@@ -1,0 +1,15 @@
+"""distribuuuu_tpu — a TPU-native distributed image-classification training framework.
+
+A ground-up JAX/XLA/pjit/pallas rebuild of the capabilities of
+BIGBALLON/distribuuuu (reference: /root/reference): distributed ImageNet
+training of CNN/attention classifiers with data parallelism over a
+`jax.sharding.Mesh`, SyncBN via cross-replica collectives, epoch-granular
+LR schedules, auto-resume checkpointing, and a yacs-style `--cfg file.yaml
+KEY VALUE ...` CLI.
+
+Compute path is JAX/XLA (MXU-friendly NHWC + bfloat16 by default) with
+optional Pallas kernels; distribution is SPMD via `shard_map` over a device
+mesh with XLA collectives (psum/pmean) riding ICI.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,147 @@
+"""Global config tree + CLI loading.
+
+Mirrors the reference config surface (`/root/reference/distribuuuu/config.py:10-100`):
+the same key tree, defaults, and precedence (defaults < --cfg YAML < trailing
+``KEY VALUE`` opts, then freeze), so the shipped YAMLs and the documented
+``train_net.py --cfg config/resnet50.yaml KEY VALUE ...`` UX work unchanged.
+
+TPU-native additions (new sections; absent keys in old YAMLs simply keep defaults):
+
+- ``MODEL.DTYPE``: compute dtype for the fwd/bwd pass ("bfloat16" rides the MXU
+  at full rate; "float32" for exact-parity runs). Params/optimizer state/BN
+  statistics always stay float32.
+- ``MODEL.REMAT``: rematerialize (activation-checkpoint) each residual stage —
+  the `jax.checkpoint` analog of the reference DenseNet's ``memory_efficient``
+  (`densenet.py:81-108`), available for every model.
+- ``MESH.*``: device-mesh shape. DATA=-1 means "all visible devices" on the
+  data axis (the reference is DP-only, `trainer.py:134`).
+- ``CUDNN.*`` is kept for YAML compatibility and remapped: BENCHMARK is a no-op
+  under XLA (autotuning is always on), DETERMINISTIC sets XLA deterministic ops.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from distribuuuu_tpu.cfgnode import CfgNode as CN
+
+_C = CN()
+cfg = _C
+
+_C.MODEL = CN()
+_C.MODEL.ARCH = "resnet18"
+_C.MODEL.NUM_CLASSES = 1000
+_C.MODEL.PRETRAINED = False
+_C.MODEL.SYNCBN = False
+_C.MODEL.WEIGHTS = None
+_C.MODEL.DUMMY_INPUT = False
+# TPU additions
+_C.MODEL.DTYPE = "bfloat16"
+_C.MODEL.REMAT = False
+
+_C.TRAIN = CN()
+_C.TRAIN.BATCH_SIZE = 32  # per-device batch size, matching the reference's
+#   per-GPU meaning (global batch = BATCH_SIZE * data-parallel size,
+#   `README.md:198-201` linear-scaling table)
+_C.TRAIN.IM_SIZE = 224
+_C.TRAIN.DATASET = "./data/ILSVRC/"
+_C.TRAIN.SPLIT = "train"
+_C.TRAIN.AUTO_RESUME = True
+_C.TRAIN.LOAD_OPT = True
+_C.TRAIN.WORKERS = 4
+_C.TRAIN.PIN_MEMORY = True  # kept for CLI compat; maps to device prefetch
+_C.TRAIN.PRINT_FREQ = 30
+_C.TRAIN.TOPK = 5
+# TPU additions
+_C.TRAIN.PREFETCH = 2  # batches prefetched to device HBM ahead of compute
+_C.TRAIN.LABEL_SMOOTH = 0.0
+
+_C.TEST = CN()
+_C.TEST.DATASET = "./data/ILSVRC/"
+_C.TEST.SPLIT = "val"
+_C.TEST.BATCH_SIZE = 200
+_C.TEST.IM_SIZE = 256
+_C.TEST.PRINT_FREQ = 10
+
+_C.CUDNN = CN()
+_C.CUDNN.BENCHMARK = True
+_C.CUDNN.DETERMINISTIC = False
+
+_C.OPTIM = CN()
+# Learning rate policy select from {'cos', 'steps'}
+_C.OPTIM.MAX_EPOCH = 100
+_C.OPTIM.LR_POLICY = "cos"
+_C.OPTIM.BASE_LR = 0.2
+_C.OPTIM.MIN_LR = 0.0
+_C.OPTIM.STEPS = []
+_C.OPTIM.LR_MULT = 0.1
+_C.OPTIM.MOMENTUM = 0.9
+_C.OPTIM.DAMPENING = 0.0
+_C.OPTIM.NESTEROV = True
+_C.OPTIM.WARMUP_FACTOR = 0.1
+_C.OPTIM.WARMUP_EPOCHS = 5
+_C.OPTIM.WEIGHT_DECAY = 5e-5
+
+# Device mesh (TPU addition). The reference's only axis is data parallelism;
+# axes are declared here so multi-axis meshes (see parallel/) slot in.
+_C.MESH = CN()
+_C.MESH.DATA = -1  # -1: all devices on the 'data' axis
+
+# Output directory
+_C.OUT_DIR = "./exp"
+_C.CFG_DEST = "config.yaml"
+
+_C.RNG_SEED = None
+
+_CFG_DEFAULT = _C.clone()
+_CFG_DEFAULT.freeze()
+
+
+def merge_from_file(cfg_file: str) -> None:
+    _C.merge_from_file(cfg_file)
+
+
+def dump_cfg() -> None:
+    """Dump the config to OUT_DIR/CFG_DEST (provenance, `config.py:75-79`)."""
+    os.makedirs(_C.OUT_DIR, exist_ok=True)
+    cfg_file = os.path.join(_C.OUT_DIR, _C.CFG_DEST)
+    with open(cfg_file, "w") as f:
+        _C.dump(stream=f)
+
+
+def reset_cfg() -> None:
+    """Reset config to initial state (leaves the singleton mutable)."""
+    _C.defrost()
+    _C.clear()
+    for k, v in _CFG_DEFAULT.clone().items():
+        _C[k] = v
+
+
+def load_cfg_fom_args(description: str = "Config file options.", argv=None) -> None:
+    """Load config from command line arguments and set any specified options.
+
+    CLI contract identical to the reference (`config.py:87-100`): ``--cfg`` for
+    the YAML, a ``--local_rank`` flag accepted-and-ignored for launcher
+    compatibility, and a trailing ``KEY VALUE ...`` remainder of overrides.
+    (The name's typo is preserved deliberately — it is public API.)
+    """
+    parser = argparse.ArgumentParser(description=description)
+    parser.add_argument("--cfg", dest="cfg_file", help="Config file location", default=None, type=str)
+    parser.add_argument(
+        "--local_rank",
+        help="accepted for launcher compatibility; JAX is one process per host",
+        default=None,
+    )
+    parser.add_argument(
+        "opts",
+        help="See distribuuuu_tpu/config.py for all options",
+        default=None,
+        nargs=argparse.REMAINDER,
+    )
+    args = parser.parse_args(sys.argv[1:] if argv is None else argv)
+    if args.cfg_file is not None:
+        merge_from_file(args.cfg_file)
+    if args.opts:
+        _C.merge_from_list(args.opts)

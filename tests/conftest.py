@@ -1,0 +1,29 @@
+"""Test harness: run every test on a virtual 8-device CPU mesh.
+
+This is the TPU-native analog of the reference's "multi-node on localhost"
+testing trick (`/root/reference/README.md:119-144`): instead of faking nodes
+with multiple launcher processes, we fake an 8-chip slice inside one process
+via XLA's host-platform device partitioning, so all sharding/collective code
+paths (psum over the data axis, SyncBN, sharded eval) execute for real.
+
+Must set env vars BEFORE jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def fresh_cfg():
+    """Reset the global config singleton around a test."""
+    from distribuuuu_tpu import config
+
+    config.reset_cfg()
+    yield config.cfg
+    config.reset_cfg()

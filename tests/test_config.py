@@ -1,0 +1,131 @@
+"""Config system tests: merge precedence, freeze, CLI contract, dump round-trip."""
+
+import os
+
+import pytest
+
+from distribuuuu_tpu import config
+from distribuuuu_tpu.cfgnode import CfgNode
+
+
+def test_defaults(fresh_cfg):
+    assert fresh_cfg.MODEL.ARCH == "resnet18"
+    assert fresh_cfg.MODEL.NUM_CLASSES == 1000
+    assert fresh_cfg.OPTIM.BASE_LR == 0.2
+    assert fresh_cfg.OPTIM.WARMUP_EPOCHS == 5
+    assert fresh_cfg.TRAIN.BATCH_SIZE == 32
+    assert fresh_cfg.RNG_SEED is None
+
+
+def test_merge_from_list(fresh_cfg):
+    fresh_cfg.merge_from_list(
+        ["MODEL.ARCH", "resnet50", "OPTIM.BASE_LR", "0.4", "TRAIN.BATCH_SIZE", "64"]
+    )
+    assert fresh_cfg.MODEL.ARCH == "resnet50"
+    assert fresh_cfg.OPTIM.BASE_LR == 0.4
+    assert fresh_cfg.TRAIN.BATCH_SIZE == 64
+
+
+def test_merge_from_list_bool_and_none(fresh_cfg):
+    fresh_cfg.merge_from_list(["MODEL.SYNCBN", "True", "MODEL.WEIGHTS", "/tmp/x.ckpt"])
+    assert fresh_cfg.MODEL.SYNCBN is True
+    assert fresh_cfg.MODEL.WEIGHTS == "/tmp/x.ckpt"
+
+
+def test_merge_rejects_unknown_key(fresh_cfg):
+    with pytest.raises(KeyError):
+        fresh_cfg.merge_from_list(["MODEL.NOPE", "1"])
+
+
+def test_merge_rejects_type_mismatch(fresh_cfg):
+    with pytest.raises(ValueError):
+        fresh_cfg.merge_from_list(["TRAIN.BATCH_SIZE", "'hello'"])
+
+
+def test_int_to_float_promotion(fresh_cfg):
+    fresh_cfg.merge_from_list(["OPTIM.BASE_LR", "1"])
+    assert fresh_cfg.OPTIM.BASE_LR == 1.0
+    assert isinstance(fresh_cfg.OPTIM.BASE_LR, float)
+
+
+def test_freeze_blocks_mutation(fresh_cfg):
+    fresh_cfg.freeze()
+    with pytest.raises(AttributeError):
+        fresh_cfg.MODEL.ARCH = "resnet50"
+    fresh_cfg.defrost()
+    fresh_cfg.MODEL.ARCH = "resnet50"
+    assert fresh_cfg.MODEL.ARCH == "resnet50"
+
+
+def test_merge_from_file(tmp_path, fresh_cfg):
+    yaml_path = tmp_path / "test.yaml"
+    yaml_path.write_text(
+        "MODEL:\n  ARCH: resnet50\nOPTIM:\n  BASE_LR: 0.8\nOUT_DIR: ./out50\n"
+    )
+    config.merge_from_file(str(yaml_path))
+    assert fresh_cfg.MODEL.ARCH == "resnet50"
+    assert fresh_cfg.OPTIM.BASE_LR == 0.8
+    assert fresh_cfg.OUT_DIR == "./out50"
+
+
+def test_load_cfg_fom_args_precedence(tmp_path, fresh_cfg):
+    yaml_path = tmp_path / "test.yaml"
+    yaml_path.write_text("MODEL:\n  ARCH: resnet50\nOPTIM:\n  BASE_LR: 0.8\n")
+    config.load_cfg_fom_args(
+        argv=["--cfg", str(yaml_path), "OPTIM.BASE_LR", "1.6", "MODEL.SYNCBN", "True"]
+    )
+    # YAML set 0.8, trailing opts override to 1.6
+    assert fresh_cfg.OPTIM.BASE_LR == 1.6
+    assert fresh_cfg.MODEL.ARCH == "resnet50"
+    assert fresh_cfg.MODEL.SYNCBN is True
+
+
+def test_local_rank_accepted_and_ignored(fresh_cfg):
+    config.load_cfg_fom_args(argv=["--local_rank", "3"])
+    assert fresh_cfg.MODEL.ARCH == "resnet18"
+
+
+def test_dump_round_trip(tmp_path, fresh_cfg):
+    fresh_cfg.MODEL.ARCH = "botnet50"
+    fresh_cfg.OUT_DIR = str(tmp_path / "out")
+    config.dump_cfg()
+    dumped = os.path.join(fresh_cfg.OUT_DIR, fresh_cfg.CFG_DEST)
+    assert os.path.exists(dumped)
+    reloaded = CfgNode.load_cfg(open(dumped))
+    assert reloaded.MODEL.ARCH == "botnet50"
+    assert reloaded.OPTIM.BASE_LR == 0.2
+
+
+def test_reference_yaml_compatible(tmp_path, fresh_cfg):
+    """A YAML with the reference's full key tree (incl. CUDNN) merges cleanly."""
+    yaml_path = tmp_path / "ref.yaml"
+    yaml_path.write_text(
+        """CFG_DEST: config.yaml
+CUDNN:
+  BENCHMARK: true
+  DETERMINISTIC: false
+MODEL:
+  ARCH: resnet18
+  DUMMY_INPUT: false
+  NUM_CLASSES: 1000
+  PRETRAINED: false
+  SYNCBN: false
+  WEIGHTS: null
+OPTIM:
+  BASE_LR: 0.2
+  MAX_EPOCH: 100
+OUT_DIR: ./resnet18
+RNG_SEED: null
+TRAIN:
+  BATCH_SIZE: 32
+"""
+    )
+    config.merge_from_file(str(yaml_path))
+    assert fresh_cfg.OUT_DIR == "./resnet18"
+    assert fresh_cfg.CUDNN.BENCHMARK is True
+
+
+def test_clone_independent(fresh_cfg):
+    c = fresh_cfg.clone()
+    c.MODEL.ARCH = "other"
+    assert fresh_cfg.MODEL.ARCH == "resnet18"
