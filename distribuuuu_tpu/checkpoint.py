@@ -1,0 +1,134 @@
+"""Checkpointing with the reference's directory/naming/auto-resume contract.
+
+Contract replicated from `/root/reference/distribuuuu/utils.py:319-410`:
+
+- per-epoch checkpoints under ``OUT_DIR/checkpoints/`` named ``ckpt_ep_{E:03d}``
+  (Orbax directories instead of ``.pth.tar`` files)
+- saved payload: epoch, model state (params + batch_stats — already "unwrapped";
+  there is no DDP wrapper to strip in SPMD), optimizer state, best_acc1
+- ``best`` holds weights-only state on Acc@1 improvement (`utils.py:386-387`)
+- auto-resume picks the highest-numbered checkpoint (`utils.py:337-342`)
+- loading a weights-only checkpoint for eval works (`utils.py:406-410`)
+
+Writes go through Orbax (async-capable, multi-host aware: every process calls
+save, Orbax coordinates so the write happens once — the analog of the
+reference's rank-0-only save gate at `utils.py:369-370`).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+_NAME_PREFIX = "ckpt_ep_"
+_DIR_NAME = "checkpoints"
+_BEST_NAME = "best"
+
+
+def get_checkpoint_dir(out_dir: str) -> str:
+    return os.path.join(out_dir, _DIR_NAME)
+
+
+def get_checkpoint_path(out_dir: str, epoch: int) -> str:
+    return os.path.join(get_checkpoint_dir(out_dir), f"{_NAME_PREFIX}{epoch:03d}")
+
+
+def get_best_path(out_dir: str) -> str:
+    return os.path.join(get_checkpoint_dir(out_dir), _BEST_NAME)
+
+
+# Exact-name match so Orbax in-progress temp dirs
+# (ckpt_ep_XXX.orbax-checkpoint-tmp-<ts>, left behind by a killed run) are
+# never mistaken for complete checkpoints during auto-resume.
+_CKPT_RE = re.compile(rf"^{_NAME_PREFIX}(\d+)$")
+
+
+def _complete_checkpoints(out_dir: str) -> list[tuple[int, str]]:
+    d = get_checkpoint_dir(out_dir)
+    if not os.path.isdir(d):
+        return []
+    out = []
+    for f in os.listdir(d):
+        m = _CKPT_RE.match(f)
+        if m:
+            out.append((int(m.group(1)), os.path.join(d, f)))
+    return sorted(out)
+
+
+def has_checkpoint(out_dir: str) -> bool:
+    return bool(_complete_checkpoints(out_dir))
+
+
+def get_last_checkpoint(out_dir: str) -> str:
+    """Highest-numbered checkpoint path (reference `utils.py:337-342`)."""
+    ckpts = _complete_checkpoints(out_dir)
+    if not ckpts:
+        raise FileNotFoundError(f"No checkpoints in {get_checkpoint_dir(out_dir)}")
+    return ckpts[-1][1]
+
+
+def _checkpointer() -> ocp.Checkpointer:
+    return ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+
+
+def save_checkpoint(out_dir: str, epoch: int, state: Any, best_acc1: float, is_best: bool) -> str:
+    """Save a full training checkpoint; refresh ``best`` on improvement."""
+    payload = {
+        "epoch": np.int32(epoch),
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+        "best_acc1": np.float32(best_acc1),
+    }
+    path = get_checkpoint_path(out_dir, epoch)
+    ckptr = _checkpointer()
+    ckptr.save(path, payload, force=True)
+    if is_best:
+        ckptr.save(
+            get_best_path(out_dir),
+            {"params": state.params, "batch_stats": state.batch_stats},
+            force=True,
+        )
+    return path
+
+
+def load_checkpoint(path: str, state: Any, load_opt: bool = True):
+    """Restore (state, start_epoch, best_acc1) from a checkpoint directory.
+
+    Accepts both full checkpoints and weights-only (``best``-style) ones,
+    mirroring the reference's graceful weights-only fallback (`utils.py:391-410`).
+    ``load_opt=False`` skips optimizer state (the TRAIN.LOAD_OPT warm-start
+    knob, reference `trainer.py:147-149`). Restored arrays adopt the sharding
+    of the templates in ``state``.
+    """
+    ckptr = _checkpointer()
+    meta = ckptr.metadata(path)
+    names = set(meta.item_metadata.tree.keys()) if hasattr(meta, "item_metadata") else set(
+        meta.tree.keys()
+    )
+
+    def as_template(tree):
+        return jax.tree.map(lambda x: ocp.utils.to_shape_dtype_struct(x), tree)
+
+    template = {"params": as_template(state.params), "batch_stats": as_template(state.batch_stats)}
+    full = {"epoch", "opt_state", "best_acc1"} <= names
+    if full:
+        template.update(
+            {
+                "epoch": np.int32(0),
+                "opt_state": as_template(state.opt_state),
+                "best_acc1": np.float32(0.0),
+            }
+        )
+    restored = ckptr.restore(path, args=ocp.args.PyTreeRestore(item=template))
+    new_state = state.replace(params=restored["params"], batch_stats=restored["batch_stats"])
+    if full:
+        if load_opt:
+            new_state = new_state.replace(opt_state=restored["opt_state"])
+        return new_state, int(restored["epoch"]) + 1, float(restored["best_acc1"])
+    return new_state, 0, 0.0

@@ -1,0 +1,16 @@
+"""Input pipeline: datasets, torch-free transforms, sharded host loaders."""
+
+from distribuuuu_tpu.data.dataset import DummyDataset, ImageFolder
+from distribuuuu_tpu.data.loader import (
+    construct_train_loader,
+    construct_val_loader,
+    prefetch_to_device,
+)
+
+__all__ = [
+    "DummyDataset",
+    "ImageFolder",
+    "construct_train_loader",
+    "construct_val_loader",
+    "prefetch_to_device",
+]

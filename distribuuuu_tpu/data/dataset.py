@@ -1,0 +1,76 @@
+"""Datasets: ImageFolder (torch-free) and the dummy smoke-test dataset.
+
+`ImageFolder` replicates ``torchvision.datasets.ImageFolder`` semantics the
+reference trains on (`/root/reference/distribuuuu/utils.py:126-138`):
+class-per-subdirectory, classes sorted lexicographically → contiguous ids.
+
+`DummyDataset` is the DUMMY_INPUT fake-data path (`utils.py:109-118`): random
+normalized pixels, label 0, length 1000 — the framework's first-class
+integration-smoke mechanism (SURVEY §4.1), kept identical in contract.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif", ".tiff", ".webp")
+
+
+@dataclass
+class ImageFolder:
+    """List of (path, class_id) samples under ``root/<class_name>/*``."""
+
+    root: str
+
+    def __post_init__(self):
+        if not os.path.isdir(self.root):
+            raise FileNotFoundError(f"Dataset directory not found: {self.root}")
+        self.classes = sorted(
+            d.name for d in os.scandir(self.root) if d.is_dir()
+        )
+        if not self.classes:
+            raise FileNotFoundError(f"No class directories under {self.root}")
+        self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
+        self.samples: list[tuple[str, int]] = []
+        for cls in self.classes:
+            cls_dir = os.path.join(self.root, cls)
+            for dirpath, _, filenames in sorted(os.walk(cls_dir)):
+                for fname in sorted(filenames):
+                    if fname.lower().endswith(IMG_EXTENSIONS):
+                        self.samples.append(
+                            (os.path.join(dirpath, fname), self.class_to_idx[cls])
+                        )
+        if not self.samples:
+            raise FileNotFoundError(f"No images found under {self.root}")
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+
+class DummyDataset:
+    """Random-pixel dataset with label 0 (reference `utils.py:109-118`).
+
+    Images are pre-normalized float32 so the loader can skip decode/augment
+    entirely — this measures the pure compute path, which is exactly what the
+    reference uses DUMMY_INPUT for.
+    """
+
+    def __init__(self, length: int = 1000, im_size: int = 224, seed: int = 0):
+        self.len = length
+        self.im_size = im_size
+        self._rng = np.random.default_rng(seed)
+
+    def sample_batch(self, batch_size: int) -> dict:
+        return {
+            "image": self._rng.standard_normal(
+                (batch_size, self.im_size, self.im_size, 3), dtype=np.float32
+            ),
+            "label": np.zeros((batch_size,), dtype=np.int32),
+            "weight": np.ones((batch_size,), dtype=np.float32),
+        }
+
+    def __len__(self) -> int:
+        return self.len

@@ -1,0 +1,98 @@
+"""Torch-free image transforms (PIL + numpy).
+
+Replicates the exact train/eval augmentation recipe the baselines were
+trained with (`/root/reference/distribuuuu/utils.py:128-137,162-170`):
+
+- train: RandomResizedCrop(IM_SIZE) → RandomHorizontalFlip → Normalize
+- eval:  Resize(TEST.IM_SIZE) → CenterCrop(224) → Normalize
+
+Algorithms follow the published torchvision semantics (area-scale ∈
+(0.08, 1.0), log-uniform aspect ∈ (3/4, 4/3), 10 tries then center fallback;
+``Resize`` scales the *shorter* side; bilinear interpolation) so accuracy
+baselines carry over. Output is float32 **NHWC** normalized by the ImageNet
+mean/std.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+
+import numpy as np
+from PIL import Image
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], dtype=np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], dtype=np.float32)
+
+
+def _to_normalized_array(img: Image.Image) -> np.ndarray:
+    """HWC uint8 PIL → float32 normalized NHWC-compatible array."""
+    arr = np.asarray(img, dtype=np.float32) / 255.0
+    if arr.ndim == 2:  # grayscale
+        arr = np.stack([arr] * 3, axis=-1)
+    return (arr - IMAGENET_MEAN) / IMAGENET_STD
+
+
+def random_resized_crop(
+    img: Image.Image,
+    size: int,
+    scale=(0.08, 1.0),
+    ratio=(3.0 / 4.0, 4.0 / 3.0),
+    rng: random.Random | None = None,
+) -> Image.Image:
+    """torchvision ``RandomResizedCrop`` semantics."""
+    rng = rng or random
+    width, height = img.size
+    area = width * height
+    log_ratio = (math.log(ratio[0]), math.log(ratio[1]))
+    for _ in range(10):
+        target_area = area * rng.uniform(scale[0], scale[1])
+        aspect = math.exp(rng.uniform(log_ratio[0], log_ratio[1]))
+        w = int(round(math.sqrt(target_area * aspect)))
+        h = int(round(math.sqrt(target_area / aspect)))
+        if 0 < w <= width and 0 < h <= height:
+            i = rng.randint(0, height - h)
+            j = rng.randint(0, width - w)
+            return img.resize((size, size), Image.BILINEAR, box=(j, i, j + w, i + h))
+    # fallback: center crop at clamped aspect (torchvision behavior)
+    in_ratio = width / height
+    if in_ratio < ratio[0]:
+        w, h = width, int(round(width / ratio[0]))
+    elif in_ratio > ratio[1]:
+        h, w = height, int(round(height * ratio[1]))
+    else:
+        w, h = width, height
+    i = (height - h) // 2
+    j = (width - w) // 2
+    return img.resize((size, size), Image.BILINEAR, box=(j, i, j + w, i + h))
+
+
+def resize_shorter(img: Image.Image, size: int) -> Image.Image:
+    """torchvision ``Resize(int)``: scale shorter side to ``size``."""
+    width, height = img.size
+    if width <= height:
+        new_w, new_h = size, max(1, int(round(size * height / width)))
+    else:
+        new_w, new_h = max(1, int(round(size * width / height))), size
+    return img.resize((new_w, new_h), Image.BILINEAR)
+
+
+def center_crop(img: Image.Image, size: int) -> Image.Image:
+    width, height = img.size
+    left = (width - size) // 2
+    top = (height - size) // 2
+    return img.crop((left, top, left + size, top + size))
+
+
+def train_transform(img: Image.Image, im_size: int, rng: random.Random | None = None) -> np.ndarray:
+    rng = rng or random
+    img = random_resized_crop(img, im_size, rng=rng)
+    if rng.random() < 0.5:
+        img = img.transpose(Image.FLIP_LEFT_RIGHT)
+    return _to_normalized_array(img)
+
+
+def eval_transform(img: Image.Image, resize_size: int, crop_size: int = 224) -> np.ndarray:
+    img = resize_shorter(img, resize_size)
+    img = center_crop(img, crop_size)
+    return _to_normalized_array(img)

@@ -1,0 +1,47 @@
+"""Rank-0-gated logging.
+
+Replaces the reference's loguru setup (`/root/reference/distribuuuu/utils.py:71-83`)
+with the stdlib: process 0 writes a timestamped file under OUT_DIR plus stderr;
+every other process logs to stderr at WARNING so crashes still surface. The
+``[{time} {module}:{line}]`` line format mirrors the loguru default closely
+enough that the reference's log-reading habits transfer.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+
+_FMT = "%(asctime)s.%(msecs)03d | %(levelname)-8s | %(module)s:%(funcName)s:%(lineno)d - %(message)s"
+_DATEFMT = "%Y-%m-%d %H:%M:%S"
+
+logger = logging.getLogger("distribuuuu_tpu")
+
+
+def setup_logger(out_dir: str | None = None, process_index: int = 0) -> logging.Logger:
+    """Configure the package logger. Call once after distributed bring-up.
+
+    Process 0: INFO to stderr + ``{out_dir}/{timestamp}.log`` (mirrors
+    `utils.py:74-79`). Other processes: WARNING to stderr only.
+    """
+    logger.handlers.clear()
+    logger.propagate = False
+    fmt = logging.Formatter(_FMT, datefmt=_DATEFMT)
+
+    stream = logging.StreamHandler(sys.stderr)
+    stream.setFormatter(fmt)
+    logger.addHandler(stream)
+
+    if process_index == 0:
+        logger.setLevel(logging.INFO)
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+            logfile = os.path.join(out_dir, time.strftime("%Y%m%d_%H%M%S") + ".log")
+            fh = logging.FileHandler(logfile)
+            fh.setFormatter(fmt)
+            logger.addHandler(fh)
+    else:
+        logger.setLevel(logging.WARNING)
+    return logger

@@ -1,0 +1,137 @@
+"""Meters, progress display, and accuracy.
+
+Host-side meters mirror the reference (`/root/reference/distribuuuu/utils.py:199-262`):
+running averages, a formatted per-iteration progress line, and ETA
+extrapolation. The accuracy computation differs by design: the reference
+computes top-k per step on device then calls ``.item()`` every iteration,
+forcing a GPU sync per step (`trainer.py:53-55` — flagged in SURVEY §3.2).
+Here `topk_correct` runs *inside* the jitted step and returns on-device
+counters; the trainer only materializes them on the host every PRINT_FREQ
+iterations, so the TPU never stalls on metrics.
+"""
+
+from __future__ import annotations
+
+import datetime
+
+import jax
+import jax.numpy as jnp
+
+from distribuuuu_tpu.logging import logger
+
+
+def _topk_rank(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Rank of the true label among logits: count of logits strictly greater
+    than the true-label logit; in top-k iff rank < k. Avoids a full sort."""
+    true_logit = jnp.take_along_axis(logits, labels[:, None], axis=-1)
+    return jnp.sum(logits > true_logit, axis=-1)
+
+
+def topk_correct(logits: jnp.ndarray, labels: jnp.ndarray, ks=(1, 5)):
+    """Per-k count of samples whose true label is in the top-k logits.
+
+    Same measurement as the reference `accuracy` (`utils.py:265-277`), but
+    returns raw on-device counts (float32); callers divide by the (globally
+    summed) sample count after the cross-replica psum, keeping the math exact
+    and the step free of host syncs.
+    """
+    rank = _topk_rank(logits, labels)
+    return {k: jnp.sum(rank < k).astype(jnp.float32) for k in ks}
+
+
+def topk_correct_weighted(logits, labels, weights, ks=(1, 5)):
+    """Weighted variant for exact padded eval (zero-weight pad slots)."""
+    rank = _topk_rank(logits, labels)
+    return {k: jnp.sum((rank < k).astype(jnp.float32) * weights) for k in ks}
+
+
+def per_example_nll(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Float32 per-example negative log-likelihood."""
+    log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(log_probs, labels[:, None], axis=-1)[:, 0]
+
+
+def cross_entropy_loss(
+    logits: jnp.ndarray, labels: jnp.ndarray, label_smooth: float = 0.0
+) -> jnp.ndarray:
+    """Mean softmax cross-entropy in float32 (reference criterion,
+    `trainer.py:43` `nn.CrossEntropyLoss`), with optional label smoothing."""
+    nll = per_example_nll(logits, labels)
+    if label_smooth > 0.0:
+        log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        smooth_loss = -jnp.mean(log_probs, axis=-1)
+        nll = (1.0 - label_smooth) * nll + label_smooth * smooth_loss
+    return jnp.mean(nll)
+
+
+class AverageMeter:
+    """Running average of a scalar (reference `utils.py:199-221`)."""
+
+    def __init__(self, name: str, fmt: str = ":f"):
+        self.name = name
+        self.fmt = fmt
+        self.reset()
+
+    def reset(self):
+        self.val = 0.0
+        self.avg = 0.0
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, val: float, n: int = 1):
+        self.val = val
+        self.sum += val * n
+        self.count += n
+        self.avg = self.sum / max(self.count, 1)
+
+    def __str__(self):
+        fmtstr = "{name} {val" + self.fmt + "} ({avg" + self.fmt + "})"
+        return fmtstr.format(name=self.name, val=self.val, avg=self.avg)
+
+
+class ProgressMeter:
+    """Formatted progress line + ETA extrapolation (reference `utils.py:224-252`)."""
+
+    def __init__(self, num_batches: int, meters, prefix: str = ""):
+        self.batch_fmtstr = self._get_batch_fmtstr(num_batches)
+        self.num_batches = num_batches
+        self.meters = meters
+        self.prefix = prefix
+
+    def display(self, batch: int):
+        entries = [self.prefix + self.batch_fmtstr.format(batch)]
+        entries += [str(meter) for meter in self.meters]
+        entries.append(self.cal_eta(batch))
+        logger.info("  ".join(entries))
+
+    def cal_eta(self, batch: int) -> str:
+        """Extrapolate remaining time from the running avg batch time."""
+        time_meter = next((m for m in self.meters if m.name == "Time"), None)
+        if time_meter is None or batch == 0:
+            return "ETA: N/A"
+        remain = max(self.num_batches - batch, 0)
+        seconds = int(time_meter.avg * remain)
+        return f"ETA: {datetime.timedelta(seconds=seconds)}"
+
+    @staticmethod
+    def _get_batch_fmtstr(num_batches: int) -> str:
+        num_digits = len(str(num_batches // 1))
+        fmt = "{:" + str(num_digits) + "d}"
+        return "[" + fmt + "/" + fmt.format(num_batches) + "]"
+
+
+def construct_meters(num_batches: int, prefix: str, topk: int = 5):
+    """The standard meter set Time/Data/Loss/Acc@1/Acc@k (`utils.py:255-262`)."""
+    batch_time = AverageMeter("Time", ":.3f")
+    data_time = AverageMeter("Data", ":.3f")
+    losses = AverageMeter("Loss", ":.4e")
+    top1 = AverageMeter("Acc@1", ":6.2f")
+    topk_m = AverageMeter(f"Acc@{topk}", ":6.2f")
+    meters = [batch_time, data_time, losses, top1, topk_m]
+    progress = ProgressMeter(num_batches, meters, prefix=prefix)
+    return batch_time, data_time, losses, top1, topk_m, progress
+
+
+def count_parameters(params) -> float:
+    """Parameter count in millions (reference `utils.py:353-357`)."""
+    return sum(x.size for x in jax.tree.leaves(params)) / 1e6

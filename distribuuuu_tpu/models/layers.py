@@ -1,0 +1,111 @@
+"""Shared building blocks for the model zoo.
+
+Conventions (TPU-first):
+
+- **NHWC** activations everywhere — the native layout for XLA:TPU conv
+  emitters (the reference is NCHW because cuDNN prefers it; that would force
+  transposes on TPU).
+- Convs/dense run in the model's compute ``dtype`` (bfloat16 by default — full
+  MXU rate); **parameters and BatchNorm statistics stay float32** and BN math
+  is done in float32 for stability.
+- Weight init matches torch semantics the reference relies on
+  (`/root/reference/distribuuuu/models/resnet.py:213-228`): kaiming-normal
+  fan-out for convs, unit/zero BN affine, with optional zero-γ on the last BN
+  of a residual block ("zero-init-residual").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# torch nn.init.kaiming_normal_(mode="fan_out", nonlinearity="relu"):
+# N(0, sqrt(2 / fan_out)) — variance_scaling(2.0, fan_out, normal).
+kaiming_normal_out = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+# torch nn.Linear default: U(-1/sqrt(fan_in), 1/sqrt(fan_in)).
+linear_uniform = nn.initializers.variance_scaling(1.0 / 3.0, "fan_in", "uniform")
+
+
+def conv(
+    features: int,
+    kernel: int,
+    stride: int = 1,
+    *,
+    padding: int | None = None,
+    groups: int = 1,
+    dtype: Any = jnp.bfloat16,
+    name: str | None = None,
+    kernel_init: Callable = kaiming_normal_out,
+) -> nn.Conv:
+    """Bias-free conv with torch-style *explicit symmetric* padding.
+
+    Explicit numbers rather than "SAME": for even inputs and strided kernels
+    SAME pads asymmetrically, which would silently misalign feature maps
+    versus the reference recipe's conv arithmetic.
+    """
+    if padding is None:
+        padding = (kernel - 1) // 2
+    return nn.Conv(
+        features=features,
+        kernel_size=(kernel, kernel),
+        strides=(stride, stride),
+        padding=[(padding, padding), (padding, padding)],
+        feature_group_count=groups,
+        use_bias=False,
+        dtype=dtype,
+        param_dtype=jnp.float32,
+        kernel_init=kernel_init,
+        name=name,
+    )
+
+
+def batch_norm(
+    *,
+    train: bool,
+    axis_name: str | None = None,
+    zero_scale: bool = False,
+    name: str | None = None,
+    momentum: float = 0.9,
+) -> nn.BatchNorm:
+    """BatchNorm matching torch defaults (eps 1e-5, momentum 0.1 ⇒ flax 0.9).
+
+    ``axis_name='data'`` turns this into SyncBN: batch statistics are averaged
+    across the mesh's data axis with `lax.pmean` inside the shard_mapped step —
+    the XLA-collective replacement for `nn.SyncBatchNorm.convert_sync_batchnorm`
+    (`/root/reference/distribuuuu/trainer.py:131`).
+
+    Always computes in float32 regardless of the surrounding compute dtype.
+    """
+    return nn.BatchNorm(
+        use_running_average=not train,
+        momentum=momentum,
+        epsilon=1e-5,
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+        axis_name=axis_name,
+        scale_init=nn.initializers.zeros if zero_scale else nn.initializers.ones,
+        name=name,
+    )
+
+
+def classifier_head(x: jnp.ndarray, num_classes: int, *, name: str = "fc") -> jnp.ndarray:
+    """Global average pool (NHWC spatial axes) + float32 linear classifier."""
+    x = jnp.mean(x, axis=(1, 2), dtype=jnp.float32)
+    return nn.Dense(
+        num_classes,
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+        kernel_init=linear_uniform,
+        bias_init=nn.initializers.zeros,
+        name=name,
+    )(x)
+
+
+def maybe_remat(module_cls, enabled: bool):
+    """`jax.checkpoint` a block class — the `torch.utils.checkpoint` analog the
+    reference uses for memory-efficient DenseNet (`densenet.py:81-108`),
+    generalized to every family via cfg.MODEL.REMAT."""
+    return nn.remat(module_cls) if enabled else module_cls

@@ -1,0 +1,42 @@
+"""Name → factory model registry.
+
+The reference dispatches via module ``globals()`` with a silent timm fallback
+(`/root/reference/distribuuuu/models/__init__.py:6-7`, `trainer.py:117-128`).
+Here registration is explicit (decorator) and the whole baseline zoo — incl.
+the archs the reference outsourced to timm (efficientnet_b0, regnetx_160,
+regnety_160/320) — is first-class in-repo, so there is no fallback path; an
+unknown arch fails loudly with the available names.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import flax.linen as nn
+
+_REGISTRY: Dict[str, Callable[..., nn.Module]] = {}
+
+
+def register_model(name: str):
+    def deco(fn: Callable[..., nn.Module]):
+        if name in _REGISTRY:
+            raise ValueError(f"Duplicate model registration: {name}")
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def list_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def build_model(arch: str, **kwargs) -> nn.Module:
+    """Instantiate a registered architecture (reference `build_model` contract)."""
+    try:
+        factory = _REGISTRY[arch]
+    except KeyError:
+        raise KeyError(
+            f"Unknown MODEL.ARCH {arch!r}. Available: {', '.join(list_models())}"
+        ) from None
+    return factory(**kwargs)

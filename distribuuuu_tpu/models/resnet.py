@@ -1,0 +1,196 @@
+"""ResNet family — Flax/NHWC rebuild of the reference zoo.
+
+Architecture parity with `/root/reference/distribuuuu/models/resnet.py` (the
+torchvision ResNet v1.5 recipe): the stride sits on the 3×3 conv of the
+Bottleneck (`resnet.py:107-111`), BasicBlock/Bottleneck expansions 1/4,
+ResNeXt via grouped 3×3 convs, wide variants via ``width_per_group=128``,
+kaiming fan-out init + optional zero-init of each block's last BN γ
+(`resnet.py:213-228`). Factories: resnet18/34/50/101/152,
+resnext50_32x4d/resnext101_32x8d, wide_resnet50_2/wide_resnet101_2
+(`resnet.py:315-447`).
+
+TPU-first departures from the reference (see models/layers.py): NHWC layout,
+bfloat16 compute on the MXU with float32 params/BN, optional per-block
+rematerialization, and SyncBN as a BN axis_name rather than a module rewrite.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Type
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distribuuuu_tpu.models.layers import (
+    batch_norm,
+    classifier_head,
+    conv,
+    maybe_remat,
+)
+from distribuuuu_tpu.models.registry import register_model
+
+
+class BasicBlock(nn.Module):
+    """3×3 + 3×3 residual block (expansion 1), reference `resnet.py:57-103`."""
+
+    expansion = 1
+
+    planes: int
+    stride: int = 1
+    downsample: bool = False
+    groups: int = 1
+    base_width: int = 64
+    zero_init_residual: bool = False
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        identity = x
+        out = conv(self.planes, 3, self.stride, dtype=self.dtype, name="conv1")(x)
+        out = batch_norm(train=train, axis_name=self.bn_axis_name, name="bn1")(out)
+        out = nn.relu(out)
+        out = conv(self.planes, 3, dtype=self.dtype, name="conv2")(out)
+        out = batch_norm(
+            train=train,
+            axis_name=self.bn_axis_name,
+            zero_scale=self.zero_init_residual,
+            name="bn2",
+        )(out)
+        if self.downsample:
+            identity = conv(self.planes, 1, self.stride, dtype=self.dtype, name="ds_conv")(x)
+            identity = batch_norm(train=train, axis_name=self.bn_axis_name, name="ds_bn")(identity)
+        return nn.relu(out + identity)
+
+
+class Bottleneck(nn.Module):
+    """1×1 → 3×3(stride, groups) → 1×1 block (expansion 4), v1.5 semantics:
+    the stride is on the 3×3 conv (reference `resnet.py:106-161`)."""
+
+    expansion = 4
+
+    planes: int
+    stride: int = 1
+    downsample: bool = False
+    groups: int = 1
+    base_width: int = 64
+    zero_init_residual: bool = False
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        width = int(self.planes * (self.base_width / 64.0)) * self.groups
+        identity = x
+        out = conv(width, 1, dtype=self.dtype, name="conv1")(x)
+        out = batch_norm(train=train, axis_name=self.bn_axis_name, name="bn1")(out)
+        out = nn.relu(out)
+        out = conv(width, 3, self.stride, groups=self.groups, dtype=self.dtype, name="conv2")(out)
+        out = batch_norm(train=train, axis_name=self.bn_axis_name, name="bn2")(out)
+        out = nn.relu(out)
+        out = conv(self.planes * self.expansion, 1, dtype=self.dtype, name="conv3")(out)
+        out = batch_norm(
+            train=train,
+            axis_name=self.bn_axis_name,
+            zero_scale=self.zero_init_residual,
+            name="bn3",
+        )(out)
+        if self.downsample:
+            identity = conv(
+                self.planes * self.expansion, 1, self.stride, dtype=self.dtype, name="ds_conv"
+            )(x)
+            identity = batch_norm(train=train, axis_name=self.bn_axis_name, name="ds_bn")(identity)
+        return nn.relu(out + identity)
+
+
+class ResNet(nn.Module):
+    """Trunk: 7×7/2 stem → maxpool → 4 stages → GAP → fc (reference
+    `resnet.py:164-276`)."""
+
+    block: Type[nn.Module]
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    groups: int = 1
+    width_per_group: int = 64
+    zero_init_residual: bool = False
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: str | None = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        block_cls = maybe_remat(self.block, self.remat)
+        x = conv(64, 7, 2, padding=3, dtype=self.dtype, name="conv1")(x)
+        x = batch_norm(train=train, axis_name=self.bn_axis_name, name="bn1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+
+        in_features = 64
+        for stage, num_blocks in enumerate(self.stage_sizes):
+            planes = 64 * (2**stage)
+            for i in range(num_blocks):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                downsample = stride != 1 or in_features != planes * self.block.expansion
+                x = block_cls(
+                    planes=planes,
+                    stride=stride,
+                    downsample=downsample,
+                    groups=self.groups,
+                    base_width=self.width_per_group,
+                    zero_init_residual=self.zero_init_residual,
+                    dtype=self.dtype,
+                    bn_axis_name=self.bn_axis_name,
+                    name=f"layer{stage + 1}_{i}",
+                )(x, train=train)
+                in_features = planes * self.block.expansion
+
+        return classifier_head(x, self.num_classes)
+
+
+def _resnet(block, stage_sizes, **kwargs) -> ResNet:
+    return ResNet(block=block, stage_sizes=stage_sizes, **kwargs)
+
+
+@register_model("resnet18")
+def resnet18(**kw):
+    return _resnet(BasicBlock, [2, 2, 2, 2], **kw)
+
+
+@register_model("resnet34")
+def resnet34(**kw):
+    return _resnet(BasicBlock, [3, 4, 6, 3], **kw)
+
+
+@register_model("resnet50")
+def resnet50(**kw):
+    return _resnet(Bottleneck, [3, 4, 6, 3], **kw)
+
+
+@register_model("resnet101")
+def resnet101(**kw):
+    return _resnet(Bottleneck, [3, 4, 23, 3], **kw)
+
+
+@register_model("resnet152")
+def resnet152(**kw):
+    return _resnet(Bottleneck, [3, 8, 36, 3], **kw)
+
+
+@register_model("resnext50_32x4d")
+def resnext50_32x4d(**kw):
+    return _resnet(Bottleneck, [3, 4, 6, 3], groups=32, width_per_group=4, **kw)
+
+
+@register_model("resnext101_32x8d")
+def resnext101_32x8d(**kw):
+    return _resnet(Bottleneck, [3, 4, 23, 3], groups=32, width_per_group=8, **kw)
+
+
+@register_model("wide_resnet50_2")
+def wide_resnet50_2(**kw):
+    return _resnet(Bottleneck, [3, 4, 6, 3], width_per_group=128, **kw)
+
+
+@register_model("wide_resnet101_2")
+def wide_resnet101_2(**kw):
+    return _resnet(Bottleneck, [3, 4, 23, 3], width_per_group=128, **kw)

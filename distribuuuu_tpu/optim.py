@@ -1,0 +1,127 @@
+"""Optimizer and epoch-granular LR schedules.
+
+Semantics-parity notes versus the reference:
+
+- **SGD update rule** (`/root/reference/distribuuuu/utils.py:187-196`, torch
+  SGD): ``g = grad + wd·p``; ``buf = m·buf + (1-dampening)·g``; update is
+  ``g + m·buf`` under nesterov else ``buf``; then ``p -= lr·update``. The LR
+  multiplies the update *after* momentum, so the buffer is LR-free — the
+  optimizer chain here therefore excludes LR, and the trainer applies
+  ``-lr`` at update time with lr passed as a traced scalar (changing it per
+  epoch never recompiles the step).
+- **Weight decay is coupled L2 on every parameter** (torch default: a single
+  param group), including BN affine and biases — kept for baseline parity.
+- **Schedules are epoch-granularity** (`trainer.py:25-26`): LR is computed on
+  the host once per epoch with *exactly* the reference math
+  (`utils.py:280-310`): cosine ``(1-MIN_LR)·½(1+cos(πe/E)) + MIN_LR`` scaled
+  by BASE_LR; steps ``LR_MULT^(last index with e ≥ STEPS[i])``; linear warmup
+  factor ``WARMUP_FACTOR·(1-α)+α`` with ``α = e/WARMUP_EPOCHS``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import chex
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distribuuuu_tpu.config import cfg
+
+
+# ---------------------------------------------------------------------------
+# LR schedule (host-side, float math identical to reference)
+# ---------------------------------------------------------------------------
+
+def lr_fun_steps(cur_epoch: int) -> float:
+    """Steps schedule (cfg.OPTIM.LR_POLICY = 'steps')."""
+    ind = [i for i, s in enumerate(cfg.OPTIM.STEPS) if cur_epoch >= s][-1]
+    return cfg.OPTIM.LR_MULT**ind
+
+
+def lr_fun_cos(cur_epoch: int) -> float:
+    """Half-period cosine schedule (cfg.OPTIM.LR_POLICY = 'cos')."""
+    lr = 0.5 * (1.0 + np.cos(np.pi * cur_epoch / cfg.OPTIM.MAX_EPOCH))
+    return (1.0 - cfg.OPTIM.MIN_LR) * lr + cfg.OPTIM.MIN_LR
+
+
+_LR_POLICIES = {"steps": lr_fun_steps, "cos": lr_fun_cos}
+
+
+def get_epoch_lr(cur_epoch: int) -> float:
+    """LR for a given epoch: policy × BASE_LR, with linear warmup."""
+    try:
+        lr_fun = _LR_POLICIES[cfg.OPTIM.LR_POLICY]
+    except KeyError:
+        raise ValueError(f"Unknown LR policy: {cfg.OPTIM.LR_POLICY}") from None
+    lr = lr_fun(cur_epoch) * cfg.OPTIM.BASE_LR
+    if cur_epoch < cfg.OPTIM.WARMUP_EPOCHS:
+        alpha = cur_epoch / cfg.OPTIM.WARMUP_EPOCHS
+        warmup_factor = cfg.OPTIM.WARMUP_FACTOR * (1.0 - alpha) + alpha
+        lr *= warmup_factor
+    return lr
+
+
+# ---------------------------------------------------------------------------
+# SGD transform (LR-free; trainer scales by -lr)
+# ---------------------------------------------------------------------------
+
+class TraceState(NamedTuple):
+    momentum: optax.Updates
+    step: chex.Array
+
+
+def sgd_momentum(
+    momentum: float, dampening: float = 0.0, nesterov: bool = True
+) -> optax.GradientTransformation:
+    """Torch-semantics momentum (supports dampening, unlike `optax.trace`).
+
+    Torch seeds the buffer with the *raw* first gradient (``buf = g``, not
+    ``(1-dampening)·g``); a step counter reproduces that exactly while keeping
+    the state pytree structure static for jit.
+    """
+
+    def init(params):
+        return TraceState(
+            momentum=jax.tree.map(jnp.zeros_like, params),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    def update(updates, state, params=None):
+        del params
+        first = state.step == 0
+
+        def upd(g, buf):
+            seeded = jnp.where(first, g, momentum * buf + (1.0 - dampening) * g)
+            return seeded
+
+        new_bufs = jax.tree.map(upd, updates, state.momentum)
+        if nesterov:
+            outs = jax.tree.map(lambda g, b: g + momentum * b, updates, new_bufs)
+        else:
+            outs = new_bufs
+        return outs, TraceState(momentum=new_bufs, step=state.step + 1)
+
+    return optax.GradientTransformation(init, update)
+
+
+def construct_optimizer() -> optax.GradientTransformation:
+    """SGD+momentum+nesterov+coupled-WD from cfg (reference `utils.py:187-196`).
+
+    Produces the *ascent direction*; the trainer applies ``params - lr·update``.
+    """
+    return optax.chain(
+        optax.add_decayed_weights(cfg.OPTIM.WEIGHT_DECAY),
+        sgd_momentum(
+            momentum=cfg.OPTIM.MOMENTUM,
+            dampening=cfg.OPTIM.DAMPENING,
+            nesterov=cfg.OPTIM.NESTEROV,
+        ),
+    )
+
+
+def apply_updates_with_lr(params, updates, lr: chex.Numeric):
+    """``p ← p − lr·u`` with lr a traced scalar (no recompile across epochs)."""
+    return jax.tree.map(lambda p, u: (p - lr * u).astype(p.dtype), params, updates)

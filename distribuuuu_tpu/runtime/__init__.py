@@ -1,0 +1,13 @@
+"""Runtime core: distributed bring-up, device mesh, seeding."""
+
+from distribuuuu_tpu.runtime.dist import DistInfo, setup_distributed
+from distribuuuu_tpu.runtime.mesh import create_mesh, data_mesh
+from distribuuuu_tpu.runtime.seeding import setup_seed
+
+__all__ = [
+    "DistInfo",
+    "setup_distributed",
+    "create_mesh",
+    "data_mesh",
+    "setup_seed",
+]

@@ -1,0 +1,109 @@
+"""Multi-host distributed bring-up.
+
+TPU-native replacement for the reference's `setup_distributed`
+(`/root/reference/distribuuuu/utils.py:19-51`). The reference runs one process
+per GPU and rendezvouses a NCCL process group over MASTER_ADDR/MASTER_PORT;
+JAX runs **one process per host** and rendezvouses all hosts with the JAX
+coordination service via `jax.distributed.initialize()`. Collectives are then
+compiled into the program by XLA and ride ICI/DCN — there is no persistent
+"process group" object to manage.
+
+Environment autodetection mirrors the reference's dual Slurm/launcher logic:
+
+- **Slurm** (`SLURM_JOB_ID` present, `utils.py:26-40`): process_id from
+  ``SLURM_PROCID``, world from ``SLURM_NTASKS``, coordinator from the first
+  hostname of ``SLURM_NODELIST`` (via `scontrol`, with a pure-Python fallback
+  parser), port from ``MASTER_PORT`` defaulting to 29566 — the same default
+  port as `utils.py:35`.
+- **Manual / launcher** (`utils.py:41-43` vocabulary): ``RANK``/``WORLD_SIZE``
+  + ``MASTER_ADDR``/``MASTER_PORT``, reinterpreted as per-host values.
+- **TPU pod metadata**: if none of the above is set, `jax.distributed.initialize()`
+  with no args lets JAX use cloud TPU metadata when on a pod; single-process
+  otherwise (we skip initialize entirely when no multi-host signal exists).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+from dataclasses import dataclass
+
+import jax
+
+
+@dataclass(frozen=True)
+class DistInfo:
+    """What the trainer needs to know about the job topology."""
+
+    process_index: int  # ~ reference "rank" (but per-host, not per-GPU)
+    process_count: int  # ~ reference "world_size" in hosts
+    local_device_count: int
+    global_device_count: int
+
+    @property
+    def is_primary(self) -> bool:
+        return self.process_index == 0
+
+
+_DEFAULT_PORT = 29566  # same default as the reference (`utils.py:35`)
+
+
+def _first_slurm_hostname(nodelist: str) -> str:
+    """Resolve the first hostname of a Slurm nodelist.
+
+    Prefers ``scontrol show hostname`` (what the reference shells out to,
+    `utils.py:29-30`); falls back to parsing compressed forms like
+    ``tpu-host-[3-7,9]`` so bring-up works where scontrol is absent.
+    """
+    try:
+        out = subprocess.run(
+            ["scontrol", "show", "hostname", nodelist],
+            capture_output=True, text=True, check=True, timeout=10,
+        ).stdout
+        first = out.splitlines()[0].strip()
+        if first:
+            return first
+    except (OSError, subprocess.SubprocessError, IndexError):
+        pass
+    m = re.match(r"([^\[,]+)(?:\[(\d+)[-,\d]*\])?", nodelist)
+    if not m:
+        raise ValueError(f"Cannot parse SLURM nodelist: {nodelist!r}")
+    prefix, first_idx = m.group(1), m.group(2)
+    return prefix if first_idx is None else f"{prefix}{first_idx}"
+
+
+def setup_distributed(port: int | None = None) -> DistInfo:
+    """Initialize multi-host JAX if the environment calls for it; return topology.
+
+    Idempotent per process. Safe to call in single-process runs (no-op).
+    """
+    env = os.environ
+    coordinator = None
+    num_processes = 1
+    process_id = 0
+
+    if "SLURM_JOB_ID" in env and "SLURM_PROCID" in env:
+        process_id = int(env["SLURM_PROCID"])
+        num_processes = int(env.get("SLURM_NTASKS", "1"))
+        addr = _first_slurm_hostname(env["SLURM_NODELIST"])
+        coordinator = f"{addr}:{port or int(env.get('MASTER_PORT', _DEFAULT_PORT))}"
+    elif "RANK" in env and "WORLD_SIZE" in env:
+        process_id = int(env["RANK"])
+        num_processes = int(env["WORLD_SIZE"])
+        addr = env.get("MASTER_ADDR", "127.0.0.1")
+        coordinator = f"{addr}:{port or int(env.get('MASTER_PORT', _DEFAULT_PORT))}"
+
+    if num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+
+    return DistInfo(
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+    )

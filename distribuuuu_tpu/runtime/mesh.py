@@ -1,0 +1,54 @@
+"""Device-mesh construction.
+
+The reference's parallelism topology is implicit in its process layout (one
+process per GPU, DDP over all of them, `trainer.py:134`). Here topology is an
+explicit `jax.sharding.Mesh`. The framework's core is data-parallel over a
+1-D ``('data',)`` mesh; `create_mesh` is general over named axes so richer
+layouts (data × model × sequence, see `distribuuuu_tpu/parallel/`) use the
+same entry point.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def create_mesh(axes: dict[str, int]) -> Mesh:
+    """Build a Mesh from ordered ``{axis_name: size}``; one size may be -1.
+
+    -1 is inferred from the remaining device count (like a reshape wildcard).
+    Uses `mesh_utils.create_device_mesh` for ICI-aware device ordering on real
+    TPU topologies, falling back to the flat device list (CPU meshes).
+    """
+    devices = jax.devices()
+    n = len(devices)
+    sizes = dict(axes)
+    wildcards = [k for k, v in sizes.items() if v == -1]
+    if len(wildcards) > 1:
+        raise ValueError(f"At most one -1 axis allowed, got {wildcards}")
+    known = math.prod(v for v in sizes.values() if v != -1)
+    if wildcards:
+        if n % known != 0:
+            raise ValueError(f"{n} devices not divisible by fixed axes {sizes}")
+        sizes[wildcards[0]] = n // known
+    total = math.prod(sizes.values())
+    if total != n:
+        raise ValueError(f"Mesh {sizes} needs {total} devices, have {n}")
+
+    shape = tuple(sizes.values())
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(shape)
+    except Exception:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(sizes.keys()))
+
+
+def data_mesh(data: int = -1) -> Mesh:
+    """The framework's default 1-D data-parallel mesh (cfg.MESH.DATA)."""
+    return create_mesh({"data": data})

@@ -1,0 +1,72 @@
+"""Seeding and determinism.
+
+Replaces `/root/reference/distribuuuu/utils.py:54-68`: when ``RNG_SEED`` is
+set, every source of randomness derives from it — the returned
+`jax.random.PRNGKey` plus numpy and Python ``random`` (used by the host-side
+augmentation pipeline), with the host streams offset by the process index
+(the analog of the reference's per-rank ``seed + rank``). When unset, a fresh
+OS-entropy seed is drawn (the reference leaves torch's OS-derived default
+seeding in place).
+
+Key-splitting contract: the *returned key is identical on every host* — model
+init must produce the same params everywhere (the analog of DDP's rank-0
+weight broadcast, reference `trainer.py:134`). Consumers that need
+distinct per-host/per-device streams (dropout, data augmentation) fold in the
+process index / `lax.axis_index` themselves: the trainer folds
+``process_index`` into its dropout key and the train step folds the mesh
+axis index per device.
+
+Determinism knob: ``CUDNN.DETERMINISTIC`` maps to XLA's deterministic-ops
+flag via `configure_determinism`, which must run **before the first JAX
+backend use** (flags are read once at client init) — the trainer calls it
+first thing.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+import jax
+import numpy as np
+
+from distribuuuu_tpu.logging import logger
+
+
+def configure_determinism(deterministic: bool) -> None:
+    """Apply XLA determinism flags; warn if the backend already initialized.
+
+    TPU executions are deterministic for this framework's op set by default;
+    the GPU flag is set for parity when running the same code on GPU backends.
+    """
+    if not deterministic:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_gpu_deterministic_ops" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " --xla_gpu_deterministic_ops=true").strip()
+    try:
+        import jax.extend.backend as jeb
+
+        initialized = jeb.backends() is not None and bool(dict(jeb.backends()))
+    except Exception:
+        initialized = False
+    if initialized:
+        logger.warning(
+            "CUDNN.DETERMINISTIC set after the XLA client initialized; "
+            "flags may not take effect for this process."
+        )
+
+
+def setup_seed(seed: int | None, process_index: int = 0):
+    """Seed host RNG sources; return the (host-identical) root `PRNGKey`.
+
+    Mirrors the reference contract (`utils.py:60-65`): with a seed, runs are
+    reproducible; without, entropy comes from the OS. numpy/python streams
+    are offset per process so each host augments differently.
+    """
+    if seed is None:
+        seed = int.from_bytes(os.urandom(4), "little")
+    host_seed = (seed + process_index) % (2**32)
+    np.random.seed(host_seed)
+    random.seed(host_seed)
+    return jax.random.PRNGKey(seed)

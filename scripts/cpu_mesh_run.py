@@ -1,0 +1,40 @@
+"""Run any entry point on a virtual N-device CPU mesh (default 8).
+
+The TPU-native analog of the reference's "multi-node on localhost" recipe
+(`/root/reference/README.md:119-144`): all sharding/collective code runs for
+real, just on partitioned host CPU devices. Usage:
+
+    python scripts/cpu_mesh_run.py train_net.py --cfg config/resnet18.yaml ...
+    DTPU_CPU_DEVICES=16 python scripts/cpu_mesh_run.py test_net.py ...
+
+Exists because this environment pins the JAX platform programmatically at
+interpreter start, so the plain ``JAX_PLATFORMS=cpu`` env var is not enough.
+"""
+
+import os
+import runpy
+import sys
+
+
+def main():
+    n = os.environ.get("DTPU_CPU_DEVICES", "8")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    if len(sys.argv) < 2:
+        raise SystemExit("usage: cpu_mesh_run.py <script.py> [args...]")
+    script = sys.argv[1]
+    sys.argv = sys.argv[1:]
+    # emulate `python script.py`: the script's directory leads sys.path
+    sys.path.insert(0, os.path.dirname(os.path.abspath(script)))
+    runpy.run_path(script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+"""Evaluate a classification model (reference `/root/reference/test_net.py`).
+
+Usage (identical CLI):
+    python test_net.py --cfg config/resnet50.yaml MODEL.WEIGHTS exp/checkpoints/best
+"""
+
+import distribuuuu_tpu.trainer as trainer
+from distribuuuu_tpu.config import cfg, load_cfg_fom_args
+
+
+def main():
+    load_cfg_fom_args("Test a classification model.")
+    cfg.freeze()
+    trainer.test_model()
+
+
+if __name__ == "__main__":
+    main()

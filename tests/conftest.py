@@ -11,10 +11,16 @@ Must set env vars BEFORE jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# NOTE: this environment's sitecustomize pre-imports jax and pins the platform
+# list programmatically, so the JAX_PLATFORMS env var alone is NOT honored —
+# the config must be updated before first backend use.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

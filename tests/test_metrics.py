@@ -1,0 +1,56 @@
+"""Metrics: top-k counts vs a numpy oracle, CE loss, meters."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distribuuuu_tpu.metrics import (
+    AverageMeter,
+    ProgressMeter,
+    cross_entropy_loss,
+    topk_correct,
+)
+
+
+def test_topk_correct_against_numpy():
+    rng = np.random.default_rng(0)
+    logits = rng.standard_normal((64, 20)).astype(np.float32)
+    labels = rng.integers(0, 20, 64)
+    got = topk_correct(jnp.asarray(logits), jnp.asarray(labels), ks=(1, 5))
+    order = np.argsort(-logits, axis=1)
+    for k in (1, 5):
+        expected = sum(labels[i] in order[i, :k] for i in range(64))
+        assert float(got[k]) == expected
+
+
+def test_cross_entropy_matches_manual():
+    logits = jnp.array([[2.0, 0.0, -1.0], [0.5, 0.5, 0.5]])
+    labels = jnp.array([0, 2])
+    loss = cross_entropy_loss(logits, labels)
+    p = np.exp(np.asarray(logits))
+    p /= p.sum(1, keepdims=True)
+    expected = -(np.log(p[0, 0]) + np.log(p[1, 2])) / 2
+    assert float(loss) == pytest.approx(expected, rel=1e-6)
+
+
+def test_label_smoothing_shifts_loss():
+    logits = jnp.array([[5.0, 0.0, 0.0]])
+    labels = jnp.array([0])
+    plain = float(cross_entropy_loss(logits, labels))
+    smooth = float(cross_entropy_loss(logits, labels, label_smooth=0.1))
+    assert smooth > plain
+
+
+def test_average_meter_running_avg():
+    m = AverageMeter("Loss", ":.2f")
+    m.update(1.0, n=2)
+    m.update(4.0, n=2)
+    assert m.avg == pytest.approx(2.5)
+    assert "Loss" in str(m)
+
+
+def test_progress_meter_eta():
+    t = AverageMeter("Time", ":.3f")
+    t.update(2.0)
+    p = ProgressMeter(100, [t], prefix="Test: ")
+    assert "0:03:" in p.cal_eta(10)  # 90 batches * 2s = 180s

@@ -1,0 +1,75 @@
+"""ResNet family: param-count parity with the reference zoo + shape checks.
+
+Param counts via `jax.eval_shape` (no compilation — fast on the 1-core host).
+Expected values are the torchvision/reference model sizes (reference
+`README.md:208-217` publishes resnet18 11.690M / resnet50 25.557M; others are
+the standard torchvision counts the reference reproduces).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distribuuuu_tpu.models import build_model, list_models
+
+EXPECTED_PARAMS_M = {
+    "resnet18": 11.690,
+    "resnet34": 21.798,
+    "resnet50": 25.557,
+    "resnet101": 44.549,
+    "resnet152": 60.193,
+    "resnext50_32x4d": 25.029,
+    "resnext101_32x8d": 88.791,
+    "wide_resnet50_2": 68.883,
+    "wide_resnet101_2": 126.887,
+}
+
+
+def _param_count_m(model, im=224):
+    shapes = jax.eval_shape(
+        lambda k, x: model.init(k, x, train=False),
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, im, im, 3), jnp.float32),
+    )
+    return sum(x.size for x in jax.tree.leaves(shapes["params"])) / 1e6
+
+
+@pytest.mark.parametrize("arch", sorted(EXPECTED_PARAMS_M))
+def test_param_counts(arch):
+    model = build_model(arch, num_classes=1000)
+    assert _param_count_m(model) == pytest.approx(EXPECTED_PARAMS_M[arch], abs=5e-4)
+
+
+def test_registry_lists_and_rejects():
+    assert "resnet18" in list_models()
+    with pytest.raises(KeyError, match="Unknown MODEL.ARCH"):
+        build_model("resnet9000")
+
+
+def test_output_shape_and_dtype():
+    """Logits are float32 (head math in f32) regardless of bf16 trunk."""
+    model = build_model("resnet18", num_classes=10)
+    shapes = jax.eval_shape(
+        lambda k, x: model.init(k, x, train=False),
+        jax.random.PRNGKey(0),
+        jnp.zeros((4, 64, 64, 3), jnp.float32),
+    )
+    out = jax.eval_shape(
+        lambda v, x: model.apply(v, x, train=False),
+        shapes,
+        jnp.zeros((4, 64, 64, 3), jnp.float32),
+    )
+    assert out.shape == (4, 10)
+    assert out.dtype == jnp.float32
+
+
+def test_forward_runs_and_bn_stats_update():
+    """One real forward (tiny) with mutable batch_stats."""
+    model = build_model("resnet18", num_classes=4)
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits, mutated = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    assert logits.shape == (2, 4)
+    # running stats must have moved off their init values
+    mean_leaf = jax.tree.leaves(mutated["batch_stats"])[0]
+    assert float(jnp.sum(jnp.abs(mean_leaf))) > 0.0

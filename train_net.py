@@ -1,0 +1,21 @@
+"""Train a classification model (reference `/root/reference/train_net.py`).
+
+Usage (identical CLI):
+    python train_net.py --cfg config/resnet50.yaml [KEY VALUE ...]
+
+Single host drives all local TPU chips; on a pod, launch one process per host
+(Slurm or RANK/WORLD_SIZE/MASTER_ADDR env — see distribuuuu_tpu/runtime/dist.py).
+"""
+
+import distribuuuu_tpu.trainer as trainer
+from distribuuuu_tpu.config import cfg, load_cfg_fom_args
+
+
+def main():
+    load_cfg_fom_args("Train a classification model.")
+    cfg.freeze()
+    trainer.train_model()
+
+
+if __name__ == "__main__":
+    main()
