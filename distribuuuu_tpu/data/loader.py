@@ -39,6 +39,13 @@ from distribuuuu_tpu.data.dataset import DummyDataset, ImageFolder
 from distribuuuu_tpu.data.transforms import eval_transform, train_transform
 
 
+class _ProducerError:
+    """Carrier for an exception raised inside the producer thread."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
 class HostDataLoader:
     """Per-host loader over an ImageFolder shard."""
 
@@ -133,37 +140,42 @@ class HostDataLoader:
             (self.seed * 1_000_003 + self.epoch) * 7919 + self.process_index * 104_729
         ) & 0x7FFFFFFF
         try:
-            with ThreadPoolExecutor(self.workers) as pool:
-                for b in range(self.num_batches):
-                    if stop.is_set():
-                        return
-                    chunk = indices[b * self.host_batch : (b + 1) * self.host_batch]
-                    if self.train and len(chunk) < self.host_batch:
-                        break
-                    slot0 = b * self.host_batch
-                    results = list(
-                        pool.map(
-                            self._load_one,
-                            chunk,
-                            [base + slot0 + i for i in range(len(chunk))],
-                        )
-                    )
-                    images = np.stack([r[0] for r in results])
-                    labels = np.array([r[1] for r in results], dtype=np.int32)
-                    weights = np.array([r[2] for r in results], dtype=np.float32)
-                    if not self.train and len(chunk) < self.host_batch:
-                        # pad final eval batch to a static shape (weight 0)
-                        short = self.host_batch - len(chunk)
-                        images = np.concatenate([images, np.zeros((short, *images.shape[1:]), images.dtype)])
-                        labels = np.concatenate([labels, np.zeros((short,), labels.dtype)])
-                        weights = np.concatenate([weights, np.zeros((short,), weights.dtype)])
-                    if not self._qput(
-                        out_q, {"image": images, "label": labels, "weight": weights}, stop
-                    ):
-                        return
+            self._produce_batches(out_q, stop, indices, base)
+        except BaseException as exc:  # surface decode/IO errors in the consumer
+            self._qput(out_q, _ProducerError(exc), stop)
         finally:
             # end-marker: waits for queue space unless the consumer is gone
             self._qput(out_q, None, stop)
+
+    def _produce_batches(self, out_q, stop, indices, base) -> None:
+        with ThreadPoolExecutor(self.workers) as pool:
+            for b in range(self.num_batches):
+                if stop.is_set():
+                    return
+                chunk = indices[b * self.host_batch : (b + 1) * self.host_batch]
+                if self.train and len(chunk) < self.host_batch:
+                    break
+                slot0 = b * self.host_batch
+                results = list(
+                    pool.map(
+                        self._load_one,
+                        chunk,
+                        [base + slot0 + i for i in range(len(chunk))],
+                    )
+                )
+                images = np.stack([r[0] for r in results])
+                labels = np.array([r[1] for r in results], dtype=np.int32)
+                weights = np.array([r[2] for r in results], dtype=np.float32)
+                if not self.train and len(chunk) < self.host_batch:
+                    # pad final eval batch to a static shape (weight 0)
+                    short = self.host_batch - len(chunk)
+                    images = np.concatenate([images, np.zeros((short, *images.shape[1:]), images.dtype)])
+                    labels = np.concatenate([labels, np.zeros((short,), labels.dtype)])
+                    weights = np.concatenate([weights, np.zeros((short,), weights.dtype)])
+                if not self._qput(
+                    out_q, {"image": images, "label": labels, "weight": weights}, stop
+                ):
+                    return
 
     def __iter__(self) -> Iterator[dict]:
         out_q: queue.Queue = queue.Queue(maxsize=self.prefetch_batches)
@@ -175,6 +187,10 @@ class HostDataLoader:
                 batch = out_q.get()
                 if batch is None:
                     break
+                if isinstance(batch, _ProducerError):
+                    # fail the run like the reference's torch DataLoader would
+                    # (a silent short epoch would desync multi-host batch counts)
+                    raise RuntimeError("data loader worker failed") from batch.exc
                 yield batch
         finally:
             stop.set()

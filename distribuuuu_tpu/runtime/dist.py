@@ -48,6 +48,8 @@ class DistInfo:
 
 _DEFAULT_PORT = 29566  # same default as the reference (`utils.py:35`)
 
+_initialized = False  # idempotence guard: jax.distributed.initialize is once-only
+
 
 def _first_slurm_hostname(nodelist: str) -> str:
     """Resolve the first hostname of a Slurm nodelist.
@@ -94,12 +96,14 @@ def setup_distributed(port: int | None = None) -> DistInfo:
         addr = env.get("MASTER_ADDR", "127.0.0.1")
         coordinator = f"{addr}:{port or int(env.get('MASTER_PORT', _DEFAULT_PORT))}"
 
-    if num_processes > 1:
+    global _initialized
+    if num_processes > 1 and not _initialized:
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,
             process_id=process_id,
         )
+        _initialized = True
 
     return DistInfo(
         process_index=jax.process_index(),

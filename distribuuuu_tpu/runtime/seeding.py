@@ -66,6 +66,14 @@ def setup_seed(seed: int | None, process_index: int = 0):
     """
     if seed is None:
         seed = int.from_bytes(os.urandom(4), "little")
+        if jax.process_count() > 1:
+            # all hosts must agree on the root key (replicated init — the
+            # analog of DDP's rank-0 weight broadcast); adopt process 0's draw
+            from jax.experimental import multihost_utils
+
+            seed = int(
+                multihost_utils.broadcast_one_to_all(np.asarray(seed, np.uint32))
+            )
     host_seed = (seed + process_index) % (2**32)
     np.random.seed(host_seed)
     random.seed(host_seed)

@@ -202,7 +202,6 @@ def train_epoch(loader, mesh, train_step, state, epoch: int, rng, is_primary: bo
     batch_time, data_time, losses, top1, topk_m, progress = construct_meters(
         len(loader), prefix=f"Epoch[{epoch}] ", topk=topk
     )
-    progress.prefix = f"Epoch[{epoch}] "
 
     window: list = []
     t_end = time.time()

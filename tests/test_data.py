@@ -146,3 +146,15 @@ def test_consumer_abort_terminates_producer(image_root):
         time.sleep(0.05)
     # producer thread (and its pool) must exit within the deadline
     assert threading.active_count() <= before
+
+
+def test_producer_exception_propagates(image_root, monkeypatch):
+    """A corrupt image must fail the epoch loudly, not truncate it silently."""
+    l = _mk_loader(image_root, 0, 1, host_batch=2)
+    bad_path = l.dataset.samples[0][0]
+    open(bad_path, "wb").write(b"not a jpeg")
+    try:
+        with pytest.raises(RuntimeError, match="data loader worker failed"):
+            list(l)
+    finally:
+        Image.new("RGB", (40, 50)).save(bad_path)
