@@ -64,6 +64,10 @@ _C.TEST.SPLIT = "val"
 _C.TEST.BATCH_SIZE = 200
 _C.TEST.IM_SIZE = 256
 _C.TEST.PRINT_FREQ = 10
+# TPU addition: eval center-crop size. The reference hardcodes 224
+# (`utils.py:166`); exposed here so small-resolution smokes can align train
+# and eval shapes (position-embedding models require matching crops).
+_C.TEST.CROP_SIZE = 224
 
 _C.CUDNN = CN()
 _C.CUDNN.BENCHMARK = True

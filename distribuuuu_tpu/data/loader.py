@@ -246,12 +246,19 @@ def construct_train_loader():
 
 def construct_val_loader():
     """Val loader (reference `construct_val_loader`, `utils.py:155-184`)."""
+    if cfg.TEST.CROP_SIZE > cfg.TEST.IM_SIZE:
+        # resize_shorter makes the shorter side exactly IM_SIZE; a larger crop
+        # would silently zero-pad eval images and degrade reported accuracy
+        raise ValueError(
+            f"TEST.CROP_SIZE ({cfg.TEST.CROP_SIZE}) must be <= TEST.IM_SIZE "
+            f"({cfg.TEST.IM_SIZE})"
+        )
     proc, nproc, local_dev, global_dev = _topology()
     host_batch = cfg.TEST.BATCH_SIZE * local_dev
     if cfg.MODEL.DUMMY_INPUT:
         return DummyLoader(
             host_batch,
-            224,
+            cfg.TEST.CROP_SIZE,
             num_batches=1000 // max(1, cfg.TEST.BATCH_SIZE * global_dev),
         )
     dataset = ImageFolder(os.path.join(cfg.TEST.DATASET, cfg.TEST.SPLIT))
@@ -265,6 +272,7 @@ def construct_val_loader():
         workers=cfg.TRAIN.WORKERS,
         seed=cfg.RNG_SEED or 0,
         prefetch_batches=cfg.TRAIN.PREFETCH * 2,
+        crop_size=cfg.TEST.CROP_SIZE,
     )
 
 

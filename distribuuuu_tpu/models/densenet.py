@@ -1,0 +1,119 @@
+"""DenseNet-BC family — Flax/NHWC rebuild.
+
+Architecture parity with `/root/reference/distribuuuu/models/densenet.py`
+(torchvision DenseNet): stem 7×7/2 + maxpool, dense blocks of BN→ReLU→1×1
+(bn_size·k) →BN→ReLU→3×3 (k) layers with feature concatenation, transitions
+BN→ReLU→1×1 (half)→avgpool/2, final BN→ReLU→GAP→fc. Factories 121/161/169/201
+(`densenet.py:300-365`).
+
+The reference's ``memory_efficient`` flag (`torch.utils.checkpoint` at
+`densenet.py:81-108`) maps to `jax.checkpoint` on each dense layer
+(``remat=True``), trading recompute for HBM — the same trade on TPU.
+
+TPU notes: concatenation-heavy networks are bandwidth-bound; NHWC keeps the
+concat on the minor-most (lane) dimension where XLA handles it without
+relayout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distribuuuu_tpu.models.layers import batch_norm, classifier_head, conv, maybe_remat
+from distribuuuu_tpu.models.registry import register_model
+
+
+class DenseLayer(nn.Module):
+    """BN→ReLU→1×1 → BN→ReLU→3×3, returns k new features (`densenet.py:23-117`)."""
+
+    growth_rate: int
+    bn_size: int = 4
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        h = batch_norm(train=train, axis_name=self.bn_axis_name, name="norm1")(x)
+        h = nn.relu(h)
+        h = conv(self.bn_size * self.growth_rate, 1, dtype=self.dtype, name="conv1")(h)
+        h = batch_norm(train=train, axis_name=self.bn_axis_name, name="norm2")(h)
+        h = nn.relu(h)
+        return conv(self.growth_rate, 3, dtype=self.dtype, name="conv2")(h)
+
+
+class DenseNet(nn.Module):
+    """DenseNet-BC trunk (`densenet.py:169-263`)."""
+
+    growth_rate: int
+    block_config: Sequence[int]
+    num_init_features: int
+    num_classes: int = 1000
+    bn_size: int = 4
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: str | None = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        layer_cls = maybe_remat(DenseLayer, self.remat)
+        x = conv(self.num_init_features, 7, 2, padding=3, dtype=self.dtype, name="conv0")(x)
+        x = batch_norm(train=train, axis_name=self.bn_axis_name, name="norm0")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+
+        features = self.num_init_features
+        for bi, num_layers in enumerate(self.block_config):
+            for li in range(num_layers):
+                new = layer_cls(
+                    growth_rate=self.growth_rate,
+                    bn_size=self.bn_size,
+                    dtype=self.dtype,
+                    bn_axis_name=self.bn_axis_name,
+                    name=f"block{bi + 1}_layer{li + 1}",
+                )(x, train=train)
+                x = jnp.concatenate([x, new.astype(x.dtype)], axis=-1)
+                features += self.growth_rate
+            if bi != len(self.block_config) - 1:
+                x = batch_norm(
+                    train=train, axis_name=self.bn_axis_name, name=f"trans{bi + 1}_norm"
+                )(x)
+                x = nn.relu(x)
+                features //= 2
+                x = conv(features, 1, dtype=self.dtype, name=f"trans{bi + 1}_conv")(x)
+                x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+
+        x = batch_norm(train=train, axis_name=self.bn_axis_name, name="norm5")(x)
+        x = nn.relu(x)
+        return classifier_head(x, self.num_classes, name="classifier")
+
+
+def _densenet(growth_rate, block_config, num_init_features, **kw):
+    return DenseNet(
+        growth_rate=growth_rate,
+        block_config=block_config,
+        num_init_features=num_init_features,
+        **kw,
+    )
+
+
+@register_model("densenet121")
+def densenet121(**kw):
+    return _densenet(32, (6, 12, 24, 16), 64, **kw)
+
+
+@register_model("densenet161")
+def densenet161(**kw):
+    return _densenet(48, (6, 12, 36, 24), 96, **kw)
+
+
+@register_model("densenet169")
+def densenet169(**kw):
+    return _densenet(32, (6, 12, 32, 32), 64, **kw)
+
+
+@register_model("densenet201")
+def densenet201(**kw):
+    return _densenet(32, (6, 12, 48, 32), 64, **kw)

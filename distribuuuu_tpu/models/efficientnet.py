@@ -1,0 +1,147 @@
+"""EfficientNet-B0 — Flax/NHWC implementation.
+
+The reference obtains this arch from timm (`/root/reference/distribuuuu/trainer.py:124-128`;
+baseline row `README.md:212`, 5.289M params, trained with the reference recipe
+at WD 1e-5). Implemented first-class here from the published architecture
+(https://arxiv.org/abs/1905.11946, timm/torchvision-compatible):
+
+stem 3×3/2 (32) → MBConv stages
+  [e1 k3 s1 16 ×1] [e6 k3 s2 24 ×2] [e6 k5 s2 40 ×2] [e6 k3 s2 80 ×3]
+  [e6 k5 s1 112 ×3] [e6 k5 s2 192 ×4] [e6 k3 s1 320 ×1]
+→ head 1×1 (1280) → GAP → dropout 0.2 → fc, SiLU everywhere, SE ratio 0.25 of
+the block's *input* channels, BN eps 1e-3, stochastic depth 0.2 linearly
+scaled over blocks.
+
+TPU notes: depthwise convs are VPU-bound; keeping them bf16/NHWC lets XLA's
+TPU emitter vectorize them. SE pooling/gating fuses into the surrounding ops.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distribuuuu_tpu.models.layers import (
+    SqueezeExcite,
+    batch_norm,
+    conv,
+    linear_uniform,
+    maybe_remat,
+)
+from distribuuuu_tpu.models.registry import register_model
+
+# (expand_ratio, kernel, stride, out_channels, repeats)
+_B0_STAGES = [
+    (1, 3, 1, 16, 1),
+    (6, 3, 2, 24, 2),
+    (6, 5, 2, 40, 2),
+    (6, 3, 2, 80, 3),
+    (6, 5, 1, 112, 3),
+    (6, 5, 2, 192, 4),
+    (6, 3, 1, 320, 1),
+]
+
+
+def _bn(train: bool, axis_name: str | None, name: str) -> nn.BatchNorm:
+    # EfficientNet uses eps 1e-3 / torch momentum 0.01 (flax 0.99)
+    return batch_norm(train=train, axis_name=axis_name, name=name, momentum=0.99, epsilon=1e-3)
+
+
+class MBConv(nn.Module):
+    """Mobile inverted bottleneck with SE and stochastic depth."""
+
+    out_ch: int
+    expand_ratio: int
+    kernel: int
+    stride: int
+    se_ratio: float
+    drop_path: float
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        in_ch = x.shape[-1]
+        h = x
+        mid = in_ch * self.expand_ratio
+        if self.expand_ratio != 1:
+            h = conv(mid, 1, dtype=self.dtype, name="expand_conv")(h)
+            h = _bn(train, self.bn_axis_name, "expand_bn")(h)
+            h = nn.silu(h)
+        h = conv(mid, self.kernel, self.stride, groups=mid, dtype=self.dtype, name="dw_conv")(h)
+        h = _bn(train, self.bn_axis_name, "dw_bn")(h)
+        h = nn.silu(h)
+        if self.se_ratio > 0:
+            h = SqueezeExcite(
+                se_dim=max(1, int(in_ch * self.se_ratio)),
+                act=nn.silu,
+                dtype=self.dtype,
+                name="se",
+            )(h)
+        h = conv(self.out_ch, 1, dtype=self.dtype, name="project_conv")(h)
+        h = _bn(train, self.bn_axis_name, "project_bn")(h)
+        if self.stride == 1 and in_ch == self.out_ch:
+            if train and self.drop_path > 0.0:
+                # stochastic depth: per-sample binary mask, rescaled
+                keep = 1.0 - self.drop_path
+                rng = self.make_rng("dropout")
+                mask = jax.random.bernoulli(rng, keep, (h.shape[0], 1, 1, 1))
+                h = jnp.where(mask, h / keep, 0.0).astype(h.dtype)
+            h = h + x
+        return h
+
+
+class EfficientNet(nn.Module):
+    """EfficientNet trunk (B0 coefficients)."""
+
+    num_classes: int = 1000
+    dropout: float = 0.2
+    drop_path_rate: float = 0.2
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: str | None = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        block_cls = maybe_remat(MBConv, self.remat)
+        x = conv(32, 3, 2, dtype=self.dtype, name="stem_conv")(x)
+        x = _bn(train, self.bn_axis_name, "stem_bn")(x)
+        x = nn.silu(x)
+
+        total_blocks = sum(r for *_, r in _B0_STAGES)
+        bidx = 0
+        for si, (e, k, s, c, r) in enumerate(_B0_STAGES):
+            for i in range(r):
+                x = block_cls(
+                    out_ch=c,
+                    expand_ratio=e,
+                    kernel=k,
+                    stride=s if i == 0 else 1,
+                    se_ratio=0.25,
+                    drop_path=self.drop_path_rate * bidx / total_blocks,
+                    dtype=self.dtype,
+                    bn_axis_name=self.bn_axis_name,
+                    name=f"stage{si + 1}_block{i + 1}",
+                )(x, train=train)
+                bidx += 1
+
+        x = conv(1280, 1, dtype=self.dtype, name="head_conv")(x)
+        x = _bn(train, self.bn_axis_name, "head_bn")(x)
+        x = nn.silu(x)
+        x = jnp.mean(x, axis=(1, 2), dtype=jnp.float32)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(
+            self.num_classes,
+            dtype=jnp.float32,
+            param_dtype=jnp.float32,
+            kernel_init=linear_uniform,
+            name="classifier",
+        )(x)
+
+
+@register_model("efficientnet_b0")
+def efficientnet_b0(**kw):
+    return EfficientNet(**kw)

@@ -69,6 +69,7 @@ def batch_norm(
     zero_scale: bool = False,
     name: str | None = None,
     momentum: float = 0.9,
+    epsilon: float = 1e-5,
 ) -> nn.BatchNorm:
     """BatchNorm matching torch defaults (eps 1e-5, momentum 0.1 ⇒ flax 0.9).
 
@@ -82,7 +83,7 @@ def batch_norm(
     return nn.BatchNorm(
         use_running_average=not train,
         momentum=momentum,
-        epsilon=1e-5,
+        epsilon=epsilon,
         dtype=jnp.float32,
         param_dtype=jnp.float32,
         axis_name=axis_name,
@@ -102,6 +103,27 @@ def classifier_head(x: jnp.ndarray, num_classes: int, *, name: str = "fc") -> jn
         bias_init=nn.initializers.zeros,
         name=name,
     )(x)
+
+
+class SqueezeExcite(nn.Module):
+    """SE gate: GAP → 1×1 reduce → act → 1×1 expand → sigmoid·x.
+
+    Shared by EfficientNet (SiLU) and RegNetY (ReLU); the reduce dim is
+    computed by the caller (both families size it from the block's *input*
+    channels, not the gated tensor's).
+    """
+
+    se_dim: int
+    act: Callable = nn.relu
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        s = jnp.mean(x, axis=(1, 2), keepdims=True, dtype=jnp.float32).astype(x.dtype)
+        s = nn.Conv(self.se_dim, (1, 1), dtype=self.dtype, param_dtype=jnp.float32, name="reduce")(s)
+        s = self.act(s)
+        s = nn.Conv(x.shape[-1], (1, 1), dtype=self.dtype, param_dtype=jnp.float32, name="expand")(s)
+        return x * nn.sigmoid(s)
 
 
 def maybe_remat(module_cls, enabled: bool):

@@ -1,0 +1,142 @@
+"""RegNetX / RegNetY — Flax/NHWC implementation.
+
+The reference obtains these from timm (`/root/reference/distribuuuu/trainer.py:124-128`;
+baseline rows `README.md:215-217`: regnetx_160 54.279M, regnety_160 83.590M,
+regnety_320 145.047M params). Implemented first-class from the published
+design space (Designing Network Design Spaces, https://arxiv.org/abs/2003.13678):
+
+- widths from the quantized-linear rule: ``u_j = w0 + wa·j``, snapped to
+  powers of ``wm`` times w0 and rounded to multiples of 8, grouped into
+  stages of equal width; per-stage depth = run length.
+- X block: 1×1 → 3×3 group conv (group width g) → 1×1 (bottleneck ratio 1)
+  with BN+ReLU, projection shortcut on shape change.
+- Y block: X block + SE (ratio 0.25 of the block's *input* width) after the
+  group conv.
+- stem: 3×3/2, 32 channels; head: GAP → fc.
+
+Configs use timm naming: regnetx_160 == RegNetX-16GF etc.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from distribuuuu_tpu.models.layers import (
+    SqueezeExcite,
+    batch_norm,
+    classifier_head,
+    conv,
+    maybe_remat,
+)
+from distribuuuu_tpu.models.registry import register_model
+
+
+def generate_regnet_widths(wa: float, w0: int, wm: float, depth: int, q: int = 8):
+    """Per-stage (widths, depths) from the quantized linear parameterization."""
+    ws_cont = np.arange(depth) * wa + w0
+    ks = np.round(np.log(ws_cont / w0) / np.log(wm))
+    ws = w0 * np.power(wm, ks)
+    ws = (np.round(ws / q) * q).astype(int)
+    widths, depths = np.unique(ws, return_counts=True)
+    order = np.argsort(widths)
+    return widths[order].tolist(), depths[order].tolist()
+
+
+def adjust_widths_groups(widths: Sequence[int], group_w: int):
+    """Make each width divisible by its group width (bottleneck ratio 1)."""
+    gs = [min(group_w, w) for w in widths]
+    ws = [int(round(w / g) * g) for w, g in zip(widths, gs)]
+    return ws, gs
+
+
+class RegNetBlock(nn.Module):
+    """X/Y bottleneck block, ratio 1."""
+
+    width: int
+    stride: int
+    group_width: int
+    se_ratio: float  # 0 → X block
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        w_in = x.shape[-1]
+        groups = self.width // self.group_width
+        h = conv(self.width, 1, dtype=self.dtype, name="conv1")(x)
+        h = batch_norm(train=train, axis_name=self.bn_axis_name, name="bn1")(h)
+        h = nn.relu(h)
+        h = conv(self.width, 3, self.stride, groups=groups, dtype=self.dtype, name="conv2")(h)
+        h = batch_norm(train=train, axis_name=self.bn_axis_name, name="bn2")(h)
+        h = nn.relu(h)
+        if self.se_ratio > 0:
+            h = SqueezeExcite(
+                se_dim=max(1, int(round(w_in * self.se_ratio))), dtype=self.dtype, name="se"
+            )(h)
+        h = conv(self.width, 1, dtype=self.dtype, name="conv3")(h)
+        h = batch_norm(train=train, axis_name=self.bn_axis_name, name="bn3")(h)
+        if self.stride != 1 or w_in != self.width:
+            sc = conv(self.width, 1, self.stride, dtype=self.dtype, name="sc_conv")(x)
+            sc = batch_norm(train=train, axis_name=self.bn_axis_name, name="sc_bn")(sc)
+        else:
+            sc = x
+        return nn.relu(h + sc)
+
+
+class RegNet(nn.Module):
+    wa: float
+    w0: int
+    wm: float
+    depth: int
+    group_width: int
+    se_ratio: float = 0.0
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: str | None = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        block_cls = maybe_remat(RegNetBlock, self.remat)
+        widths, depths = generate_regnet_widths(self.wa, self.w0, self.wm, self.depth)
+        widths, groups = adjust_widths_groups(widths, self.group_width)
+
+        x = conv(32, 3, 2, dtype=self.dtype, name="stem_conv")(x)
+        x = batch_norm(train=train, axis_name=self.bn_axis_name, name="stem_bn")(x)
+        x = nn.relu(x)
+
+        for si, (w, d, g) in enumerate(zip(widths, depths, groups)):
+            for i in range(d):
+                x = block_cls(
+                    width=w,
+                    stride=2 if i == 0 else 1,
+                    group_width=g,
+                    se_ratio=self.se_ratio,
+                    dtype=self.dtype,
+                    bn_axis_name=self.bn_axis_name,
+                    name=f"stage{si + 1}_block{i + 1}",
+                )(x, train=train)
+
+        return classifier_head(x, self.num_classes, name="head_fc")
+
+
+@register_model("regnetx_160")
+def regnetx_160(**kw):
+    """RegNetX-16GF (timm naming)."""
+    return RegNet(wa=55.59, w0=216, wm=2.1, depth=22, group_width=128, **kw)
+
+
+@register_model("regnety_160")
+def regnety_160(**kw):
+    """RegNetY-16GF."""
+    return RegNet(wa=106.23, w0=200, wm=2.48, depth=18, group_width=112, se_ratio=0.25, **kw)
+
+
+@register_model("regnety_320")
+def regnety_320(**kw):
+    """RegNetY-32GF."""
+    return RegNet(wa=115.89, w0=232, wm=2.53, depth=20, group_width=232, se_ratio=0.25, **kw)

@@ -103,6 +103,54 @@ class Bottleneck(nn.Module):
         return nn.relu(out + identity)
 
 
+def resnet_stem(x, train, *, dtype, bn_axis_name):
+    """7×7/2 conv-BN-ReLU + 3×3/2 maxpool (reference `resnet.py:186-196`).
+
+    Plain function so composed trunks (BoTNet) share one definition; flax
+    binds the submodule names into the caller's scope.
+    """
+    x = conv(64, 7, 2, padding=3, dtype=dtype, name="conv1")(x)
+    x = batch_norm(train=train, axis_name=bn_axis_name, name="bn1")(x)
+    x = nn.relu(x)
+    return nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+
+
+def resnet_stages(
+    x,
+    train,
+    *,
+    block,
+    stage_sizes,
+    groups=1,
+    width_per_group=64,
+    zero_init_residual=False,
+    dtype,
+    bn_axis_name,
+    remat=False,
+):
+    """Residual stages with v1.5 stride placement (reference `resnet.py:230-276`)."""
+    block_cls = maybe_remat(block, remat)
+    in_features = 64
+    for stage, num_blocks in enumerate(stage_sizes):
+        planes = 64 * (2**stage)
+        for i in range(num_blocks):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            downsample = stride != 1 or in_features != planes * block.expansion
+            x = block_cls(
+                planes=planes,
+                stride=stride,
+                downsample=downsample,
+                groups=groups,
+                base_width=width_per_group,
+                zero_init_residual=zero_init_residual,
+                dtype=dtype,
+                bn_axis_name=bn_axis_name,
+                name=f"layer{stage + 1}_{i}",
+            )(x, train=train)
+            in_features = planes * block.expansion
+    return x
+
+
 class ResNet(nn.Module):
     """Trunk: 7×7/2 stem → maxpool → 4 stages → GAP → fc (reference
     `resnet.py:164-276`)."""
@@ -119,31 +167,19 @@ class ResNet(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
-        block_cls = maybe_remat(self.block, self.remat)
-        x = conv(64, 7, 2, padding=3, dtype=self.dtype, name="conv1")(x)
-        x = batch_norm(train=train, axis_name=self.bn_axis_name, name="bn1")(x)
-        x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
-
-        in_features = 64
-        for stage, num_blocks in enumerate(self.stage_sizes):
-            planes = 64 * (2**stage)
-            for i in range(num_blocks):
-                stride = 2 if (stage > 0 and i == 0) else 1
-                downsample = stride != 1 or in_features != planes * self.block.expansion
-                x = block_cls(
-                    planes=planes,
-                    stride=stride,
-                    downsample=downsample,
-                    groups=self.groups,
-                    base_width=self.width_per_group,
-                    zero_init_residual=self.zero_init_residual,
-                    dtype=self.dtype,
-                    bn_axis_name=self.bn_axis_name,
-                    name=f"layer{stage + 1}_{i}",
-                )(x, train=train)
-                in_features = planes * self.block.expansion
-
+        x = resnet_stem(x, train, dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        x = resnet_stages(
+            x,
+            train,
+            block=self.block,
+            stage_sizes=self.stage_sizes,
+            groups=self.groups,
+            width_per_group=self.width_per_group,
+            zero_init_residual=self.zero_init_residual,
+            dtype=self.dtype,
+            bn_axis_name=self.bn_axis_name,
+            remat=self.remat,
+        )
         return classifier_head(x, self.num_classes)
 
 

@@ -1,0 +1,118 @@
+"""Non-ResNet families: param-count parity + rel-pos attention numerics.
+
+Param counts are the published model sizes (reference `README.md:208-217`
+for the baseline-table archs; torchvision sizes for DenseNet).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distribuuuu_tpu.models import build_model
+from distribuuuu_tpu.models.botnet import RelPosEmb, rel_to_abs
+
+EXPECTED_PARAMS_M = {
+    "densenet121": 7.979,
+    "densenet161": 28.681,
+    "densenet169": 14.149,
+    "densenet201": 20.014,
+    "botnet50": 20.859,
+    "efficientnet_b0": 5.289,
+    "regnetx_160": 54.279,
+    "regnety_160": 83.590,
+    "regnety_320": 145.047,
+}
+
+
+def _param_count_m(model, im=224):
+    shapes = jax.eval_shape(
+        lambda k, x: model.init(k, x, train=False),
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, im, im, 3), jnp.float32),
+    )
+    return sum(x.size for x in jax.tree.leaves(shapes["params"])) / 1e6
+
+
+@pytest.mark.parametrize("arch", sorted(EXPECTED_PARAMS_M))
+def test_param_counts(arch):
+    model = build_model(arch, num_classes=1000)
+    assert _param_count_m(model) == pytest.approx(EXPECTED_PARAMS_M[arch], abs=5e-4)
+
+
+def test_rel_to_abs_against_gather():
+    """rel_to_abs pad/reshape trick == direct relative→absolute gather."""
+    rng = np.random.default_rng(0)
+    B, N, L = 2, 3, 5
+    x = rng.standard_normal((B, N, L, 2 * L - 1)).astype(np.float32)
+    got = np.asarray(rel_to_abs(jnp.asarray(x)))
+    expect = np.empty((B, N, L, L), np.float32)
+    for i in range(L):  # absolute key j ↔ relative index j - i + L - 1
+        for j in range(L):
+            expect[:, :, i, j] = x[:, :, i, j - i + L - 1]
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+def test_rel_pos_emb_against_bruteforce():
+    """Factorized 2-D rel-pos logits == per-pair brute force."""
+    H, W, D = 3, 4, 8
+    mod = RelPosEmb(height=H, width=W, dim_head=D)
+    rng = np.random.default_rng(1)
+    q = rng.standard_normal((2, 2, H * W, D)).astype(np.float32)
+    variables = mod.init(jax.random.PRNGKey(0), jnp.asarray(q))
+    got = np.asarray(mod.apply(variables, jnp.asarray(q)))
+    rel_h = np.asarray(variables["params"]["rel_height"])
+    rel_w = np.asarray(variables["params"]["rel_width"])
+
+    expect = np.zeros((2, 2, H * W, H * W), np.float32)
+    for qh in range(H):
+        for qw in range(W):
+            for kh in range(H):
+                for kw in range(W):
+                    qi, ki = qh * W + qw, kh * W + kw
+                    vec = rel_w[kw - qw + W - 1] + rel_h[kh - qh + H - 1]
+                    expect[:, :, qi, ki] = q[:, :, qi, :] @ vec
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_forward_shapes_eval_shape():
+    """Output shapes/dtypes for the new families (abstract, no compile)."""
+    for arch, im in [("botnet50", 64), ("efficientnet_b0", 64), ("regnety_160", 32), ("densenet121", 32)]:
+        model = build_model(arch, num_classes=7)
+        shapes = jax.eval_shape(
+            lambda k, x, m=model: m.init(k, x, train=False),
+            jax.random.PRNGKey(0),
+            jnp.zeros((2, im, im, 3), jnp.float32),
+        )
+        out = jax.eval_shape(
+            lambda v, x, m=model: m.apply(v, x, train=False),
+            shapes,
+            jnp.zeros((2, im, im, 3), jnp.float32),
+        )
+        assert out.shape == (2, 7), arch
+        assert out.dtype == jnp.float32, arch
+
+
+def test_efficientnet_dropout_needs_rng():
+    """Train-mode forward with stochastic depth consumes the dropout rng."""
+    model = build_model("efficientnet_b0", num_classes=4)
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out, _ = model.apply(
+        variables,
+        x,
+        train=True,
+        mutable=["batch_stats"],
+        rngs={"dropout": jax.random.PRNGKey(1)},
+    )
+    assert out.shape == (2, 4)
+
+
+def test_botnet_forward_real():
+    """One real botnet forward at tiny fmap: exercises the rel-pos einsums."""
+    model = build_model("botnet50", num_classes=4)
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 4)
+    assert bool(jnp.all(jnp.isfinite(out)))
