@@ -33,7 +33,9 @@ def main():
     from distribuuuu_tpu.trainer import create_train_state, make_train_step
 
     n_chips = jax.device_count()
-    per_chip_batch = 128
+    # 512/chip saturates the v5e MXU pipeline (measured 1044 img/s @128 →
+    # 1530 @512); the reference's own large-batch regime goes to 8192 global
+    per_chip_batch = 512
     global_batch = per_chip_batch * n_chips
 
     mesh = data_mesh(-1)

@@ -57,6 +57,11 @@ _C.TRAIN.TOPK = 5
 # TPU additions
 _C.TRAIN.PREFETCH = 2  # batches prefetched to device HBM ahead of compute
 _C.TRAIN.LABEL_SMOOTH = 0.0
+# jax.profiler trace of a few steady-state steps (epoch 0) → OUT_DIR/profile.
+# The reference has no profiler (SURVEY §5); this is the idiomatic upgrade.
+_C.TRAIN.PROFILE = False
+_C.TRAIN.PROFILE_START = 10  # first profiled step
+_C.TRAIN.PROFILE_STEPS = 5
 
 _C.TEST = CN()
 _C.TEST.DATASET = "./data/ILSVRC/"

@@ -1,0 +1,214 @@
+"""Torch checkpoint → Flax variables conversion.
+
+The reference loads torchvision-format pretrained weights
+(`/root/reference/distribuuuu/models/utils.py:1-4`, URLs `resnet.py:23-33`,
+DenseNet legacy-key remap `densenet.py:266-282`) and its own training
+checkpoints are torch ``state_dict``s (`utils.py:374-380`). This module maps
+those trees onto this framework's parameter layout so users migrating from
+the reference keep their weights:
+
+- conv ``[O, I, kh, kw]`` → HWIO kernels; BN weight/bias → scale/bias and
+  running_mean/var → batch_stats; fc weight transposed.
+- reference/torchvision ResNet naming (``layer1.0.conv1`` …) → our
+  ``layer1_0/conv1`` modules, incl. ``downsample.{0,1}`` → ``ds_conv/ds_bn``.
+- DenseNet ``features.denseblock{B}.denselayer{L}.*`` → ``block{B}_layer{L}``,
+  transitions and the pre-1.0 dotted legacy names (``norm.1`` …) the
+  reference also remaps.
+
+Checkpoints saved by the *reference trainer* wrap the model dict under
+``state_dict`` with a possible ``module.`` DDP prefix (`utils.py:360-363`) —
+both are stripped.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+
+def _to_np(t) -> np.ndarray:
+    try:
+        return t.detach().cpu().numpy()
+    except AttributeError:
+        return np.asarray(t)
+
+
+def _unwrap(state_dict: Mapping[str, Any]) -> Dict[str, np.ndarray]:
+    if "state_dict" in state_dict and isinstance(state_dict["state_dict"], Mapping):
+        state_dict = state_dict["state_dict"]
+    out = {}
+    for k, v in state_dict.items():
+        out[k.removeprefix("module.")] = _to_np(v)
+    return out
+
+
+def _conv_kernel(w: np.ndarray) -> np.ndarray:
+    """[O, I/g, kh, kw] → [kh, kw, I/g, O] (flax HWIO)."""
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+def _set(tree: dict, path: list[str], value: np.ndarray) -> None:
+    node = tree
+    for p in path[:-1]:
+        node = node.setdefault(p, {})
+    node[path[-1]] = value
+
+
+_DENSENET_LEGACY = re.compile(
+    r"^(.*denselayer\d+\.(?:norm|relu|conv))\.([12])\.(.*)$"
+)
+
+
+def _remap_densenet_legacy(key: str) -> str:
+    """`norm.1.weight` → `norm1.weight` (reference `densenet.py:266-282`)."""
+    m = _DENSENET_LEGACY.match(key)
+    if m:
+        return f"{m.group(1)}{m.group(2)}.{m.group(3)}"
+    return key
+
+
+def _module_path(torch_key: str, arch: str) -> tuple[list[str] | None, str]:
+    """Map a torch module path (sans param name) to our module path.
+
+    Returns (path-list, param-kind) where kind ∈ {conv, bn_affine, bn_stats,
+    linear_w, linear_b, skip}.
+    """
+    parts = torch_key.split(".")
+    name = parts[-1]
+    mod = parts[:-1]
+
+    if name in ("running_mean", "running_var"):
+        kind = "bn_stats"
+    elif name == "num_batches_tracked":
+        return None, "skip"
+    elif name in ("weight", "bias"):
+        kind = None  # decided by module type below
+    else:
+        return None, "skip"
+
+    if arch.startswith("densenet"):
+        mod = [p for p in mod if p != "features"]
+        mapped = []
+        for p in mod:
+            if p.startswith("denseblock"):
+                mapped.append(f"block{p.removeprefix('denseblock')}")
+            elif p.startswith("denselayer"):
+                mapped[-1] = mapped[-1] + f"_layer{p.removeprefix('denselayer')}"
+            elif p.startswith("transition"):
+                mapped.append(f"trans{p.removeprefix('transition')}")
+            else:
+                mapped.append(p)
+        # trans{B}.norm → trans{B}_norm; trans{B}.conv → trans{B}_conv
+        out = []
+        for p in mapped:
+            if out and out[-1].startswith("trans") and p in ("norm", "conv"):
+                out[-1] = out[-1] + "_" + p
+            else:
+                out.append(p)
+        mod = out
+    else:  # resnet family naming
+        mapped = []
+        i = 0
+        while i < len(mod):
+            p = mod[i]
+            if re.fullmatch(r"layer\d+", p) and i + 1 < len(mod):
+                mapped.append(f"{p}_{mod[i + 1]}")
+                i += 2
+            elif p == "downsample":
+                # downsample.0 → ds_conv, downsample.1 → ds_bn
+                sub = mod[i + 1]
+                mapped.append("ds_conv" if sub == "0" else "ds_bn")
+                i += 2
+            else:
+                mapped.append(p)
+                i += 1
+        mod = mapped
+
+    leaf = mod[-1] if mod else ""
+    is_bn = leaf.startswith(("bn", "norm")) or leaf.endswith(("bn", "norm")) or leaf in ("ds_bn",)
+    is_linear = leaf in ("fc", "classifier")
+    if kind is None:
+        if is_linear:
+            kind = "linear_w" if name == "weight" else "linear_b"
+        elif is_bn:
+            kind = "bn_affine"
+        else:
+            kind = "conv"
+    return mod, kind
+
+
+def convert_state_dict(state_dict: Mapping[str, Any], arch: str) -> dict:
+    """torch state_dict → ``{"params": ..., "batch_stats": ...}`` numpy trees."""
+    sd = _unwrap(state_dict)
+    params: dict = {}
+    batch_stats: dict = {}
+    for key, value in sd.items():
+        if arch.startswith("densenet"):
+            key = _remap_densenet_legacy(key)
+        mod, kind = _module_path(key, arch)
+        if kind == "skip":
+            continue
+        name = key.split(".")[-1]
+        if kind == "conv":
+            _set(params, mod + ["kernel"], _conv_kernel(value))
+        elif kind == "bn_affine":
+            _set(params, mod + ["scale" if name == "weight" else "bias"], value)
+        elif kind == "bn_stats":
+            _set(batch_stats, mod + ["mean" if name == "running_mean" else "var"], value)
+        elif kind == "linear_w":
+            _set(params, mod + ["kernel"], value.T)
+        elif kind == "linear_b":
+            _set(params, mod + ["bias"], value)
+    return {"params": params, "batch_stats": batch_stats}
+
+
+def load_torch_file(path: str) -> Mapping[str, Any]:
+    import torch
+
+    return torch.load(path, map_location="cpu", weights_only=False)
+
+
+def verify_against_model(converted: dict, arch: str, num_classes: int = 1000) -> None:
+    """Raise if the converted tree doesn't match the model's expected tree."""
+    import jax
+    import jax.numpy as jnp
+
+    from distribuuuu_tpu.models import build_model
+
+    model = build_model(arch, num_classes=num_classes)
+    expected = jax.eval_shape(
+        lambda k, x: model.init(k, x, train=False),
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 224, 224, 3), jnp.float32),
+    )
+
+    def compare(exp_tree, got_tree, which):
+        exp_flat = {"/".join(map(str, k)): v for k, v in _flatten(exp_tree)}
+        got_flat = {"/".join(map(str, k)): v for k, v in _flatten(got_tree)}
+        missing = exp_flat.keys() - got_flat.keys()
+        extra = got_flat.keys() - exp_flat.keys()
+        if missing or extra:
+            raise ValueError(
+                f"{which} mismatch for {arch}: missing={sorted(missing)[:5]} "
+                f"extra={sorted(extra)[:5]} (showing ≤5)"
+            )
+        for k, v in exp_flat.items():
+            if tuple(v.shape) != tuple(got_flat[k].shape):
+                raise ValueError(
+                    f"{which}/{k}: shape {got_flat[k].shape} != expected {v.shape}"
+                )
+
+    compare(expected["params"], converted["params"], "params")
+    compare(expected.get("batch_stats", {}), converted["batch_stats"], "batch_stats")
+
+
+def _flatten(tree, prefix=()):
+    out = []
+    if isinstance(tree, Mapping):
+        for k, v in tree.items():
+            out.extend(_flatten(v, prefix + (k,)))
+    else:
+        out.append((prefix, tree))
+    return out

@@ -24,6 +24,7 @@ from __future__ import annotations
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from distribuuuu_tpu.models.layers import batch_norm, classifier_head, conv, maybe_remat
@@ -108,9 +109,12 @@ class MHSA(nn.Module):
     dim_v: int = 128
     rel_pos_emb: bool = False
     dtype: Any = jnp.bfloat16
+    fuse: bool | None = None  # None = auto: Pallas kernel on TPU, XLA elsewhere
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        from distribuuuu_tpu.ops import fused_attention, xla_attention
+
         b, h, w, _ = x.shape
         heads, dqk, dv = self.heads, self.dim_qk, self.dim_v
         qk = conv(2 * heads * dqk, 1, dtype=self.dtype, name="to_qk")(x)
@@ -124,13 +128,23 @@ class MHSA(nn.Module):
         k = heads_first(k, dqk)
         v = heads_first(v, dv)
 
-        logits = jnp.einsum("bnxd,bnyd->bnxy", q, k)
         pos_cls = RelPosEmb if self.rel_pos_emb else AbsPosEmb
-        logits = logits + pos_cls(
+        bias = pos_cls(
             height=self.fmap_size[0], width=self.fmap_size[1], dim_head=dqk, name="pos_emb"
         )(q)
-        weights = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(v.dtype)
-        out = jnp.einsum("bnxy,bnyd->bnxd", weights, v)
+        fuse = self.fuse
+        if fuse is None:
+            # opt-in while the kernel soaks: auto-enables on TPU only when
+            # DTPU_FUSED_ATTN=1 (numerics are verified; flipping the default
+            # waits on on-chip soak time)
+            import os
+
+            fuse = (
+                jax.default_backend() == "tpu"
+                and os.environ.get("DTPU_FUSED_ATTN") == "1"
+            )
+        attn = fused_attention if fuse else xla_attention
+        out = attn(q, k, v, bias)
         return out.transpose(0, 2, 1, 3).reshape(b, h, w, heads * dv)
 
 

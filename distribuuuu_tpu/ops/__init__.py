@@ -1,0 +1,5 @@
+"""Custom TPU kernels (Pallas) with XLA fallbacks."""
+
+from distribuuuu_tpu.ops.attention import fused_attention, xla_attention
+
+__all__ = ["fused_attention", "xla_attention"]

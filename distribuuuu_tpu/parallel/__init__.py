@@ -1,0 +1,20 @@
+"""Parallelism primitives beyond plain data parallelism.
+
+The reference is DP-only (SURVEY §2b); this package holds the TPU-native
+building blocks that extend the same mesh design to other axes:
+
+- `collectives`: in-program reductions (the `scaled_all_reduce` analog) and
+  host-level barriers (the `dist.barrier()` analog).
+- `ring_attention`: sequence/context parallelism — exact blockwise attention
+  with k/v blocks rotating over the mesh's sequence axis via `ppermute`,
+  online-softmax accumulation (memory O(L_local²) instead of O(L²)).
+"""
+
+from distribuuuu_tpu.parallel.collectives import (
+    barrier,
+    pmean_tree,
+    scaled_all_reduce,
+)
+from distribuuuu_tpu.parallel.ring_attention import ring_attention
+
+__all__ = ["barrier", "pmean_tree", "scaled_all_reduce", "ring_attention"]

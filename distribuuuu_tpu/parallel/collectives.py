@@ -1,0 +1,46 @@
+"""Collective helpers.
+
+Replaces the reference's explicit NCCL usage (SURVEY §2c):
+
+- `scaled_all_reduce` (reference `utils.py:85-106`): there it is an async
+  NCCL allreduce on a list of metric tensors scaled by 1/world. Here the
+  same operation *inside* the compiled step is a `lax.pmean`; this helper
+  keeps the list-of-tensors signature for API familiarity. It must be called
+  under `shard_map`/`pmap` with the named axis in scope.
+- `barrier` (reference `dist.barrier()`, `tutorial/imagenet.py:159`): host
+  synchronization across processes via the JAX multihost utilities.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+
+def scaled_all_reduce(tensors: Sequence, axis_name: str = "data"):
+    """Average each tensor across the named mesh axis (in-program collective).
+
+    No-op when the axis has size 1, like the reference's world-size-1 gate.
+    """
+    if jax.lax.axis_size(axis_name) == 1:
+        return list(tensors)
+    return [jax.lax.pmean(t, axis_name) for t in tensors]
+
+
+def pmean_tree(tree, axis_name: str = "data"):
+    """pmean over a whole pytree (grads, batch stats)."""
+    return jax.lax.pmean(tree, axis_name)
+
+
+def barrier(name: str = "barrier") -> None:
+    """Block until every process reaches this point (host-level).
+
+    The analog of ``torch.distributed.barrier()`` — implemented as a tiny
+    all-reduce through the JAX coordination service. Single-process: no-op.
+    """
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

@@ -1,0 +1,109 @@
+"""Ring attention — exact sequence-parallel attention over a mesh axis.
+
+Long-context support the TPU-native way (the reference has no sequence
+parallelism — SURVEY §5 "long-context: absent" — but this framework treats it
+as first-class): queries/keys/values are sharded along the sequence dimension
+over a ``seq`` mesh axis; each device holds L/P tokens. K/V blocks rotate
+around the ring with `lax.ppermute` (neighbor exchanges ride the ICI torus)
+while each device accumulates its queries' attention with an online softmax
+(flash-attention-style running max/denominator), so the full L×L score matrix
+never materializes and per-device memory is O(L_local · L_local) per step.
+
+Compute/communication overlap is XLA's: the ppermute for step i+1 is
+independent of step i's matmuls, and the TPU latency-hiding scheduler
+overlaps them.
+
+Use inside `shard_map` over a mesh with a sequence axis, e.g.::
+
+    mesh = create_mesh({"data": -1, "seq": 4})
+    out = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="seq"),
+        mesh=mesh,
+        in_specs=P("data", None, "seq", None),
+        out_specs=P("data", None, "seq", None),
+    )(q, k, v)
+
+Causal masking uses global token positions (block offsets from the axis
+index), so the result equals single-device causal attention exactly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _block_attn(q, k, v, q_off, k_off, scale, causal):
+    """Scores/partials for one (q_block, k_block) pair in f32.
+
+    q: [B,H,Lq,D]; k,v: [B,H,Lk,D]. Returns (m, l, o) partials.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        lq, lk = q.shape[2], k.shape[2]
+        qpos = q_off + jnp.arange(lq)[:, None]
+        kpos = k_off + jnp.arange(lk)[None, :]
+        s = jnp.where(qpos >= kpos, s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)  # [B,H,Lq,1]
+    # fully-masked rows produce m = -inf; guard the exp
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return m_safe, l, o
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str = "seq",
+    causal: bool = False,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Exact attention with sequence sharded over ``axis_name``.
+
+    Args: q/k/v ``[B, H, L_local, D]`` (the local sequence shard, heads
+    replicated on this axis). Returns the local shard of the attention output
+    in q's dtype.
+    """
+    p = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    l_local = q.shape[2]
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+
+    perm = [(i, (i + 1) % p) for i in range(p)]  # ring: pass k/v to the right
+
+    def step(i, carry):
+        m, l, o, k_blk, v_blk = carry
+        # k/v block currently held arrived from device (my - i) mod p
+        src = (my - i) % p
+        k_off = src * l_local
+        bm, bl, bo = _block_attn(q, k_blk, v_blk, my * l_local, k_off, scale, causal)
+        # online softmax merge
+        m_new = jnp.maximum(m, bm)
+        c_old = jnp.exp(m - m_new)
+        c_new = jnp.exp(bm - m_new)
+        l = l * c_old + bl * c_new
+        o = o * c_old + bo * c_new
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return m_new, l, o, k_blk, v_blk
+
+    b, h, _, d = q.shape
+    init = (
+        jnp.full((b, h, l_local, 1), -jnp.inf, jnp.float32),
+        jnp.zeros((b, h, l_local, 1), jnp.float32),
+        jnp.zeros((b, h, l_local, d), jnp.float32),
+        k,
+        v,
+    )
+    m, l, o, _, _ = jax.lax.fori_loop(0, p, step, init)
+    # rows with zero mass (fully masked) → 0 output
+    out = jnp.where(l > 0, o / jnp.maximum(l, 1e-37), 0.0)
+    return out.astype(q.dtype)

@@ -203,6 +203,8 @@ def train_epoch(loader, mesh, train_step, state, epoch: int, rng, is_primary: bo
         len(loader), prefix=f"Epoch[{epoch}] ", topk=topk
     )
 
+    profile = cfg.TRAIN.PROFILE and epoch == 0 and is_primary
+    trace_active = False
     window: list = []
     t_end = time.time()
     t_window = t_end
@@ -210,15 +212,24 @@ def train_epoch(loader, mesh, train_step, state, epoch: int, rng, is_primary: bo
         prefetch_to_device(loader, mesh, cfg.TRAIN.PREFETCH)
     ):
         data_time.update(time.time() - t_end)
+        if profile and not trace_active and it == cfg.TRAIN.PROFILE_START:
+            jax.profiler.start_trace(f"{cfg.OUT_DIR}/profile")
+            trace_active = True
+        if trace_active and it >= cfg.TRAIN.PROFILE_START + cfg.TRAIN.PROFILE_STEPS:
+            jax.device_get(window[-1])  # close out the traced steps first
+            jax.profiler.stop_trace()
+            logger.info(f"Wrote profiler trace to {cfg.OUT_DIR}/profile")
+            trace_active = False
         step_rng = jax.random.fold_in(rng, epoch * 100_000 + it)
         state, m = train_step(state, batch, lr_arr, step_rng)
         window.append(m)
         if it % cfg.TRAIN.PRINT_FREQ == 0 or it == len(loader) - 1:
-            jax.block_until_ready(m)
+            # device_get is the sync point (block_until_ready is unreliable on
+            # some transports); fetch BEFORE timestamping the window
+            vals = jax.device_get(window)
             now = time.time()
             batch_time.update((now - t_window) / len(window), n=len(window))
             t_window = now
-            vals = jax.device_get(window)
             n = sum(v["n"] for v in vals)
             losses.update(float(sum(v["loss_sum"] for v in vals) / n), n=int(n))
             top1.update(float(100.0 * sum(v["correct1"] for v in vals) / n), n=int(n))
@@ -229,6 +240,9 @@ def train_epoch(loader, mesh, train_step, state, epoch: int, rng, is_primary: bo
             if is_primary:
                 progress.display(it)
         t_end = time.time()
+    if trace_active:  # epoch shorter than PROFILE_START+STEPS
+        jax.profiler.stop_trace()
+        logger.info(f"Wrote profiler trace to {cfg.OUT_DIR}/profile (short epoch)")
     return state
 
 
@@ -245,8 +259,7 @@ def validate(loader, mesh, eval_step, state, is_primary: bool, print_freq=None, 
         m = eval_step(state, batch)
         totals = m if totals is None else jax.tree.map(jnp.add, totals, m)
         if it % print_freq == 0 or it == len(loader) - 1:
-            jax.block_until_ready(m)
-            vals = jax.device_get(totals)
+            vals = jax.device_get(totals)  # sync point
             n = max(vals["n"], 1.0)
             losses.avg = float(vals["loss_sum"] / n)
             losses.val = losses.avg
@@ -286,6 +299,14 @@ def train_model():
         f"global batch={cfg.TRAIN.BATCH_SIZE * info.global_device_count}"
     )
 
+    if cfg.MODEL.ARCH == "botnet50" and cfg.TRAIN.IM_SIZE != cfg.TEST.CROP_SIZE:
+        # BoTNet's position-embedding tables are sized by the training crop;
+        # fail here rather than after a full epoch at the first validate()
+        raise ValueError(
+            f"botnet50 requires TRAIN.IM_SIZE == TEST.CROP_SIZE "
+            f"(got {cfg.TRAIN.IM_SIZE} vs {cfg.TEST.CROP_SIZE}): the relative "
+            f"position tables are sized by the training crop"
+        )
     model = _build_cfg_model()
     init_key, dropout_key = jax.random.split(key)
     # init_key is host-identical (replicated params); the dropout stream is

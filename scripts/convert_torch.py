@@ -1,0 +1,49 @@
+"""Convert a torch checkpoint (torchvision-format or reference trainer
+checkpoint) to an Orbax weights directory loadable via ``MODEL.WEIGHTS``.
+
+Usage:
+    python scripts/convert_torch.py --arch resnet50 --src resnet50.pth --dst ./converted_resnet50
+    python test_net.py --cfg config/resnet50.yaml MODEL.WEIGHTS ./converted_resnet50
+"""
+
+import argparse
+import os
+import sys
+
+# runnable from any cwd: the package lives at the repo root (scripts/..)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# conversion is pure host work — never touch (or wait on) an accelerator
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--arch", required=True)
+    ap.add_argument("--src", required=True, help="torch .pth/.pth.tar file")
+    ap.add_argument("--dst", required=True, help="output Orbax checkpoint dir")
+    ap.add_argument("--num-classes", type=int, default=1000)
+    args = ap.parse_args()
+
+    import orbax.checkpoint as ocp
+
+    from distribuuuu_tpu.convert import (
+        convert_state_dict,
+        load_torch_file,
+        verify_against_model,
+    )
+
+    sd = load_torch_file(args.src)
+    converted = convert_state_dict(sd, args.arch)
+    verify_against_model(converted, args.arch, args.num_classes)
+    ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+    import os
+
+    ckptr.save(os.path.abspath(args.dst), converted, force=True)
+    print(f"converted {args.src} ({args.arch}) -> {args.dst}")
+
+
+if __name__ == "__main__":
+    main()

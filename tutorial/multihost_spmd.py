@@ -1,0 +1,66 @@
+"""Rung 3 — multiple hosts: one process per host, a mesh across all of them.
+
+Torch analog: `tutorial/mnmc_ddp_launch.py` (torch.distributed.launch).
+Differences that matter:
+
+- torch runs one process per *GPU*; JAX runs one per *host* — each process
+  drives all of its local chips.
+- there is no NCCL process group object; `jax.distributed.initialize()`
+  connects the hosts' coordination service, after which `jax.devices()`
+  returns the GLOBAL device list and a mesh over it compiles collectives
+  over ICI/DCN automatically.
+
+Launch (2 hosts):
+  host0:  MASTER_ADDR=host0 RANK=0 WORLD_SIZE=2 python multihost_spmd.py
+  host1:  MASTER_ADDR=host0 RANK=1 WORLD_SIZE=2 python multihost_spmd.py
+(the same RANK/WORLD_SIZE/MASTER_ADDR vocabulary the torch launcher sets)
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from single_device import init_params, loss_fn, synthetic_batch
+
+if __name__ == "__main__":
+    if "RANK" in os.environ:
+        jax.distributed.initialize(
+            coordinator_address=f"{os.environ.get('MASTER_ADDR', '127.0.0.1')}:"
+            f"{os.environ.get('MASTER_PORT', '29566')}",
+            num_processes=int(os.environ["WORLD_SIZE"]),
+            process_id=int(os.environ["RANK"]),
+        )
+    rank, world = jax.process_index(), jax.process_count()
+    print(f"[host {rank}/{world}] local {jax.local_device_count()} "
+          f"global {jax.device_count()} devices")
+
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+
+    def step(params, batch, lr):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        grads = jax.lax.pmean(grads, "data")
+        loss = jax.lax.pmean(loss, "data")
+        return jax.tree.map(lambda p, g: p - lr * g, params, grads), loss
+
+    train_step = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P("data"), P()), out_specs=(P(), P()), check_vma=False,
+    ))
+
+    params = init_params(jax.random.PRNGKey(0))  # same key everywhere → replicated init
+    host_batch = synthetic_batch(seed=rank)      # each host loads ITS shard
+    sharding = NamedSharding(mesh, P("data"))
+    batch = {
+        # assemble a GLOBAL array from per-host shards — the DistributedSampler analog
+        k: jax.make_array_from_process_local_data(sharding, v)
+        for k, v in host_batch.items()
+    }
+    for i in range(30):
+        params, loss = train_step(params, batch, jnp.float32(0.05))
+        if i % 10 == 0 and rank == 0:
+            print(f"step {i:3d}  loss {float(loss):.4f}")
+    if rank == 0:
+        print("all hosts ran the SAME program; the mesh spanned them")

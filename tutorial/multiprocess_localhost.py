@@ -1,0 +1,82 @@
+"""Rung 4 — fake a pod on one machine: spawn N processes, CPU devices.
+
+Torch analog: `tutorial/mnmc_ddp_mp.py` (torch.multiprocessing.spawn) and the
+reference README's "multi-node on localhost" recipe (`README.md:119-144`,
+two launchers with disjoint CUDA_VISIBLE_DEVICES). The JAX version spawns
+subprocesses that each claim some CPU devices and rendezvous through a local
+coordinator — real multi-process collectives, no accelerators needed.
+
+Run:  python multiprocess_localhost.py            (spawns 2 workers)
+      NPROC=4 python multiprocess_localhost.py
+"""
+
+import os
+import subprocess
+import sys
+
+if __name__ == "__main__" and "RANK" not in os.environ:
+    # parent: spawn one worker per fake "host"
+    nproc = int(os.environ.get("NPROC", "2"))
+    procs = []
+    for rank in range(nproc):
+        env = dict(
+            os.environ,
+            RANK=str(rank),
+            WORLD_SIZE=str(nproc),
+            MASTER_ADDR="127.0.0.1",
+            MASTER_PORT="29571",
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS=os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=4",
+        )
+        procs.append(subprocess.Popen([sys.executable, __file__], env=env))
+    rc = max(p.wait() for p in procs)
+    sys.exit(rc)
+
+# ---- worker (RANK set) ----------------------------------------------------
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+from single_device import init_params, loss_fn, synthetic_batch  # noqa: E402
+
+jax.distributed.initialize(
+    coordinator_address=f"{os.environ['MASTER_ADDR']}:{os.environ['MASTER_PORT']}",
+    num_processes=int(os.environ["WORLD_SIZE"]),
+    process_id=int(os.environ["RANK"]),
+)
+rank = jax.process_index()
+print(f"[worker {rank}] sees {jax.local_device_count()} local / "
+      f"{jax.device_count()} global devices", flush=True)
+
+mesh = Mesh(np.asarray(jax.devices()), ("data",))
+
+
+def step(params, batch, lr):
+    loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+    grads = jax.lax.pmean(grads, "data")
+    loss = jax.lax.pmean(loss, "data")
+    return jax.tree.map(lambda p, g: p - lr * g, params, grads), loss
+
+
+train_step = jax.jit(jax.shard_map(
+    step, mesh=mesh,
+    in_specs=(P(), P("data"), P()), out_specs=(P(), P()), check_vma=False,
+))
+params = init_params(jax.random.PRNGKey(0))
+sharding = NamedSharding(mesh, P("data"))
+local = synthetic_batch(seed=rank)
+n_local = local["image"].shape[0] // jax.process_count()
+batch = {
+    k: jax.make_array_from_process_local_data(sharding, np.asarray(v)[:n_local])
+    for k, v in local.items()
+}
+for i in range(20):
+    params, loss = train_step(params, batch, jnp.float32(0.05))
+    if i % 5 == 0 and rank == 0:
+        print(f"step {i:3d}  loss {float(loss):.4f}", flush=True)
+if rank == 0:
+    print("a pod on your laptop: same code as rung 3", flush=True)

@@ -1,0 +1,56 @@
+"""Rung 2 — single host, all local devices: Mesh + shard_map.
+
+Torch analog: `tutorial/snmc_dp.py` (DataParallel). The torch version
+scatter/gathers through one master GPU; SPMD has no master — every device
+runs the same compiled program on its shard of the batch, and the gradient
+average is a `psum` compiled *into* that program, riding the ICI links.
+
+Note what did NOT change from rung 1: `forward`, `loss_fn`, the update rule.
+Only the batch is sharded and one `pmean` appears.
+
+Run:  python single_host_spmd.py            (all local TPU chips)
+      python ../scripts/cpu_mesh_run.py single_host_spmd.py   (fake 8 chips)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from single_device import BATCH, init_params, loss_fn, synthetic_batch
+
+if __name__ == "__main__":
+    devices = np.asarray(jax.devices())
+    mesh = Mesh(devices, ("data",))
+    print(f"mesh: {len(devices)} devices on axis 'data'")
+
+    def step(params, batch, lr):
+        # per-device view: batch is the LOCAL shard here
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        grads = jax.lax.pmean(grads, "data")   # ← the whole of DDP, one line
+        loss = jax.lax.pmean(loss, "data")
+        params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return params, loss
+
+    train_step = jax.jit(
+        jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P(), P("data"), P()),   # params replicated, batch sharded
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+
+    params = init_params(jax.random.PRNGKey(0))
+    batch = synthetic_batch(0)
+    # place the global batch sharded over devices (host → HBM shards)
+    batch = {
+        "image": jax.device_put(batch["image"], NamedSharding(mesh, P("data"))),
+        "label": jax.device_put(batch["label"], NamedSharding(mesh, P("data"))),
+    }
+    for step_i in range(60):
+        params, loss = train_step(params, batch, jnp.float32(0.05))
+        if step_i % 10 == 0:
+            print(f"step {step_i:3d}  loss {float(loss):.4f}  (global batch {BATCH})")
+    print("same trajectory as rung 1 — SPMD changed the where, not the what")

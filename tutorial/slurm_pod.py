@@ -1,0 +1,70 @@
+"""Rung 5 — Slurm: the cluster sets the env, the code stays the same.
+
+Torch analog: `tutorial/mnmc_ddp_slurm.py`. The reference translates Slurm
+variables into MASTER_ADDR/RANK itself (`distribuuuu/utils.py:26-40`); this
+script does the same translation for the JAX coordinator. One task per HOST
+(not per chip):
+
+  srun -N 4 --ntasks-per-node=1 python slurm_pod.py
+
+The body after initialize() is byte-identical to rung 3 — which is the
+lesson: launchers differ, the SPMD program does not.
+"""
+
+import os
+import re
+import subprocess
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from single_device import init_params, loss_fn, synthetic_batch
+
+
+def slurm_coordinator(port=29566):
+    nodelist = os.environ["SLURM_NODELIST"]
+    try:
+        first = subprocess.run(
+            ["scontrol", "show", "hostname", nodelist],
+            capture_output=True, text=True, check=True,
+        ).stdout.splitlines()[0].strip()
+    except Exception:
+        m = re.match(r"([^\[,]+)(?:\[(\d+)", nodelist)
+        first = m.group(1) + (m.group(2) or "")
+    return f"{first}:{port}"
+
+
+if __name__ == "__main__":
+    if "SLURM_JOB_ID" in os.environ:
+        jax.distributed.initialize(
+            coordinator_address=slurm_coordinator(),
+            num_processes=int(os.environ["SLURM_NTASKS"]),
+            process_id=int(os.environ["SLURM_PROCID"]),
+        )
+    rank = jax.process_index()
+    print(f"[task {rank}] {jax.local_device_count()} local / {jax.device_count()} global")
+
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+
+    def step(params, batch, lr):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        grads = jax.lax.pmean(grads, "data")
+        loss = jax.lax.pmean(loss, "data")
+        return jax.tree.map(lambda p, g: p - lr * g, params, grads), loss
+
+    train_step = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P("data"), P()), out_specs=(P(), P()), check_vma=False,
+    ))
+    params = init_params(jax.random.PRNGKey(0))
+    sharding = NamedSharding(mesh, P("data"))
+    batch = {
+        k: jax.make_array_from_process_local_data(sharding, np.asarray(v))
+        for k, v in synthetic_batch(seed=rank).items()
+    }
+    for i in range(20):
+        params, loss = train_step(params, batch, jnp.float32(0.05))
+        if i % 5 == 0 and rank == 0:
+            print(f"step {i:3d}  loss {float(loss):.4f}")
