@@ -1,0 +1,24 @@
+#!/bin/bash
+# Dev gate (the reference's `.dev/pre-commit.sh` analog): format/lint + fast
+# tests. black/isort/flake8 are used when installed; the syntax gate and the
+# unit tests always run, so the hook is useful on minimal machines too.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+fail=0
+
+if command -v black >/dev/null 2>&1; then
+  black --check distribuuuu_tpu tests tutorial scripts *.py || fail=1
+fi
+if command -v isort >/dev/null 2>&1; then
+  isort --check-only distribuuuu_tpu tests tutorial scripts *.py || fail=1
+fi
+if command -v flake8 >/dev/null 2>&1; then
+  flake8 distribuuuu_tpu tests || fail=1
+fi
+
+python -m compileall -q distribuuuu_tpu tests tutorial scripts *.py || fail=1
+
+python -m pytest tests/ -x -q || fail=1
+
+exit $fail

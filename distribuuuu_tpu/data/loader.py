@@ -35,6 +35,7 @@ import numpy as np
 from PIL import Image
 
 from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.data import native
 from distribuuuu_tpu.data.dataset import DummyDataset, ImageFolder
 from distribuuuu_tpu.data.transforms import eval_transform, train_transform
 
@@ -73,6 +74,7 @@ class HostDataLoader:
         self.seed = seed
         self.prefetch_batches = prefetch_batches
         self.crop_size = crop_size  # eval center-crop (reference hardcodes 224, `utils.py:166`)
+        self.use_native = native.available()
         self.epoch = 0
 
         total = len(dataset)
@@ -113,6 +115,15 @@ class HostDataLoader:
             size = self.im_size if self.train else self.crop_size
             return np.zeros((size, size, 3), dtype=np.float32), 0, 0.0
         path, label = self.dataset.samples[idx]
+        if self.use_native and path.lower().endswith((".jpg", ".jpeg")):
+            # C++ decode+transform, GIL-free (native/dtpu_decode.cc); falls
+            # through to PIL on decode failure (e.g. odd colorspace)
+            if self.train:
+                arr = native.decode_train(path, self.im_size, slot_seed)
+            else:
+                arr = native.decode_eval(path, self.im_size, self.crop_size)
+            if arr is not None:
+                return arr, label, 1.0
         with Image.open(path) as im:
             im = im.convert("RGB")
             if self.train:

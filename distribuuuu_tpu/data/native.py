@@ -1,0 +1,68 @@
+"""ctypes bindings for the native decode library (native/dtpu_decode.cc).
+
+The native path does JPEG decode + resample (PIL-compatible triangle filter)
++ crop/flip/normalize in one C++ pass with the GIL released — the framework's
+answer to SURVEY §7's input-throughput hard part (the reference leans on
+torch's C++ DataLoader machinery for the same reason). Falls back to the
+PIL/numpy transforms transparently when the library isn't built.
+
+Build once per machine: ``scripts/build_native.sh``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "build",
+    "libdtpu_decode.so",
+)
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None and os.path.exists(_LIB_PATH):
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.dtpu_decode_eval.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.dtpu_decode_eval.restype = ctypes.c_int
+        lib.dtpu_decode_train.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.dtpu_decode_train.restype = ctypes.c_int
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def decode_eval(path: str, resize: int, crop: int) -> np.ndarray | None:
+    """Native eval transform; None on decode failure (caller falls back)."""
+    lib = _load()
+    out = np.empty((crop, crop, 3), np.float32)
+    rc = lib.dtpu_decode_eval(
+        path.encode(), resize, crop, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    )
+    return out if rc == 0 else None
+
+
+def decode_train(path: str, size: int, seed: int) -> np.ndarray | None:
+    """Native train transform (seeded crop/flip); None on decode failure."""
+    lib = _load()
+    out = np.empty((size, size, 3), np.float32)
+    rc = lib.dtpu_decode_train(
+        path.encode(), size, ctypes.c_uint64(seed), out.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    )
+    return out if rc == 0 else None

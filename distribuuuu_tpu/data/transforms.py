@@ -68,12 +68,17 @@ def random_resized_crop(
 
 
 def resize_shorter(img: Image.Image, size: int) -> Image.Image:
-    """torchvision ``Resize(int)``: scale shorter side to ``size``."""
+    """torchvision ``Resize(int)``: scale shorter side to ``size``.
+
+    The long side uses truncation (``int(size*long/short)``), matching
+    torchvision's ``_compute_resized_output_size`` exactly — rounding modes
+    shift the crop window by a pixel at .5 ratios.
+    """
     width, height = img.size
     if width <= height:
-        new_w, new_h = size, max(1, int(round(size * height / width)))
+        new_w, new_h = size, max(1, int(size * height / width))
     else:
-        new_w, new_h = max(1, int(round(size * width / height))), size
+        new_w, new_h = max(1, int(size * width / height)), size
     return img.resize((new_w, new_h), Image.BILINEAR)
 
 

@@ -79,31 +79,36 @@ def ring_attention(
 
     perm = [(i, (i + 1) % p) for i in range(p)]  # ring: pass k/v to the right
 
-    def step(i, carry):
-        m, l, o, k_blk, v_blk = carry
-        # k/v block currently held arrived from device (my - i) mod p
-        src = (my - i) % p
-        k_off = src * l_local
-        bm, bl, bo = _block_attn(q, k_blk, v_blk, my * l_local, k_off, scale, causal)
-        # online softmax merge
+    def merge(carry, bm, bl, bo):
+        m, l, o = carry
         m_new = jnp.maximum(m, bm)
         c_old = jnp.exp(m - m_new)
         c_new = jnp.exp(bm - m_new)
-        l = l * c_old + bl * c_new
-        o = o * c_old + bo * c_new
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        return m_new, l, o, k_blk, v_blk
+        return m_new, l * c_old + bl * c_new, o * c_old + bo * c_new
 
     b, h, _, d = q.shape
     init = (
         jnp.full((b, h, l_local, 1), -jnp.inf, jnp.float32),
         jnp.zeros((b, h, l_local, 1), jnp.float32),
         jnp.zeros((b, h, l_local, d), jnp.float32),
-        k,
-        v,
     )
-    m, l, o, _, _ = jax.lax.fori_loop(0, p, step, init)
+    # local block first, then p-1 permute+consume rounds (no wasted final hop)
+    acc = merge(
+        init, *_block_attn(q, k, v, my * l_local, my * l_local, scale, causal)
+    )
+
+    def step(i, carry):
+        m, l, o, k_blk, v_blk = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        src = (my - i) % p  # after i hops, the block originated i to the left
+        bm, bl, bo = _block_attn(
+            q, k_blk, v_blk, my * l_local, src * l_local, scale, causal
+        )
+        m, l, o = merge((m, l, o), bm, bl, bo)
+        return m, l, o, k_blk, v_blk
+
+    m, l, o, _, _ = jax.lax.fori_loop(1, p, step, (*acc, k, v))
     # rows with zero mass (fully masked) → 0 output
     out = jnp.where(l > 0, o / jnp.maximum(l, 1e-37), 0.0)
     return out.astype(q.dtype)

@@ -216,7 +216,8 @@ def train_epoch(loader, mesh, train_step, state, epoch: int, rng, is_primary: bo
             jax.profiler.start_trace(f"{cfg.OUT_DIR}/profile")
             trace_active = True
         if trace_active and it >= cfg.TRAIN.PROFILE_START + cfg.TRAIN.PROFILE_STEPS:
-            jax.device_get(window[-1])  # close out the traced steps first
+            if window:  # un-fetched steps remain (a PRINT_FREQ fetch clears it)
+                jax.device_get(window[-1])
             jax.profiler.stop_trace()
             logger.info(f"Wrote profiler trace to {cfg.OUT_DIR}/profile")
             trace_active = False

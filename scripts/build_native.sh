@@ -1,0 +1,8 @@
+#!/bin/bash
+# Build the native decode library (libjpeg-based, no Python deps).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p native/build
+g++ -O3 -march=native -fPIC -shared -o native/build/libdtpu_decode.so \
+    native/dtpu_decode.cc -ljpeg
+echo "built native/build/libdtpu_decode.so"

@@ -39,8 +39,6 @@ def main():
     converted = convert_state_dict(sd, args.arch)
     verify_against_model(converted, args.arch, args.num_classes)
     ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
-    import os
-
     ckptr.save(os.path.abspath(args.dst), converted, force=True)
     print(f"converted {args.src} ({args.arch}) -> {args.dst}")
 

@@ -1,0 +1,60 @@
+"""Native C++ decode/transform parity with the PIL reference path."""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from distribuuuu_tpu.data import native
+from distribuuuu_tpu.data.transforms import eval_transform
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library not built (scripts/build_native.sh)"
+)
+
+
+@pytest.fixture(scope="module")
+def jpeg_path(tmp_path_factory):
+    rng = np.random.default_rng(0)
+    # smooth-ish image: JPEG is lossy, so pure noise would amplify codec diffs
+    x = np.linspace(0, 255, 96)[None, :, None] + np.linspace(0, 64, 80)[:, None, None]
+    img = (x + rng.integers(0, 32, (80, 96, 3))).clip(0, 255).astype(np.uint8)
+    p = tmp_path_factory.mktemp("native") / "img.jpg"
+    Image.fromarray(img).save(p, quality=95)
+    return str(p)
+
+
+def test_eval_matches_pil(jpeg_path):
+    got = native.decode_eval(jpeg_path, 64, 56)
+    with Image.open(jpeg_path) as im:
+        expect = eval_transform(im.convert("RGB"), 64, 56)
+    assert got.shape == expect.shape == (56, 56, 3)
+    # identical triangle-filter math on identical decoded pixels; tolerance
+    # covers float-order and libjpeg vs PIL IDCT rounding (≤1 u8 step ≈ 0.02
+    # normalized)
+    assert np.abs(got - expect).mean() < 0.02
+    assert np.abs(got - expect).max() < 0.35
+
+
+def test_eval_upscale_path(jpeg_path):
+    got = native.decode_eval(jpeg_path, 160, 128)
+    with Image.open(jpeg_path) as im:
+        expect = eval_transform(im.convert("RGB"), 160, 128)
+    assert np.abs(got - expect).mean() < 0.02
+
+
+def test_train_transform_properties(jpeg_path):
+    a = native.decode_train(jpeg_path, 48, seed=123)
+    b = native.decode_train(jpeg_path, 48, seed=123)
+    c = native.decode_train(jpeg_path, 48, seed=124)
+    assert a.shape == (48, 48, 3)
+    np.testing.assert_array_equal(a, b)  # deterministic per seed
+    assert np.abs(a - c).max() > 0  # different seed → different crop/flip
+    # output is normalized: values in a plausible standardized range
+    assert -3.5 < a.min() and a.max() < 3.5
+
+
+def test_decode_failure_returns_none(tmp_path):
+    bad = tmp_path / "bad.jpg"
+    bad.write_bytes(b"not a jpeg")
+    assert native.decode_eval(str(bad), 64, 56) is None
+    assert native.decode_train(str(bad), 48, 1) is None

@@ -31,7 +31,9 @@ def slurm_coordinator(port=29566):
             capture_output=True, text=True, check=True,
         ).stdout.splitlines()[0].strip()
     except Exception:
-        m = re.match(r"([^\[,]+)(?:\[(\d+)", nodelist)
+        m = re.match(r"([^\[,]+)(?:\[(\d+))?", nodelist)
+        if m is None:
+            raise ValueError(f"cannot parse SLURM_NODELIST: {nodelist!r}")
         first = m.group(1) + (m.group(2) or "")
     return f"{first}:{port}"
 
