@@ -18,8 +18,8 @@ place in HBM, so peak memory is ~one copy of state + activations.
 
 from __future__ import annotations
 
+import os
 import time
-from functools import partial
 from typing import Any
 
 import flax.struct
@@ -188,6 +188,29 @@ def _build_cfg_model():
     )
 
 
+def _pretrained_path() -> str:
+    """Resolve MODEL.PRETRAINED=True to a local converted checkpoint.
+
+    The reference downloads torchvision weights via torch.hub
+    (`models/utils.py:1-4`, URLs `resnet.py:23-33`); TPU pods are typically
+    egress-restricted, so here pretrained weights are provisioned once with
+    the converter and found under ``$DTPU_PRETRAINED_DIR`` (default
+    ``~/.cache/distribuuuu_tpu/pretrained/<arch>``).
+    """
+    root = os.environ.get(
+        "DTPU_PRETRAINED_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "distribuuuu_tpu", "pretrained"),
+    )
+    path = os.path.join(root, cfg.MODEL.ARCH)
+    if not os.path.isdir(path):
+        raise FileNotFoundError(
+            f"MODEL.PRETRAINED=True but no converted weights at {path}. "
+            f"Provision once with: python scripts/convert_torch.py --arch "
+            f"{cfg.MODEL.ARCH} --src <torchvision .pth> --dst {path}"
+        )
+    return path
+
+
 # ---------------------------------------------------------------------------
 # Epoch loops (reference `train_epoch`/`validate`, `trainer.py:14-103`)
 # ---------------------------------------------------------------------------
@@ -332,6 +355,9 @@ def train_model():
             cfg.MODEL.WEIGHTS, state, load_opt=cfg.TRAIN.LOAD_OPT
         )
         logger.info(f"Warm-started weights from {cfg.MODEL.WEIGHTS}")
+    elif cfg.MODEL.PRETRAINED:
+        state, _, _ = ckpt.load_checkpoint(_pretrained_path(), state, load_opt=False)
+        logger.info(f"Initialized from pretrained weights ({cfg.MODEL.ARCH})")
 
     for epoch in range(start_epoch, cfg.OPTIM.MAX_EPOCH):
         state = train_epoch(
@@ -358,6 +384,9 @@ def test_model():
     if cfg.MODEL.WEIGHTS:
         state, _, _ = ckpt.load_checkpoint(cfg.MODEL.WEIGHTS, state)
         logger.info(f"Loaded weights from {cfg.MODEL.WEIGHTS}")
+    elif cfg.MODEL.PRETRAINED:
+        state, _, _ = ckpt.load_checkpoint(_pretrained_path(), state, load_opt=False)
+        logger.info(f"Loaded pretrained weights ({cfg.MODEL.ARCH})")
     val_loader = construct_val_loader()
     eval_step = make_eval_step(model, mesh, cfg.TRAIN.TOPK)
     return validate(val_loader, mesh, eval_step, state, info.is_primary)

@@ -159,3 +159,16 @@ def test_prefetch_to_device_shards_batches(mesh):
     assert out[0]["image"].shape == (16, 8, 8, 3)
     assert not out[0]["image"].sharding.is_fully_replicated
     np.testing.assert_allclose(np.asarray(out[1]["image"]), batches[1]["image"])
+
+
+def test_pretrained_flag_resolves_and_errors(fresh_cfg, tmp_path, monkeypatch):
+    """MODEL.PRETRAINED=True points at the converted-weights cache or fails
+    with provisioning instructions (the egress-free torch.hub analog)."""
+    from distribuuuu_tpu import trainer as tr
+
+    monkeypatch.setenv("DTPU_PRETRAINED_DIR", str(tmp_path))
+    fresh_cfg.MODEL.ARCH = "resnet18"
+    with pytest.raises(FileNotFoundError, match="convert_torch.py"):
+        tr._pretrained_path()
+    (tmp_path / "resnet18").mkdir()
+    assert tr._pretrained_path() == str(tmp_path / "resnet18")
