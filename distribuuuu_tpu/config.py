@@ -57,6 +57,11 @@ _C.TRAIN.TOPK = 5
 # TPU additions
 _C.TRAIN.PREFETCH = 2  # batches prefetched to device HBM ahead of compute
 _C.TRAIN.LABEL_SMOOTH = 0.0
+# Gradient accumulation: each optimizer step averages grads over ACCUM_STEPS
+# micro-batches of BATCH_SIZE (effective global batch = BATCH_SIZE × devices
+# × ACCUM_STEPS). The reference reaches large batches with more GPUs only
+# (`README.md:178-192`); this reaches them on a fixed chip count.
+_C.TRAIN.ACCUM_STEPS = 1
 # jax.profiler trace of a few steady-state steps (epoch 0) → OUT_DIR/profile.
 # The reference has no profiler (SURVEY §5); this is the idiomatic upgrade.
 _C.TRAIN.PROFILE = False

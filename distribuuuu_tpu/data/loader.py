@@ -234,12 +234,14 @@ def _topology():
 def construct_train_loader():
     """Train loader (reference `construct_train_loader`, `utils.py:121-152`)."""
     proc, nproc, local_dev, global_dev = _topology()
-    host_batch = cfg.TRAIN.BATCH_SIZE * local_dev
+    # per optimizer step each device consumes BATCH_SIZE × ACCUM_STEPS samples
+    step_batch = cfg.TRAIN.BATCH_SIZE * cfg.TRAIN.ACCUM_STEPS
+    host_batch = step_batch * local_dev
     if cfg.MODEL.DUMMY_INPUT:
         return DummyLoader(
             host_batch,
             cfg.TRAIN.IM_SIZE,
-            num_batches=1000 // max(1, cfg.TRAIN.BATCH_SIZE * global_dev),
+            num_batches=1000 // max(1, step_batch * global_dev),
         )
     dataset = ImageFolder(os.path.join(cfg.TRAIN.DATASET, cfg.TRAIN.SPLIT))
     return HostDataLoader(

@@ -79,24 +79,62 @@ def _forward_loss(model, params, batch_stats, batch, train: bool, rng):
     return loss, (logits, new_stats)
 
 
-def make_train_step(model, tx, mesh: Mesh, topk: int):
+def make_train_step(model, tx, mesh: Mesh, topk: int, accum_steps: int = 1):
     """Build the jitted SPMD train step.
 
     Per-device: forward/backward on the local batch shard → `pmean` grads over
     the data axis → identical optimizer update everywhere. Metrics are raw
     *count* sums (`psum`) so averaging is exact regardless of shard sizes.
+
+    ``accum_steps > 1``: the local batch is split into that many micro-batches
+    and grads/BN-stats/metrics are averaged over a `lax.scan` before the single
+    optimizer update — same effective batch as more chips, constant memory.
     """
+
+    def grads_one(params, batch_stats, micro, rng):
+        def loss_fn(p):
+            return _forward_loss(model, p, batch_stats, micro, True, rng)
+
+        (loss, (logits, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params
+        )
+        return loss, logits, new_stats, grads
 
     def step(state: TrainState, batch, lr, rng):
         # distinct dropout stream per device (rng arrives replicated)
         rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
 
-        def loss_fn(params):
-            return _forward_loss(model, params, state.batch_stats, batch, True, rng)
+        if accum_steps == 1:
+            loss, logits, new_stats, grads = grads_one(
+                state.params, state.batch_stats, batch, rng
+            )
+        else:
+            micro = jax.tree.map(
+                lambda x: x.reshape(accum_steps, x.shape[0] // accum_steps, *x.shape[1:]),
+                batch,
+            )
 
-        (loss, (logits, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
-        )
+            def body(carry, xs):
+                acc_grads, acc_loss = carry
+                mb, mb_rng = xs
+                loss, logits, new_stats, grads = grads_one(
+                    state.params, state.batch_stats, mb, mb_rng
+                )
+                acc_grads = jax.tree.map(jnp.add, acc_grads, grads)
+                return (acc_grads, acc_loss + loss), (logits, new_stats)
+
+            zero_grads = jax.tree.map(jnp.zeros_like, state.params)
+            rngs = jax.random.split(rng, accum_steps)
+            (sum_grads, sum_loss), (logits_all, stats_all) = jax.lax.scan(
+                body, (zero_grads, jnp.float32(0.0)), (micro, rngs)
+            )
+            grads = jax.tree.map(lambda g: g / accum_steps, sum_grads)
+            loss = sum_loss / accum_steps
+            logits = logits_all.reshape(-1, logits_all.shape[-1])
+            # running stats: use the scan-average (order-insensitive approx of
+            # sequential EMA over micro-batches; exact for the normalization
+            # itself, which is per-micro-batch either way)
+            new_stats = jax.tree.map(lambda s: jnp.mean(s, axis=0), stats_all)
         grads = jax.lax.pmean(grads, "data")
         # Running BN stats: averaged across replicas so state stays replicated.
         # (With SYNCBN the normalization stats are already cross-replica; this
@@ -320,7 +358,8 @@ def train_model():
     logger.info(
         f"Devices: {info.global_device_count} ({info.process_count} hosts), "
         f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))}, "
-        f"global batch={cfg.TRAIN.BATCH_SIZE * info.global_device_count}"
+        f"global batch={cfg.TRAIN.BATCH_SIZE * info.global_device_count * cfg.TRAIN.ACCUM_STEPS}"
+        + (f" (accum x{cfg.TRAIN.ACCUM_STEPS})" if cfg.TRAIN.ACCUM_STEPS > 1 else "")
     )
 
     if cfg.MODEL.ARCH == "botnet50" and cfg.TRAIN.IM_SIZE != cfg.TEST.CROP_SIZE:
@@ -342,7 +381,9 @@ def train_model():
 
     train_loader = construct_train_loader()
     val_loader = construct_val_loader()
-    train_step = make_train_step(model, tx, mesh, cfg.TRAIN.TOPK)
+    train_step = make_train_step(
+        model, tx, mesh, cfg.TRAIN.TOPK, accum_steps=cfg.TRAIN.ACCUM_STEPS
+    )
     eval_step = make_eval_step(model, mesh, cfg.TRAIN.TOPK)
 
     start_epoch, best_acc1 = 0, 0.0

@@ -33,7 +33,6 @@ def test_two_process_training(tmp_path):
             WORLD_SIZE="2",
             MASTER_ADDR="127.0.0.1",
             MASTER_PORT=str(port),
-            XLA_FLAGS="--xla_force_host_platform_device_count=4",
         )
         env.pop("JAX_PLATFORMS", None)
         log = open(tmp_path / f"rank{rank}.log", "w")

@@ -172,3 +172,40 @@ def test_pretrained_flag_resolves_and_errors(fresh_cfg, tmp_path, monkeypatch):
         tr._pretrained_path()
     (tmp_path / "resnet18").mkdir()
     assert tr._pretrained_path() == str(tmp_path / "resnet18")
+
+
+def test_grad_accumulation_equivalence(fresh_cfg, mesh):
+    """ACCUM_STEPS=2 over batch 2N == one step over batch 2N (BN-free model).
+
+    BN normalizes per micro-batch under accumulation, so exact equality needs
+    a BN-free model; NoBN isolates the gradient-accumulation math.
+    """
+
+    class NoBN(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.Conv(8, (3, 3), use_bias=False, dtype=jnp.float32)(x)
+            x = nn.relu(x)
+            x = jnp.mean(x, axis=(1, 2))
+            return nn.Dense(4)(x)
+
+    fresh_cfg.OPTIM.WEIGHT_DECAY = 0.0
+    fresh_cfg.OPTIM.MOMENTUM = 0.0
+    fresh_cfg.OPTIM.NESTEROV = False
+    model = NoBN()
+    batch = _batch(n=32)
+
+    outs = []
+    for accum in (1, 2):
+        state, tx = create_train_state(model, jax.random.PRNGKey(0), mesh, 8)
+        step = make_train_step(model, tx, mesh, topk=2, accum_steps=accum)
+        new_state, m = step(
+            state, _device_batch(batch, mesh), jnp.float32(1.0), jax.random.PRNGKey(0)
+        )
+        outs.append((jax.device_get(new_state.params), jax.device_get(m)))
+    (p1, m1), (p2, m2) = outs
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    assert m1["n"] == m2["n"] == 32.0
+    np.testing.assert_allclose(m1["correct1"], m2["correct1"])
+    np.testing.assert_allclose(m1["loss_sum"], m2["loss_sum"], rtol=1e-5)
