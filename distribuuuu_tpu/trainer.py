@@ -167,9 +167,14 @@ def make_train_step(model, tx, mesh: Mesh, topk: int, accum_steps: int = 1):
 
 
 def make_eval_step(model, mesh: Mesh, topk: int):
-    """Jitted SPMD eval step with weight-masked exact metrics (SURVEY §3.3)."""
+    """Jitted SPMD eval step with weight-masked exact metrics (SURVEY §3.3).
 
-    def step(state: TrainState, batch):
+    Takes and returns the running metric totals so accumulation happens
+    *inside* the compiled step (one dispatch per batch). ``zero_metrics()``
+    builds the initial totals.
+    """
+
+    def step(state: TrainState, batch, totals):
         logits = model.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
             batch["image"],
@@ -179,17 +184,29 @@ def make_eval_step(model, mesh: Mesh, topk: int):
         logits32 = logits.astype(jnp.float32)
         nll = per_example_nll(logits32, batch["label"])
         correct = topk_correct_weighted(logits32, batch["label"], w, ks=(1, topk))
-        return {
+        m = {
             "loss_sum": jax.lax.psum(jnp.sum(nll * w), "data"),
             "n": jax.lax.psum(jnp.sum(w), "data"),
             "correct1": jax.lax.psum(correct[1], "data"),
             f"correct{topk}": jax.lax.psum(correct[topk], "data"),
         }
+        return jax.tree.map(jnp.add, totals, m)
 
     sharded = jax.shard_map(
-        step, mesh=mesh, in_specs=(P(), P("data")), out_specs=P(), check_vma=False
+        step, mesh=mesh, in_specs=(P(), P("data"), P()), out_specs=P(), check_vma=False
     )
+    # NB: totals is NOT donated — the buffers are 4 scalars, and donating a
+    # replicated shard_map input deadlocked the XLA:CPU collective rendezvous.
     return jax.jit(sharded)
+
+
+def zero_metrics(topk: int, mesh: Mesh):
+    """Zeroed running totals, replicated over the mesh up front so the first
+    eval step needs no implicit resharding. (Deliberately NOT donated — see
+    the NB in make_eval_step.)"""
+    z = jnp.zeros((), jnp.float32)
+    totals = {"loss_sum": z, "n": z, "correct1": z, f"correct{topk}": z}
+    return jax.device_put(totals, NamedSharding(mesh, P()))
 
 
 # ---------------------------------------------------------------------------
@@ -267,8 +284,9 @@ def train_epoch(loader, mesh, train_step, state, epoch: int, rng, is_primary: bo
     profile = cfg.TRAIN.PROFILE and epoch == 0 and is_primary
     trace_active = False
     window: list = []
-    t_end = time.time()
-    t_window = t_end
+    epoch_start = time.time()
+    t_end = epoch_start
+    t_window = epoch_start
     for it, batch in enumerate(
         prefetch_to_device(loader, mesh, cfg.TRAIN.PREFETCH)
     ):
@@ -305,6 +323,14 @@ def train_epoch(loader, mesh, train_step, state, epoch: int, rng, is_primary: bo
     if trace_active:  # epoch shorter than PROFILE_START+STEPS
         jax.profiler.stop_trace()
         logger.info(f"Wrote profiler trace to {cfg.OUT_DIR}/profile (short epoch)")
+    if is_primary and len(loader):
+        imgs = cfg.TRAIN.BATCH_SIZE * cfg.TRAIN.ACCUM_STEPS * jax.device_count() * len(loader)
+        wall = time.time() - epoch_start
+        if wall > 0:
+            logger.info(
+                f"Epoch[{epoch}] done: {wall:.1f}s, {imgs / wall:.0f} img/s "
+                f"({imgs / wall / jax.device_count():.0f}/chip)"
+            )
     return state
 
 
@@ -314,12 +340,11 @@ def validate(loader, mesh, eval_step, state, is_primary: bool, print_freq=None, 
     batch_time, data_time, losses, top1, topk_m, progress = construct_meters(
         len(loader), prefix=prefix, topk=topk
     )
-    totals = None
+    totals = zero_metrics(topk, mesh)
     t_end = time.time()
     for it, batch in enumerate(prefetch_to_device(loader, mesh, cfg.TRAIN.PREFETCH)):
         data_time.update(time.time() - t_end)
-        m = eval_step(state, batch)
-        totals = m if totals is None else jax.tree.map(jnp.add, totals, m)
+        totals = eval_step(state, batch, totals)
         if it % print_freq == 0 or it == len(loader) - 1:
             vals = jax.device_get(totals)  # sync point
             n = max(vals["n"], 1.0)

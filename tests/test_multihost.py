@@ -33,6 +33,9 @@ def test_two_process_training(tmp_path):
             WORLD_SIZE="2",
             MASTER_ADDR="127.0.0.1",
             MASTER_PORT=str(port),
+            # pin 4 devices/process explicitly: conftest's 8-device XLA_FLAGS
+            # is inherited otherwise, silently doubling the topology
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
         )
         env.pop("JAX_PLATFORMS", None)
         log = open(tmp_path / f"rank{rank}.log", "w")
@@ -54,7 +57,7 @@ def test_two_process_training(tmp_path):
                     "RNG_SEED", "5",
                     "OUT_DIR", str(out_dir),
                 ],
-                env={**env, "DTPU_CPU_DEVICES": "4"},
+                env=env,
                 stdout=log,
                 stderr=subprocess.STDOUT,
                 cwd=REPO,

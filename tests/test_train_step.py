@@ -21,6 +21,7 @@ from distribuuuu_tpu.trainer import (
     create_train_state,
     make_eval_step,
     make_train_step,
+    zero_metrics,
 )
 
 
@@ -139,14 +140,18 @@ def test_eval_step_weighted_exact(fresh_cfg, mesh):
     eval_step = make_eval_step(model, mesh, topk=2)
 
     full = _batch(n=16, seed=3)
-    m_full = jax.device_get(eval_step(state, _device_batch(full, mesh)))
+    m_full = jax.device_get(
+        eval_step(state, _device_batch(full, mesh), zero_metrics(2, mesh))
+    )
 
     padded = {
         "image": np.concatenate([full["image"], np.zeros_like(full["image"])]),
         "label": np.concatenate([full["label"], np.zeros_like(full["label"])]),
         "weight": np.concatenate([full["weight"], np.zeros_like(full["weight"])]),
     }
-    m_pad = jax.device_get(eval_step(state, _device_batch(padded, mesh)))
+    m_pad = jax.device_get(
+        eval_step(state, _device_batch(padded, mesh), zero_metrics(2, mesh))
+    )
     assert m_pad["n"] == m_full["n"] == 16.0
     np.testing.assert_allclose(m_pad["loss_sum"], m_full["loss_sum"], rtol=1e-5)
     np.testing.assert_allclose(m_pad["correct1"], m_full["correct1"])
