@@ -6,7 +6,6 @@ decode, batch assembly), accuracy collapses to chance — no other test
 exercises label-image alignment through the entire stack.
 """
 
-import os
 
 import numpy as np
 import pytest
