@@ -1,0 +1,85 @@
+"""Checkpoint layer: naming contract, resume scan, tmp-dir safety, LOAD_OPT."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distribuuuu_tpu import checkpoint as ckpt
+from distribuuuu_tpu.trainer import TrainState
+
+
+@pytest.fixture()
+def tiny_state():
+    params = {"w": jnp.arange(4.0), "b": jnp.zeros((2,))}
+    opt_state = {"momentum": {"w": jnp.ones(4), "b": jnp.zeros(2)}}
+    return TrainState(params=params, batch_stats={"m": jnp.zeros(3)}, opt_state=opt_state)
+
+
+def test_naming_contract(tmp_path):
+    out = str(tmp_path)
+    assert ckpt.get_checkpoint_path(out, 7).endswith("checkpoints/ckpt_ep_007")
+    assert ckpt.get_best_path(out).endswith("checkpoints/best")
+
+
+def test_save_load_roundtrip(tmp_path, tiny_state):
+    out = str(tmp_path)
+    path = ckpt.save_checkpoint(out, 3, tiny_state, best_acc1=12.5, is_best=True)
+    assert os.path.isdir(path)
+    assert ckpt.has_checkpoint(out)
+    assert ckpt.get_last_checkpoint(out) == path
+
+    blank = jax.tree.map(jnp.zeros_like, tiny_state)
+    restored, start_epoch, best = ckpt.load_checkpoint(path, blank)
+    assert start_epoch == 4 and best == 12.5
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]), np.arange(4.0))
+    np.testing.assert_array_equal(
+        np.asarray(restored.opt_state["momentum"]["w"]), np.ones(4)
+    )
+
+
+def test_weights_only_best_load(tmp_path, tiny_state):
+    out = str(tmp_path)
+    ckpt.save_checkpoint(out, 0, tiny_state, best_acc1=1.0, is_best=True)
+    blank = jax.tree.map(jnp.zeros_like, tiny_state)
+    restored, start_epoch, best = ckpt.load_checkpoint(ckpt.get_best_path(out), blank)
+    assert start_epoch == 0 and best == 0.0  # weights-only: no epoch/opt
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]), np.arange(4.0))
+    # optimizer state untouched (stays blank)
+    np.testing.assert_array_equal(
+        np.asarray(restored.opt_state["momentum"]["w"]), np.zeros(4)
+    )
+
+
+def test_load_opt_false_skips_optimizer(tmp_path, tiny_state):
+    out = str(tmp_path)
+    path = ckpt.save_checkpoint(out, 2, tiny_state, best_acc1=5.0, is_best=False)
+    blank = jax.tree.map(jnp.zeros_like, tiny_state)
+    restored, start_epoch, _ = ckpt.load_checkpoint(path, blank, load_opt=False)
+    assert start_epoch == 3
+    np.testing.assert_array_equal(
+        np.asarray(restored.opt_state["momentum"]["w"]), np.zeros(4)
+    )
+
+
+def test_resume_ignores_orbax_tmp_dirs(tmp_path, tiny_state):
+    """A killed run's in-progress temp dir must never win the resume scan."""
+    out = str(tmp_path)
+    ckpt.save_checkpoint(out, 4, tiny_state, best_acc1=1.0, is_best=False)
+    d = ckpt.get_checkpoint_dir(out)
+    os.makedirs(os.path.join(d, "ckpt_ep_009.orbax-checkpoint-tmp-1234567890"))
+    assert ckpt.get_last_checkpoint(out).endswith("ckpt_ep_004")
+
+    # tmp dirs alone ≠ resumable state
+    empty = str(tmp_path / "fresh")
+    os.makedirs(os.path.join(empty, "checkpoints", "ckpt_ep_000.orbax-checkpoint-tmp-1"))
+    assert not ckpt.has_checkpoint(empty)
+
+
+def test_highest_epoch_wins(tmp_path, tiny_state):
+    out = str(tmp_path)
+    for e in (0, 2, 10):
+        ckpt.save_checkpoint(out, e, tiny_state, best_acc1=0.0, is_best=False)
+    assert ckpt.get_last_checkpoint(out).endswith("ckpt_ep_010")

@@ -12,6 +12,16 @@ from distribuuuu_tpu.parallel import ring_attention, scaled_all_reduce
 from distribuuuu_tpu.runtime import create_mesh
 
 
+def _make_ring(mesh, **kw):
+    return jax.shard_map(
+        functools.partial(ring_attention, axis_name="seq", **kw),
+        mesh=mesh,
+        in_specs=(P(None, None, "seq", None),) * 3,
+        out_specs=P(None, None, "seq", None),
+        check_vma=False,
+    )
+
+
 def _global_attention(q, k, v, causal=False):
     scale = q.shape[-1] ** -0.5
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
@@ -32,15 +42,7 @@ def test_ring_matches_global(causal):
     k = jnp.asarray(rng.standard_normal((B, H, L, D)), jnp.float32)
     v = jnp.asarray(rng.standard_normal((B, H, L, D)), jnp.float32)
 
-    ring = jax.jit(
-        jax.shard_map(
-            functools.partial(ring_attention, axis_name="seq", causal=causal),
-            mesh=mesh,
-            in_specs=(P(None, None, "seq", None),) * 3,
-            out_specs=P(None, None, "seq", None),
-            check_vma=False,
-        )
-    )
+    ring = jax.jit(_make_ring(mesh, causal=causal))
     got = np.asarray(ring(q, k, v))
     expect = np.asarray(_global_attention(q, k, v, causal))
     np.testing.assert_allclose(got, expect, rtol=2e-5, atol=2e-5)
@@ -53,15 +55,7 @@ def test_ring_bf16():
     q = jnp.asarray(rng.standard_normal((B, H, L, D)), jnp.bfloat16)
     k = jnp.asarray(rng.standard_normal((B, H, L, D)), jnp.bfloat16)
     v = jnp.asarray(rng.standard_normal((B, H, L, D)), jnp.bfloat16)
-    ring = jax.jit(
-        jax.shard_map(
-            functools.partial(ring_attention, axis_name="seq"),
-            mesh=mesh,
-            in_specs=(P(None, None, "seq", None),) * 3,
-            out_specs=P(None, None, "seq", None),
-            check_vma=False,
-        )
-    )
+    ring = jax.jit(_make_ring(mesh))
     got = np.asarray(ring(q, k, v), np.float32)
     expect = np.asarray(_global_attention(q, k, v), np.float32)
     np.testing.assert_allclose(got, expect, rtol=5e-2, atol=5e-2)
@@ -79,3 +73,26 @@ def test_scaled_all_reduce_in_shard_map():
         jax.shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False)
     )(x)
     np.testing.assert_allclose(np.asarray(out), np.full(8, 3.5))
+
+
+def test_ring_attention_differentiable():
+    """Grads through the ring (fori_loop + ppermute) match the global oracle."""
+    mesh = create_mesh({"seq": 8})
+    rng = np.random.default_rng(2)
+    B, H, L, D = 1, 1, 16, 8
+    q = jnp.asarray(rng.standard_normal((B, H, L, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, L, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, L, D)), jnp.float32)
+
+    ring = _make_ring(mesh)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring(q, k, v) ** 2)
+
+    def loss_global(q, k, v):
+        return jnp.sum(_global_attention(q, k, v) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_glob = jax.grad(loss_global, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_glob):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
