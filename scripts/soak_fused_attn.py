@@ -1,0 +1,66 @@
+"""On-chip soak for the fused attention kernel (run when a TPU is healthy).
+
+Validates ops/attention.py against the XLA path on real hardware at BoTNet
+shapes (fwd values, gradients, and speed), then prints the verdict. If all
+checks pass, flip the default by setting DTPU_FUSED_ATTN=1 in the launch
+environment (or change the auto-gate in models/botnet.py).
+
+    python scripts/soak_fused_attn.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distribuuuu_tpu.ops.attention import fused_attention, xla_attention
+
+    print(f"devices: {jax.devices()}", flush=True)
+    rng = np.random.default_rng(0)
+    B, N, L, D = 64, 4, 196, 128  # botnet50 stage-4 shapes, batch 64
+    q = jnp.asarray(rng.standard_normal((B, N, L, D)) * 0.1, jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, N, L, D)) * 0.1, jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, N, L, D)), jnp.bfloat16)
+    bias = jnp.asarray(rng.standard_normal((B, N, L, L)), jnp.float32)
+
+    # 1) forward parity
+    out_f = jax.device_get(jax.jit(fused_attention)(q, k, v, bias))
+    out_x = jax.device_get(jax.jit(xla_attention)(q, k, v, bias))
+    fwd_diff = np.max(np.abs(out_f.astype(np.float32) - out_x.astype(np.float32)))
+    print(f"fwd max|diff| = {fwd_diff:.4f} (bf16 tolerance ~0.05)", flush=True)
+
+    # 2) gradient parity
+    def loss(fn):
+        return lambda *a: jnp.sum(fn(*a).astype(jnp.float32) ** 2)
+
+    gf = jax.device_get(jax.jit(jax.grad(loss(fused_attention), argnums=(0, 1, 2, 3)))(q, k, v, bias))
+    gx = jax.device_get(jax.jit(jax.grad(loss(xla_attention), argnums=(0, 1, 2, 3)))(q, k, v, bias))
+    grad_diff = max(
+        float(np.max(np.abs(a.astype(np.float32) - b.astype(np.float32))))
+        for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gx))
+    )
+    print(f"grad max|diff| = {grad_diff:.4f}", flush=True)
+
+    # 3) speed
+    for name, fn in [("fused", fused_attention), ("xla", xla_attention)]:
+        f = jax.jit(loss(fn))
+        jax.device_get(f(q, k, v, bias))
+        t0 = time.perf_counter()
+        for _ in range(10):
+            jax.device_get(f(q, k, v, bias))
+        print(f"{name}: {(time.perf_counter() - t0) / 10 * 1000:.2f} ms", flush=True)
+
+    ok = fwd_diff < 0.1 and grad_diff < 1.0
+    print("SOAK", "PASS — consider enabling DTPU_FUSED_ATTN=1" if ok else "FAIL", flush=True)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -108,3 +108,58 @@ def test_densenet_legacy_key_remap():
         _remap_densenet_legacy("features.denseblock1.denselayer2.conv1.weight")
         == "features.denseblock1.denselayer2.conv1.weight"
     )
+
+
+def _synthetic_densenet121_state_dict(legacy_block1=False):
+    """torchvision densenet121 keys/shapes from naming rules.
+
+    ``legacy_block1=True`` emits block-1 dense layers with the pre-1.0 dotted
+    names (``norm.1`` …) to exercise the remap inside full conversion.
+    """
+    sd = {}
+
+    def conv(name, o, i, k):
+        if legacy_block1 and ".denseblock1." in name:
+            name = name.replace(".conv1", ".conv.1").replace(".conv2", ".conv.2")
+        sd[name + ".weight"] = torch.zeros(o, i, k, k)
+
+    def bn(name, c):
+        if legacy_block1 and ".denseblock1." in name:
+            name = name.replace(".norm1", ".norm.1").replace(".norm2", ".norm.2")
+        for p, v in [("weight", torch.ones(c)), ("bias", torch.zeros(c)),
+                     ("running_mean", torch.zeros(c)), ("running_var", torch.ones(c)),
+                     ("num_batches_tracked", torch.tensor(0))]:
+            sd[f"{name}.{p}"] = v
+
+    conv("features.conv0", 64, 3, 7)
+    bn("features.norm0", 64)
+    feats = 64
+    growth, bn_size = 32, 4
+    for b, layers in enumerate([6, 12, 24, 16], start=1):
+        for l in range(1, layers + 1):
+            pre = f"features.denseblock{b}.denselayer{l}"
+            bn(pre + ".norm1", feats + (l - 1) * growth)
+            conv(pre + ".conv1", bn_size * growth, feats + (l - 1) * growth, 1)
+            bn(pre + ".norm2", bn_size * growth)
+            conv(pre + ".conv2", growth, bn_size * growth, 3)
+        feats += layers * growth
+        if b != 4:
+            bn(f"features.transition{b}.norm", feats)
+            conv(f"features.transition{b}.conv", feats // 2, feats, 1)
+            feats //= 2
+    bn("features.norm5", feats)
+    sd["classifier.weight"] = torch.zeros(1000, feats)
+    sd["classifier.bias"] = torch.zeros(1000)
+    return sd
+
+
+def test_densenet121_full_tree_structure():
+    converted = convert_state_dict(_synthetic_densenet121_state_dict(), "densenet121")
+    verify_against_model(converted, "densenet121")
+
+
+def test_densenet121_legacy_keys_full_conversion():
+    """Pre-1.0 dotted names remap correctly inside the full conversion path."""
+    sd = _synthetic_densenet121_state_dict(legacy_block1=True)
+    converted = convert_state_dict(sd, "densenet121")
+    verify_against_model(converted, "densenet121")
