@@ -13,3 +13,23 @@ mesh with XLA collectives (psum/pmean) riding ICI.
 """
 
 __version__ = "0.1.0"
+
+# Lazy convenience API: `from distribuuuu_tpu import cfg, build_model, ...`
+# without paying the jax/flax import cost for config-only consumers.
+_LAZY = {
+    "cfg": ("distribuuuu_tpu.config", "cfg"),
+    "load_cfg_fom_args": ("distribuuuu_tpu.config", "load_cfg_fom_args"),
+    "build_model": ("distribuuuu_tpu.models", "build_model"),
+    "list_models": ("distribuuuu_tpu.models", "list_models"),
+    "train_model": ("distribuuuu_tpu.trainer", "train_model"),
+    "test_model": ("distribuuuu_tpu.trainer", "test_model"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module 'distribuuuu_tpu' has no attribute {name!r}")

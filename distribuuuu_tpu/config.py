@@ -113,6 +113,14 @@ _CFG_DEFAULT = _C.clone()
 _CFG_DEFAULT.freeze()
 
 
+def get_default(key_path: str):
+    """Default value for a dotted config key (e.g. ``"TEST.DATASET"``)."""
+    node = _CFG_DEFAULT
+    for part in key_path.split("."):
+        node = node[part]
+    return node
+
+
 def merge_from_file(cfg_file: str) -> None:
     _C.merge_from_file(cfg_file)
 

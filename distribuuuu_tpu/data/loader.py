@@ -34,7 +34,7 @@ import jax
 import numpy as np
 from PIL import Image
 
-from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.config import cfg, get_default
 from distribuuuu_tpu.data import native
 from distribuuuu_tpu.data.dataset import DummyDataset, ImageFolder
 from distribuuuu_tpu.data.transforms import eval_transform, train_transform
@@ -274,7 +274,16 @@ def construct_val_loader():
             cfg.TEST.CROP_SIZE,
             num_batches=1000 // max(1, cfg.TEST.BATCH_SIZE * global_dev),
         )
-    dataset = ImageFolder(os.path.join(cfg.TEST.DATASET, cfg.TEST.SPLIT))
+    # Reference quirk kept for migration compat: its val loader reads
+    # TRAIN.DATASET + TEST.SPLIT and TEST.DATASET is unused (`utils.py:157`),
+    # so reference users only ever set TRAIN.DATASET. Honor TEST.DATASET only
+    # when it was explicitly changed from the default.
+    val_root = (
+        cfg.TEST.DATASET
+        if cfg.TEST.DATASET != get_default("TEST.DATASET")
+        else cfg.TRAIN.DATASET
+    )
+    dataset = ImageFolder(os.path.join(val_root, cfg.TEST.SPLIT))
     return HostDataLoader(
         dataset,
         host_batch=host_batch,

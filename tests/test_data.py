@@ -158,3 +158,24 @@ def test_producer_exception_propagates(image_root, monkeypatch):
             list(l)
     finally:
         Image.new("RGB", (40, 50)).save(bad_path)
+
+
+def test_val_loader_follows_train_dataset_by_default(image_root, fresh_cfg):
+    """Reference compat: setting only TRAIN.DATASET must steer the val loader
+    too (the reference's val dir is TRAIN.DATASET + TEST.SPLIT, `utils.py:157`)."""
+    import os
+    from distribuuuu_tpu.data.loader import construct_val_loader
+
+    # build a tiny split layout: root2/val -> symlink to the class dirs
+    root2 = os.path.join(os.path.dirname(image_root), "ds2")
+    os.makedirs(root2, exist_ok=True)
+    link = os.path.join(root2, "val")
+    if not os.path.exists(link):
+        os.symlink(image_root, link)
+
+    fresh_cfg.TRAIN.DATASET = root2  # only TRAIN.DATASET set, as reference users do
+    fresh_cfg.TEST.BATCH_SIZE = 2
+    fresh_cfg.TEST.CROP_SIZE = 16
+    fresh_cfg.TEST.IM_SIZE = 20
+    loader = construct_val_loader()
+    assert len(loader.dataset) == 21

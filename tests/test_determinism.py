@@ -9,7 +9,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from distribuuuu_tpu import optim
 from distribuuuu_tpu.data.dataset import DummyDataset
 from distribuuuu_tpu.models import build_model
 from distribuuuu_tpu.runtime import data_mesh, setup_seed
