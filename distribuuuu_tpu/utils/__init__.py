@@ -1,0 +1,75 @@
+"""Migration facade: the reference's `distribuuuu.utils` surface in one place.
+
+The reference concentrates its runtime helpers in a single
+`distribuuuu/utils.py` (SURVEY §2a rows 5-13); here they live in focused
+modules. This package re-exports them under the names reference users know,
+so ``from distribuuuu.utils import setup_distributed`` becomes
+``from distribuuuu_tpu.utils import setup_distributed`` unchanged.
+
+| reference symbol (`utils.py`)    | implementation                         |
+|----------------------------------|----------------------------------------|
+| setup_distributed (`:19`)        | runtime.dist.setup_distributed         |
+| setup_seed (`:54`)               | runtime.seeding.setup_seed             |
+| setup_logger (`:71`)             | logging.setup_logger                   |
+| scaled_all_reduce (`:85`)        | parallel.collectives.scaled_all_reduce |
+| construct_train_loader (`:121`)  | data.loader.construct_train_loader     |
+| construct_val_loader (`:155`)    | data.loader.construct_val_loader       |
+| construct_optimizer (`:187`)     | optim.construct_optimizer              |
+| AverageMeter (`:199`)            | metrics.AverageMeter                   |
+| ProgressMeter (`:224`)           | metrics.ProgressMeter                  |
+| construct_meters (`:255`)        | metrics.construct_meters               |
+| accuracy (`:265`)                | metrics.topk_correct (count-based)     |
+| get_epoch_lr (`:301`)            | optim.get_epoch_lr                     |
+| count_parameters (`:353`)        | metrics.count_parameters               |
+| save/load_checkpoint etc (`:319`)| checkpoint.*                           |
+
+(`unwrap_model`/`set_lr` have no analog: there is no DDP wrapper to strip,
+and the LR is a step argument, not optimizer state.)
+"""
+
+from distribuuuu_tpu.checkpoint import (
+    get_best_path,
+    get_checkpoint_dir,
+    get_last_checkpoint,
+    has_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
+from distribuuuu_tpu.data.loader import construct_train_loader, construct_val_loader
+from distribuuuu_tpu.logging import setup_logger
+from distribuuuu_tpu.metrics import (
+    AverageMeter,
+    ProgressMeter,
+    construct_meters,
+    count_parameters,
+    topk_correct,
+    topk_correct_weighted,
+)
+from distribuuuu_tpu.optim import construct_optimizer, get_epoch_lr
+from distribuuuu_tpu.parallel.collectives import barrier, scaled_all_reduce
+from distribuuuu_tpu.runtime.dist import setup_distributed
+from distribuuuu_tpu.runtime.seeding import setup_seed
+
+__all__ = [
+    "AverageMeter",
+    "ProgressMeter",
+    "barrier",
+    "construct_meters",
+    "construct_optimizer",
+    "construct_train_loader",
+    "construct_val_loader",
+    "count_parameters",
+    "get_best_path",
+    "get_checkpoint_dir",
+    "get_epoch_lr",
+    "get_last_checkpoint",
+    "has_checkpoint",
+    "load_checkpoint",
+    "save_checkpoint",
+    "scaled_all_reduce",
+    "setup_distributed",
+    "setup_logger",
+    "setup_seed",
+    "topk_correct",
+    "topk_correct_weighted",
+]

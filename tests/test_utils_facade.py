@@ -1,0 +1,17 @@
+"""The distribuuuu.utils-compatible facade exposes the reference surface."""
+
+
+def test_facade_symbols():
+    from distribuuuu_tpu import utils
+
+    for name in utils.__all__:
+        assert callable(getattr(utils, name)), name
+
+    # spot-check the key reference names exist under their familiar spellings
+    for ref_name in [
+        "setup_distributed", "setup_seed", "setup_logger", "scaled_all_reduce",
+        "construct_train_loader", "construct_val_loader", "construct_optimizer",
+        "AverageMeter", "ProgressMeter", "get_epoch_lr", "count_parameters",
+        "save_checkpoint", "load_checkpoint", "has_checkpoint", "get_last_checkpoint",
+    ]:
+        assert hasattr(utils, ref_name), ref_name
