@@ -10,6 +10,7 @@ framework adds).
   python imagenet_spmd.py                       # synthetic data fallback
 """
 
+import os
 import sys
 import time
 
@@ -19,7 +20,10 @@ import numpy as np
 from flax import linen as nn
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-BATCH_PER_DEV, CLASSES, EPOCH_STEPS = 32, 1000, 100
+# env-overridable so the script smokes quickly on a CPU mesh
+BATCH_PER_DEV = int(os.environ.get("BATCH_PER_DEV", "32"))
+CLASSES = 1000
+EPOCH_STEPS = int(os.environ.get("EPOCH_STEPS", "100"))
 
 
 class ResNet18(nn.Module):
