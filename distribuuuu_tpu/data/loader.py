@@ -81,6 +81,15 @@ class HostDataLoader:
         self.shard_size = (total + process_count - 1) // process_count
         if train:
             self.num_batches = self.shard_size // host_batch  # drop_last
+            if self.num_batches == 0:
+                raise ValueError(
+                    f"Training dataset ({total} samples / {process_count} "
+                    f"host(s) = {self.shard_size} per shard) yields zero "
+                    f"batches per epoch: each host consumes {host_batch} "
+                    f"samples per step (BATCH_SIZE x ACCUM_STEPS x local "
+                    f"devices) with drop_last. Reduce TRAIN.BATCH_SIZE / "
+                    f"TRAIN.ACCUM_STEPS."
+                )
         else:
             self.num_batches = (self.shard_size + host_batch - 1) // host_batch
 

@@ -179,3 +179,9 @@ def test_val_loader_follows_train_dataset_by_default(image_root, fresh_cfg):
     fresh_cfg.TEST.IM_SIZE = 20
     loader = construct_val_loader()
     assert len(loader.dataset) == 21
+
+
+def test_train_loader_rejects_dataset_smaller_than_batch(image_root):
+    """A dataset below one global batch must fail loudly, not no-op epochs."""
+    with pytest.raises(ValueError, match="zero batches"):
+        _mk_loader(image_root, 0, 1, host_batch=64)  # 21 samples < 64
