@@ -50,9 +50,8 @@ def main():
     timer.start()
     import jax
     import jax.numpy as jnp
-    import numpy as np
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from distribuuuu_tpu.benchutil import make_synthetic_batch
     from distribuuuu_tpu.models import build_model
     from distribuuuu_tpu.runtime import data_mesh
     from distribuuuu_tpu.trainer import create_train_state, make_train_step
@@ -68,20 +67,7 @@ def main():
     state, tx = create_train_state(model, jax.random.PRNGKey(0), mesh, 224)
     train_step = make_train_step(model, tx, mesh, topk=5)
 
-    rng = np.random.default_rng(0)
-    batch = {
-        "image": jax.device_put(
-            rng.standard_normal((global_batch, 224, 224, 3)).astype(np.float32),
-            NamedSharding(mesh, P("data", None, None, None)),
-        ),
-        "label": jax.device_put(
-            rng.integers(0, 1000, global_batch).astype(np.int32),
-            NamedSharding(mesh, P("data")),
-        ),
-        "weight": jax.device_put(
-            np.ones((global_batch,), np.float32), NamedSharding(mesh, P("data"))
-        ),
-    }
+    batch = make_synthetic_batch(mesh, global_batch)
     lr = jnp.asarray(0.1, jnp.float32)
     key = jax.random.PRNGKey(1)
 

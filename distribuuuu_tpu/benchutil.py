@@ -1,0 +1,25 @@
+"""Shared helpers for benchmarking scripts (bench.py, scripts/perf_sweep.py)."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def make_synthetic_batch(mesh, global_batch: int, im_size: int = 224, seed: int = 0):
+    """Synthetic sharded train batch with the loader's exact field contract."""
+    rng = np.random.default_rng(seed)
+    return {
+        "image": jax.device_put(
+            rng.standard_normal((global_batch, im_size, im_size, 3)).astype(np.float32),
+            NamedSharding(mesh, P("data", None, None, None)),
+        ),
+        "label": jax.device_put(
+            rng.integers(0, 1000, global_batch).astype(np.int32),
+            NamedSharding(mesh, P("data")),
+        ),
+        "weight": jax.device_put(
+            np.ones((global_batch,), np.float32), NamedSharding(mesh, P("data"))
+        ),
+    }

@@ -1,0 +1,73 @@
+"""Perf sweep on a healthy TPU: models × batch sizes, one table.
+
+    python scripts/perf_sweep.py [--quick]
+
+Measures the full SPMD train step with bench.py's methodology (3 warmup
+steps for compile+autotune, then device_get-synced timing) and prints a
+markdown table for docs/BENCH_NOTES.md.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CASES = [
+    # (arch, per-chip batches)
+    ("resnet18", (256, 1024)),
+    ("resnet50", (128, 512)),
+    ("botnet50", (128, 256)),
+    ("efficientnet_b0", (256, 512)),
+    ("regnety_160", (64, 128)),
+]
+
+WARMUP, ITERS, QUICK_ITERS = 3, 10, 5
+
+
+def main():
+    quick = "--quick" in sys.argv
+    import jax
+    import jax.numpy as jnp
+
+    from distribuuuu_tpu.benchutil import make_synthetic_batch
+    from distribuuuu_tpu.models import build_model
+    from distribuuuu_tpu.runtime import data_mesh
+    from distribuuuu_tpu.trainer import create_train_state, make_train_step
+
+    mesh = data_mesh(-1)
+    n_chips = jax.device_count()
+    print(f"devices: {jax.devices()}\n")
+    print("| arch | batch/chip | ms/step | img/s/chip |")
+    print("|---|---|---|---|")
+    lr = jnp.asarray(0.1, jnp.float32)
+    key = jax.random.PRNGKey(1)
+    iters = QUICK_ITERS if quick else ITERS
+
+    for arch, batches in CASES:
+        model = build_model(arch, num_classes=1000)
+        init_state, tx = create_train_state(model, jax.random.PRNGKey(0), mesh, 224)
+        step = make_train_step(model, tx, mesh, topk=5)
+        del init_state  # each batch size gets a fresh state below
+        for B in batches[:1] if quick else batches:
+            try:
+                # state/batch construction inside the try: OOM at the larger
+                # rungs happens here as readily as inside the step
+                state, _ = create_train_state(model, jax.random.PRNGKey(0), mesh, 224)
+                batch = make_synthetic_batch(mesh, B * n_chips)
+                for _ in range(WARMUP):
+                    state, m = step(state, batch, lr, key)
+                    jax.device_get(m)
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    state, m = step(state, batch, lr, key)
+                    jax.device_get(m)
+                dt = (time.perf_counter() - t0) / iters
+                print(f"| {arch} | {B} | {dt * 1000:.1f} | {B / dt:.1f} |", flush=True)
+                del state, batch
+            except Exception as e:  # OOM etc: report and continue the sweep
+                print(f"| {arch} | {B} | FAILED: {type(e).__name__} | — |", flush=True)
+
+
+if __name__ == "__main__":
+    main()
