@@ -58,8 +58,9 @@ def main():
 
     n_chips = jax.device_count()
     # 512/chip saturates the v5e MXU pipeline (measured 1044 img/s @128 →
-    # 1530 @512); the reference's own large-batch regime goes to 8192 global
-    per_chip_batch = 512
+    # 1530 @512); the reference's own large-batch regime goes to 8192 global.
+    # Env-overridable for smaller-HBM parts and for CPU-mesh smoke runs.
+    per_chip_batch = int(os.environ.get("DTPU_BENCH_BATCH", "512"))
     global_batch = per_chip_batch * n_chips
 
     mesh = data_mesh(-1)

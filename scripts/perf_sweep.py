@@ -30,6 +30,7 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    from distribuuuu_tpu import optim
     from distribuuuu_tpu.benchutil import make_synthetic_batch
     from distribuuuu_tpu.models import build_model
     from distribuuuu_tpu.runtime import data_mesh
@@ -46,10 +47,10 @@ def main():
 
     for arch, batches in CASES:
         model = build_model(arch, num_classes=1000)
-        init_state, tx = create_train_state(model, jax.random.PRNGKey(0), mesh, 224)
-        step = make_train_step(model, tx, mesh, topk=5)
-        del init_state  # each batch size gets a fresh state below
+        # tx is state-free; building the step does not allocate device memory
+        step = make_train_step(model, optim.construct_optimizer(), mesh, topk=5)
         for B in batches[:1] if quick else batches:
+            state = batch = None
             try:
                 # state/batch construction inside the try: OOM at the larger
                 # rungs happens here as readily as inside the step
@@ -64,9 +65,12 @@ def main():
                     jax.device_get(m)
                 dt = (time.perf_counter() - t0) / iters
                 print(f"| {arch} | {B} | {dt * 1000:.1f} | {B / dt:.1f} |", flush=True)
-                del state, batch
             except Exception as e:  # OOM etc: report and continue the sweep
                 print(f"| {arch} | {B} | FAILED: {type(e).__name__} | — |", flush=True)
+            finally:
+                # release device memory even on the failure path, or a single
+                # OOM poisons every later row
+                del state, batch
 
 
 if __name__ == "__main__":
