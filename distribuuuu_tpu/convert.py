@@ -14,6 +14,16 @@ the reference keep their weights:
 - DenseNet ``features.denseblock{B}.denselayer{L}.*`` → ``block{B}_layer{L}``,
   transitions and the pre-1.0 dotted legacy names (``norm.1`` …) the
   reference also remaps.
+- BoTNet: the reference builds botnet50 as a bare ``nn.Sequential``
+  (`botnet.py:283-289`) so its checkpoints use numeric keys — ``0``=conv1,
+  ``1``=bn1, ``4/5/6``=layer1-3, ``7.net.{i}``=BoTBlocks, ``10``=fc; mapped
+  onto our named modules, incl. the MHSA qkv convs and rel-pos tables.
+  ``pretrained=True`` semantics (resnet50 trunk warm-start, `botnet.py:280`)
+  are provided by :func:`botnet50_trunk_from_resnet50`.
+- EfficientNet-B0 / RegNetX/Y: the reference gets these from **timm**
+  (`trainer.py:124-128`), so reference-trained checkpoints carry timm module
+  naming (``conv_stem``/``blocks.{s}.{b}``; ``s{k}.b{j}.conv{n}.conv`` …);
+  both are mapped here (timm ≥0.5 naming).
 
 Checkpoints saved by the *reference trainer* wrap the model dict under
 ``state_dict`` with a possible ``module.`` DDP prefix (`utils.py:360-363`) —
@@ -139,9 +149,217 @@ def _module_path(torch_key: str, arch: str) -> tuple[list[str] | None, str]:
     return mod, kind
 
 
+def _emit(params, batch_stats, path, torch_name, value, kind) -> None:
+    """Route one torch tensor into the params/batch_stats trees.
+
+    kind: ``conv`` (transpose OIHW→HWIO, bias kept as-is when present), ``bn``
+    (affine → scale/bias, stats → mean/var), ``linear`` (transpose), ``raw``
+    (copy as-is; ``path`` already includes the leaf name).
+    """
+    if kind == "conv":
+        if torch_name == "weight":
+            _set(params, path + ["kernel"], _conv_kernel(value))
+        elif torch_name == "bias":
+            _set(params, path + ["bias"], value)
+    elif kind == "bn":
+        if torch_name == "weight":
+            _set(params, path + ["scale"], value)
+        elif torch_name == "bias":
+            _set(params, path + ["bias"], value)
+        elif torch_name == "running_mean":
+            _set(batch_stats, path + ["mean"], value)
+        elif torch_name == "running_var":
+            _set(batch_stats, path + ["var"], value)
+    elif kind == "linear":
+        if torch_name == "weight":
+            _set(params, path + ["kernel"], value.T)
+        else:
+            _set(params, path + ["bias"], value)
+    elif kind == "raw":
+        _set(params, path, value)
+
+
+# reference botnet50 Sequential slots (`botnet.py:283-289`): 0=conv1 1=bn1
+# 2=relu 3=maxpool 4..6=layer1..3 7=BoTStack 8=avgpool 9=flatten 10=fc
+def _convert_botnet50(sd: Dict[str, np.ndarray]) -> dict:
+    params: dict = {}
+    batch_stats: dict = {}
+    # BoTBlock.net Sequential slots (`botnet.py:132-149`): 0=conv_in 1=bn_in
+    # 2=act 3=MHSA 4=avgpool/identity 5=bn_mid 6=act 7=conv_out 8=bn_out
+    net_slots = {
+        "0": ("conv_in", "conv"),
+        "1": ("bn_in", "bn"),
+        "5": ("bn_mid", "bn"),
+        "7": ("conv_out", "conv"),
+        "8": ("bn_out", "bn"),
+    }
+    for key, value in sd.items():
+        parts = key.split(".")
+        name = parts[-1]
+        if name == "num_batches_tracked":
+            continue
+        top = parts[0]
+        if top == "0":
+            _emit(params, batch_stats, ["conv1"], name, value, "conv")
+        elif top == "1":
+            _emit(params, batch_stats, ["bn1"], name, value, "bn")
+        elif top in ("4", "5", "6"):
+            block = [f"layer{int(top) - 3}_{parts[1]}"]
+            inner = parts[2]
+            if inner == "downsample":
+                mod, kind = ("ds_conv", "conv") if parts[3] == "0" else ("ds_bn", "bn")
+            else:
+                mod, kind = inner, ("bn" if inner.startswith("bn") else "conv")
+            _emit(params, batch_stats, block + [mod], name, value, kind)
+        elif top == "7":  # BoTStack: 7.net.{i}.(shortcut|net).…
+            block = [f"bot_{parts[2]}"]
+            if parts[3] == "shortcut":
+                mod, kind = ("sc_conv", "conv") if parts[4] == "0" else ("sc_bn", "bn")
+                _emit(params, batch_stats, block + [mod], name, value, kind)
+            else:
+                slot = parts[4]
+                if slot == "3":  # MHSA
+                    sub = parts[5]
+                    if sub in ("to_qk", "to_v"):
+                        _emit(params, batch_stats, block + ["mhsa", sub], name, value, "conv")
+                    else:  # pos_emb.{rel_height,rel_width,height,width}
+                        _emit(
+                            params, batch_stats,
+                            block + ["mhsa", "pos_emb", parts[6]], name, value, "raw",
+                        )
+                else:
+                    mod, kind = net_slots[slot]
+                    _emit(params, batch_stats, block + [mod], name, value, kind)
+        elif top == "10":
+            _emit(params, batch_stats, ["fc"], name, value, "linear")
+    return {"params": params, "batch_stats": batch_stats}
+
+
+def botnet50_trunk_from_resnet50(state_dict: Mapping[str, Any]) -> dict:
+    """Reference ``botnet50(pretrained=True)`` semantics (`botnet.py:275-290`):
+    the pretrained **resnet50 trunk** (conv1/bn1/layer1-3) is reused and the
+    BoTStack + classifier start fresh. Takes a torchvision/reference resnet50
+    state_dict and returns the *partial* converted tree (trunk modules only);
+    merge over freshly-initialized botnet50 variables with
+    :func:`merge_pretrained`."""
+    sd = _unwrap(state_dict)
+    trunk = {
+        k: v for k, v in sd.items()
+        if k.split(".")[0] in ("conv1", "bn1", "layer1", "layer2", "layer3")
+    }
+    if not trunk:
+        raise ValueError(
+            "state_dict has no resnet50 trunk keys (conv1/bn1/layer1-3) — "
+            "expected a torchvision/reference resnet50 checkpoint, got keys like "
+            f"{sorted(sd)[:3]}"
+        )
+    # trunk module names are identical between our resnet50 and botnet50
+    return convert_state_dict(trunk, "resnet50")
+
+
+def merge_pretrained(variables: Mapping, partial: Mapping) -> dict:
+    """Deep-merge a (possibly partial) converted tree over init variables."""
+    out = dict(variables)
+    for k, v in partial.items():
+        if k in out and isinstance(out[k], Mapping) and isinstance(v, Mapping):
+            out[k] = merge_pretrained(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+# timm efficientnet_b0 block-module naming → ours. Stage 0 is timm's
+# DepthwiseSeparableConv (no expansion); stages 1-6 are InvertedResidual.
+_EFFNET_DS = {
+    "conv_dw": ("dw_conv", "conv"),
+    "bn1": ("dw_bn", "bn"),
+    "conv_pw": ("project_conv", "conv"),
+    "bn2": ("project_bn", "bn"),
+}
+_EFFNET_IR = {
+    "conv_pw": ("expand_conv", "conv"),
+    "bn1": ("expand_bn", "bn"),
+    "conv_dw": ("dw_conv", "conv"),
+    "bn2": ("dw_bn", "bn"),
+    "conv_pwl": ("project_conv", "conv"),
+    "bn3": ("project_bn", "bn"),
+}
+
+
+def _convert_efficientnet(sd: Dict[str, np.ndarray]) -> dict:
+    params: dict = {}
+    batch_stats: dict = {}
+    for key, value in sd.items():
+        parts = key.split(".")
+        name = parts[-1]
+        if name == "num_batches_tracked":
+            continue
+        top = parts[0]
+        if top == "conv_stem":
+            _emit(params, batch_stats, ["stem_conv"], name, value, "conv")
+        elif top == "bn1":
+            _emit(params, batch_stats, ["stem_bn"], name, value, "bn")
+        elif top == "conv_head":
+            _emit(params, batch_stats, ["head_conv"], name, value, "conv")
+        elif top == "bn2":
+            _emit(params, batch_stats, ["head_bn"], name, value, "bn")
+        elif top == "classifier":
+            _emit(params, batch_stats, ["classifier"], name, value, "linear")
+        elif top == "blocks":
+            si, bi = int(parts[1]), int(parts[2])
+            block = [f"stage{si + 1}_block{bi + 1}"]
+            mod = parts[3]
+            if mod == "se":
+                sub = "reduce" if parts[4] == "conv_reduce" else "expand"
+                _emit(params, batch_stats, block + ["se", sub], name, value, "conv")
+            else:
+                tgt, kind = (_EFFNET_DS if si == 0 else _EFFNET_IR)[mod]
+                _emit(params, batch_stats, block + [tgt], name, value, kind)
+    return {"params": params, "batch_stats": batch_stats}
+
+
+def _convert_regnet(sd: Dict[str, np.ndarray]) -> dict:
+    """timm regnet naming: ``stem.conv/bn``, ``s{k}.b{j}.conv{n}.{conv,bn}``,
+    ``se.fc{1,2}``, ``downsample.{conv,bn}``, ``head.fc``."""
+    params: dict = {}
+    batch_stats: dict = {}
+    for key, value in sd.items():
+        parts = key.split(".")
+        name = parts[-1]
+        if name == "num_batches_tracked":
+            continue
+        top = parts[0]
+        if top == "stem":
+            mod, kind = ("stem_conv", "conv") if parts[1] == "conv" else ("stem_bn", "bn")
+            _emit(params, batch_stats, [mod], name, value, kind)
+        elif top == "head":
+            _emit(params, batch_stats, ["head_fc"], name, value, "linear")
+        elif re.fullmatch(r"s\d+", top):
+            stage, bi = int(top[1:]), int(parts[1].removeprefix("b"))
+            block = [f"stage{stage}_block{bi}"]
+            mod = parts[2]
+            if mod in ("conv1", "conv2", "conv3"):
+                n = mod[-1]
+                tgt, kind = (mod, "conv") if parts[3] == "conv" else (f"bn{n}", "bn")
+                _emit(params, batch_stats, block + [tgt], name, value, kind)
+            elif mod == "se":
+                sub = "reduce" if parts[3] == "fc1" else "expand"
+                _emit(params, batch_stats, block + ["se", sub], name, value, "conv")
+            elif mod == "downsample":
+                tgt, kind = ("sc_conv", "conv") if parts[3] == "conv" else ("sc_bn", "bn")
+                _emit(params, batch_stats, block + [tgt], name, value, kind)
+    return {"params": params, "batch_stats": batch_stats}
+
+
 def convert_state_dict(state_dict: Mapping[str, Any], arch: str) -> dict:
     """torch state_dict → ``{"params": ..., "batch_stats": ...}`` numpy trees."""
     sd = _unwrap(state_dict)
+    if arch == "botnet50":
+        return _convert_botnet50(sd)
+    if arch.startswith("efficientnet"):
+        return _convert_efficientnet(sd)
+    if arch.startswith("regnet"):
+        return _convert_regnet(sd)
     params: dict = {}
     batch_stats: dict = {}
     for key, value in sd.items():
@@ -164,10 +382,28 @@ def convert_state_dict(state_dict: Mapping[str, Any], arch: str) -> dict:
     return {"params": params, "batch_stats": batch_stats}
 
 
-def load_torch_file(path: str) -> Mapping[str, Any]:
+def load_torch_file(path: str, *, unsafe: bool = False) -> Mapping[str, Any]:
+    """Load a torch checkpoint with safe unpickling.
+
+    ``weights_only=True`` loads torchvision/timm state_dicts and reference
+    trainer checkpoints fine. Legacy pickles that need arbitrary-code
+    unpickling require an explicit ``unsafe=True`` opt-in (checkpoints from
+    untrusted sources can execute code on load otherwise).
+    """
+    import pickle
+
     import torch
 
-    return torch.load(path, map_location="cpu", weights_only=False)
+    try:
+        return torch.load(path, map_location="cpu", weights_only=True)
+    except (pickle.UnpicklingError, RuntimeError) as e:
+        if not unsafe:
+            raise RuntimeError(
+                f"{path} is not loadable with torch safe-unpickling "
+                "(weights_only=True). If you trust this file, retry with "
+                "--unsafe (load_torch_file(path, unsafe=True))."
+            ) from e
+        return torch.load(path, map_location="cpu", weights_only=False)
 
 
 def verify_against_model(converted: dict, arch: str, num_classes: int = 1000) -> None:

@@ -25,18 +25,48 @@ def main():
     ap.add_argument("--src", required=True, help="torch .pth/.pth.tar file")
     ap.add_argument("--dst", required=True, help="output Orbax checkpoint dir")
     ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument(
+        "--unsafe",
+        action="store_true",
+        help="allow torch legacy unpickling (weights_only=False) — only for trusted files",
+    )
+    ap.add_argument(
+        "--from-resnet50",
+        action="store_true",
+        help="botnet50 only: warm-start the trunk from a resnet50 checkpoint "
+        "(reference botnet50(pretrained=True) semantics); BoTStack + fc stay at init",
+    )
     args = ap.parse_args()
 
     import orbax.checkpoint as ocp
 
     from distribuuuu_tpu.convert import (
+        botnet50_trunk_from_resnet50,
         convert_state_dict,
         load_torch_file,
+        merge_pretrained,
         verify_against_model,
     )
 
-    sd = load_torch_file(args.src)
-    converted = convert_state_dict(sd, args.arch)
+    sd = load_torch_file(args.src, unsafe=args.unsafe)
+    if args.from_resnet50:
+        if args.arch != "botnet50":
+            raise SystemExit("--from-resnet50 only applies to --arch botnet50")
+        import jax.numpy as jnp
+
+        from distribuuuu_tpu.models import build_model
+
+        partial = botnet50_trunk_from_resnet50(sd)
+        model = build_model(args.arch, num_classes=args.num_classes)
+        init = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3), jnp.float32), train=False
+        )
+        import numpy as np
+
+        init = jax.tree.map(np.asarray, dict(init))
+        converted = merge_pretrained(init, partial)
+    else:
+        converted = convert_state_dict(sd, args.arch)
     verify_against_model(converted, args.arch, args.num_classes)
     ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
     ckptr.save(os.path.abspath(args.dst), converted, force=True)

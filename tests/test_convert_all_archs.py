@@ -1,0 +1,271 @@
+"""Whole-registry torch→flax conversion round-trip (VERDICT r1 item #3).
+
+For every registered arch we synthesize a torch-format state_dict from the
+model's own parameter tree via the *inverse* key mapping (flax path → torch
+checkpoint key + inverse layout transform), run the real converter over it,
+and require (a) exact tree/shape agreement with the model
+(``verify_against_model``) and (b) exact value round-trip per leaf — arange
+fills make any transpose or cross-wiring error show up as a value mismatch.
+
+Torch-side naming per family follows what reference users actually hold:
+torchvision naming for resnet/densenet (`/root/reference/distribuuuu/models/
+resnet.py:23-33`, `densenet.py:266-282`), the reference's own Sequential
+numbering for botnet50 (`botnet.py:283-289`), and timm (≥0.5) naming for
+efficientnet_b0/regnetx/y, which the reference pulls from timm
+(`trainer.py:124-128`).
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distribuuuu_tpu.convert import (
+    botnet50_trunk_from_resnet50,
+    convert_state_dict,
+    merge_pretrained,
+    verify_against_model,
+)
+from distribuuuu_tpu.models import build_model
+from distribuuuu_tpu.models.registry import list_models
+
+
+# ---------------------------------------------------------------------------
+# flax module path → torch checkpoint module prefix, per family
+# ---------------------------------------------------------------------------
+
+def _mod_resnet(mod):
+    parts = []
+    for p in mod:
+        m = re.fullmatch(r"(layer\d+)_(\d+)", p)
+        if m:
+            parts += [m.group(1), m.group(2)]
+        elif p == "ds_conv":
+            parts += ["downsample", "0"]
+        elif p == "ds_bn":
+            parts += ["downsample", "1"]
+        else:
+            parts.append(p)
+    return ".".join(parts)
+
+
+def _mod_densenet(mod):
+    parts = []
+    for p in mod:
+        m = re.fullmatch(r"block(\d+)_layer(\d+)", p)
+        t = re.fullmatch(r"trans(\d+)_(norm|conv)", p)
+        if m:
+            parts += [f"features.denseblock{m.group(1)}", f"denselayer{m.group(2)}"]
+        elif t:
+            parts.append(f"features.transition{t.group(1)}.{t.group(2)}")
+        elif p in ("conv0", "norm0", "norm5"):
+            parts.append(f"features.{p}")
+        else:
+            parts.append(p)
+    return ".".join(parts)
+
+
+_BOT_SLOTS = {
+    "sc_conv": "shortcut.0",
+    "sc_bn": "shortcut.1",
+    "conv_in": "net.0",
+    "bn_in": "net.1",
+    "bn_mid": "net.5",
+    "conv_out": "net.7",
+    "bn_out": "net.8",
+}
+
+
+def _mod_botnet(mod):
+    head = mod[0]
+    if head == "conv1":
+        return "0"
+    if head == "bn1":
+        return "1"
+    if head == "fc":
+        return "10"
+    m = re.fullmatch(r"layer(\d+)_(\d+)", head)
+    if m:
+        rest = _mod_resnet(mod[1:])
+        return f"{int(m.group(1)) + 3}.{m.group(2)}" + (f".{rest}" if rest else "")
+    b = re.fullmatch(r"bot_(\d+)", head)
+    assert b, mod
+    prefix = f"7.net.{b.group(1)}"
+    inner = mod[1]
+    if inner == "mhsa":
+        if mod[2] in ("to_qk", "to_v"):
+            return f"{prefix}.net.3.{mod[2]}"
+        return f"{prefix}.net.3.pos_emb"  # + raw leaf name appended by caller
+    return f"{prefix}.{_BOT_SLOTS[inner]}"
+
+
+_EFF_DS_INV = {"dw_conv": "conv_dw", "dw_bn": "bn1", "project_conv": "conv_pw", "project_bn": "bn2"}
+_EFF_IR_INV = {
+    "expand_conv": "conv_pw",
+    "expand_bn": "bn1",
+    "dw_conv": "conv_dw",
+    "dw_bn": "bn2",
+    "project_conv": "conv_pwl",
+    "project_bn": "bn3",
+}
+
+
+def _mod_efficientnet(mod):
+    head = mod[0]
+    flat = {
+        "stem_conv": "conv_stem",
+        "stem_bn": "bn1",
+        "head_conv": "conv_head",
+        "head_bn": "bn2",
+        "classifier": "classifier",
+    }
+    if head in flat:
+        return flat[head]
+    m = re.fullmatch(r"stage(\d+)_block(\d+)", head)
+    assert m, mod
+    prefix = f"blocks.{int(m.group(1)) - 1}.{int(m.group(2)) - 1}"
+    inner = mod[1]
+    if inner == "se":
+        return f"{prefix}.se.conv_{'reduce' if mod[2] == 'reduce' else 'expand'}"
+    inv = _EFF_DS_INV if m.group(1) == "1" else _EFF_IR_INV
+    return f"{prefix}.{inv[inner]}"
+
+
+def _mod_regnet(mod):
+    head = mod[0]
+    if head == "stem_conv":
+        return "stem.conv"
+    if head == "stem_bn":
+        return "stem.bn"
+    if head == "head_fc":
+        return "head.fc"
+    m = re.fullmatch(r"stage(\d+)_block(\d+)", head)
+    assert m, mod
+    prefix = f"s{m.group(1)}.b{m.group(2)}"
+    inner = mod[1]
+    if inner == "se":
+        return f"{prefix}.se.fc{'1' if mod[2] == 'reduce' else '2'}"
+    if inner == "sc_conv":
+        return f"{prefix}.downsample.conv"
+    if inner == "sc_bn":
+        return f"{prefix}.downsample.bn"
+    c = re.fullmatch(r"(conv|bn)(\d)", inner)
+    assert c, mod
+    return f"{prefix}.conv{c.group(2)}.{'conv' if c.group(1) == 'conv' else 'bn'}"
+
+
+def _family_inverse(arch):
+    if arch == "botnet50":
+        return _mod_botnet
+    if arch.startswith("densenet"):
+        return _mod_densenet
+    if arch.startswith("efficientnet"):
+        return _mod_efficientnet
+    if arch.startswith("regnet"):
+        return _mod_regnet
+    return _mod_resnet
+
+
+# ---------------------------------------------------------------------------
+# synthesize the torch sd from the model tree
+# ---------------------------------------------------------------------------
+
+_RAW_LEAVES = {"rel_height", "rel_width", "height", "width"}
+
+
+def _flatten(tree, prefix=()):
+    if hasattr(tree, "items"):
+        for k, v in tree.items():
+            yield from _flatten(v, prefix + (k,))
+    else:
+        yield prefix, tree
+
+
+def _model_tree(arch):
+    model = build_model(arch, dtype=jnp.float32)
+    return jax.eval_shape(
+        lambda k, x: model.init(k, x, train=False),
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 224, 224, 3), jnp.float32),
+    )
+
+
+def _synthesize(arch, tree):
+    """Returns (torch_sd, expected_flax_tree) with arange-valued leaves."""
+    mod_inv = _family_inverse(arch)
+    sd = {}
+    expected = {"params": {}, "batch_stats": {}}
+    idx = 0
+    for col in ("params", "batch_stats"):
+        for path, leaf in _flatten(tree.get(col, {})):
+            shape = tuple(leaf.shape)
+            val = (np.arange(int(np.prod(shape)), dtype=np.float32) + idx * 7.0).reshape(shape)
+            idx += 1
+            node = expected[col]
+            for p in path[:-1]:
+                node = node.setdefault(p, {})
+            node[path[-1]] = val
+
+            mod, leaf_name = list(path[:-1]), path[-1]
+            prefix = mod_inv(mod)
+            if leaf_name in _RAW_LEAVES:
+                sd[f"{prefix}.{leaf_name}"] = val
+            elif col == "batch_stats":
+                sd[f"{prefix}.running_{'mean' if leaf_name == 'mean' else 'var'}"] = val
+            elif leaf_name == "kernel":
+                tv = np.transpose(val, (3, 2, 0, 1)) if val.ndim == 4 else val.T
+                sd[f"{prefix}.weight"] = tv
+            elif leaf_name == "scale":
+                sd[f"{prefix}.weight"] = val
+            else:
+                assert leaf_name == "bias", (path, leaf_name)
+                sd[f"{prefix}.bias"] = val
+    return sd, expected
+
+
+def _assert_trees_equal(got, expected):
+    g = {("/".join(p)): v for p, v in _flatten(got)}
+    e = {("/".join(p)): v for p, v in _flatten(expected)}
+    assert g.keys() == e.keys(), (sorted(e.keys() - g.keys())[:5], sorted(g.keys() - e.keys())[:5])
+    for k, v in e.items():
+        np.testing.assert_array_equal(np.asarray(g[k]), v, err_msg=k)
+
+
+@pytest.mark.parametrize("arch", list_models())
+def test_convert_roundtrip(arch):
+    tree = _model_tree(arch)
+    sd, expected = _synthesize(arch, tree)
+    converted = convert_state_dict(sd, arch)
+    verify_against_model(converted, arch)
+    _assert_trees_equal(converted["params"], expected["params"])
+    _assert_trees_equal(converted["batch_stats"], expected["batch_stats"])
+
+
+def test_botnet50_trunk_warm_start():
+    """Reference ``botnet50(pretrained=True)``: resnet50 trunk reused, BoTStack
+    + classifier fresh (`/root/reference/distribuuuu/models/botnet.py:275-290`)."""
+    r50_tree = _model_tree("resnet50")
+    sd, r50_expected = _synthesize("resnet50", r50_tree)
+    partial = botnet50_trunk_from_resnet50(sd)
+
+    # trunk modules only — nothing from layer4 or the head may leak through
+    assert all(not k.startswith(("layer4", "fc")) for k in partial["params"])
+    assert {k for k in partial["params"] if k.startswith("layer3")}
+
+    bot_tree = _model_tree("botnet50")
+    zeros = {
+        col: jax.tree.map(lambda s: np.zeros(s.shape, np.float32), dict(bot_tree[col]))
+        for col in ("params", "batch_stats")
+    }
+    merged = merge_pretrained(zeros, partial)
+    verify_against_model(merged, "botnet50")
+    # trunk leaves carry the resnet50 values; BoTStack/head stay at init
+    np.testing.assert_array_equal(
+        np.asarray(merged["params"]["layer2_1"]["conv1"]["kernel"]),
+        r50_expected["params"]["layer2_1"]["conv1"]["kernel"],
+    )
+    assert np.all(np.asarray(merged["params"]["bot_0"]["conv_in"]["kernel"]) == 0)
+    assert np.all(np.asarray(merged["params"]["fc"]["kernel"]) == 0)
