@@ -19,6 +19,13 @@ fi
 
 python -m compileall -q distribuuuu_tpu tests tutorial scripts *.py || fail=1
 
-python -m pytest tests/ -x -q || fail=1
+# Fast tier by default (the slow tier adds ~7 min of true multi-process
+# training + real-JPEG learning): run `DTPU_PRECOMMIT_SLOW=1 bash
+# .dev/pre-commit.sh` before cutting a release to include them.
+if [ "${DTPU_PRECOMMIT_SLOW:-0}" = "1" ]; then
+  python -m pytest tests/ -x -q || fail=1
+else
+  python -m pytest tests/ -x -q -m "not slow" || fail=1
+fi
 
 exit $fail

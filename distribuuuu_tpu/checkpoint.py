@@ -3,16 +3,24 @@
 Contract replicated from `/root/reference/distribuuuu/utils.py:319-410`:
 
 - per-epoch checkpoints under ``OUT_DIR/checkpoints/`` named ``ckpt_ep_{E:03d}``
-  (Orbax directories instead of ``.pth.tar`` files)
+  (Orbax directories instead of ``.pth.tar`` files); after finishing 0-based
+  epoch ``E`` the file is named ``E+1`` while the payload records ``E``,
+  exactly like the reference (`utils.py:374-384`: ``get_checkpoint(epoch + 1)``
+  with ``{"epoch": epoch}``) — so the first checkpoint is ``ckpt_ep_001``
 - saved payload: epoch, model state (params + batch_stats — already "unwrapped";
   there is no DDP wrapper to strip in SPMD), optimizer state, best_acc1
 - ``best`` holds weights-only state on Acc@1 improvement (`utils.py:386-387`)
 - auto-resume picks the highest-numbered checkpoint (`utils.py:337-342`)
 - loading a weights-only checkpoint for eval works (`utils.py:406-410`)
 
-Writes go through Orbax (async-capable, multi-host aware: every process calls
-save, Orbax coordinates so the write happens once — the analog of the
-reference's rank-0-only save gate at `utils.py:369-370`).
+Writes go through Orbax **async** checkpointing (SURVEY §5/§7): ``save``
+snapshots the arrays then returns, the serialize+commit runs on a background
+thread, so the mesh never stalls at an epoch boundary waiting on disk. At
+most one save per target is in flight (the next save waits for the previous),
+and `wait_for_saves()` blocks until everything is durable — the trainer calls
+it before exiting. Multi-host aware: every process calls save, Orbax
+coordinates so the write happens once — the analog of the reference's
+rank-0-only save gate at `utils.py:369-370`.
 """
 
 from __future__ import annotations
@@ -72,12 +80,28 @@ def get_last_checkpoint(out_dir: str) -> str:
     return ckpts[-1][1]
 
 
-def _checkpointer() -> ocp.Checkpointer:
-    return ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+# Two async checkpointers so an epoch save and a ``best`` refresh can be in
+# flight concurrently; each serializes with itself (wait before next save).
+_CKPTRS: dict[str, ocp.AsyncCheckpointer] = {}
+
+
+def _checkpointer(which: str = "epoch") -> ocp.AsyncCheckpointer:
+    if which not in _CKPTRS:
+        _CKPTRS[which] = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+    return _CKPTRS[which]
+
+
+def wait_for_saves() -> None:
+    """Block until every in-flight async save is committed to disk."""
+    for c in _CKPTRS.values():
+        c.wait_until_finished()
 
 
 def save_checkpoint(out_dir: str, epoch: int, state: Any, best_acc1: float, is_best: bool) -> str:
-    """Save a full training checkpoint; refresh ``best`` on improvement."""
+    """Start an async save of a full training checkpoint; refresh ``best`` on
+    improvement. Returns once device arrays are snapshotted (the expensive
+    serialize+write happens in the background). ``epoch`` is the 0-based epoch
+    just finished; the file is named ``epoch+1`` per the reference contract."""
     payload = {
         "epoch": np.int32(epoch),
         "params": state.params,
@@ -85,11 +109,14 @@ def save_checkpoint(out_dir: str, epoch: int, state: Any, best_acc1: float, is_b
         "opt_state": state.opt_state,
         "best_acc1": np.float32(best_acc1),
     }
-    path = get_checkpoint_path(out_dir, epoch)
-    ckptr = _checkpointer()
+    path = get_checkpoint_path(out_dir, epoch + 1)
+    ckptr = _checkpointer("epoch")
+    ckptr.wait_until_finished()  # ≤1 in flight; no-op when idle
     ckptr.save(path, payload, force=True)
     if is_best:
-        ckptr.save(
+        best = _checkpointer("best")
+        best.wait_until_finished()
+        best.save(
             get_best_path(out_dir),
             {"params": state.params, "batch_stats": state.batch_stats},
             force=True,
@@ -106,6 +133,7 @@ def load_checkpoint(path: str, state: Any, load_opt: bool = True):
     knob, reference `trainer.py:147-149`). Restored arrays adopt the sharding
     of the templates in ``state``.
     """
+    wait_for_saves()  # the path may be a save still committing in background
     ckptr = _checkpointer()
     meta = ckptr.metadata(path)
     names = set(meta.item_metadata.tree.keys()) if hasattr(meta, "item_metadata") else set(

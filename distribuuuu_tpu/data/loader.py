@@ -247,6 +247,9 @@ def construct_train_loader():
     step_batch = cfg.TRAIN.BATCH_SIZE * cfg.TRAIN.ACCUM_STEPS
     host_batch = step_batch * local_dev
     if cfg.MODEL.DUMMY_INPUT:
+        # ~1000 synthetic samples per epoch, like the reference's DummyDataset
+        # (`utils.py:109-118`). At global batches >1000 this floors to a
+        # single step per epoch — fine for the smoke/bench role this serves.
         return DummyLoader(
             host_batch,
             cfg.TRAIN.IM_SIZE,

@@ -13,6 +13,7 @@ iterations, so the TPU never stalls on metrics.
 from __future__ import annotations
 
 import datetime
+import time
 
 import jax
 import jax.numpy as jnp
@@ -65,7 +66,15 @@ def cross_entropy_loss(
 
 
 class AverageMeter:
-    """Running average of a scalar (reference `utils.py:199-221`)."""
+    """Running average of a scalar.
+
+    The classic PyTorch-examples meter interface (``val``/``avg``/``sum``/
+    ``count``, ``update(val, n)``), which the reference also uses
+    (`utils.py:199-221`) — kept API-compatible because downstream tooling
+    greps these log fields. ``avg``/``val`` are writable for callers that
+    track exact on-device totals and only mirror them here for display
+    (see ``validate``).
+    """
 
     def __init__(self, name: str, fmt: str = ":f"):
         self.name = name
@@ -73,9 +82,7 @@ class AverageMeter:
         self.reset()
 
     def reset(self):
-        self.val = 0.0
-        self.avg = 0.0
-        self.sum = 0.0
+        self.val = self.avg = self.sum = 0.0
         self.count = 0
 
     def update(self, val: float, n: int = 1):
@@ -97,21 +104,47 @@ class ProgressMeter:
         self.num_batches = num_batches
         self.meters = meters
         self.prefix = prefix
+        self._run = None  # (tic, cur_epoch, start_epoch, max_epoch)
+
+    def configure_run_eta(
+        self, *, tic: float, cur_epoch: int, start_epoch: int, max_epoch: int
+    ) -> None:
+        """Enable whole-run ETA: extrapolate across remaining *epochs* from
+        time elapsed since ``tic`` (≈ reference ``cal_eta``, `utils.py:246-252`,
+        incl. its resume-awareness: the rate is measured only over epochs run
+        in this process)."""
+        self._run = (tic, cur_epoch, start_epoch, max_epoch)
 
     def display(self, batch: int):
         entries = [self.prefix + self.batch_fmtstr.format(batch)]
         entries += [str(meter) for meter in self.meters]
         entries.append(self.cal_eta(batch))
+        run_eta = self.cal_run_eta(batch)
+        if run_eta:
+            entries.append(run_eta)
         logger.info("  ".join(entries))
 
     def cal_eta(self, batch: int) -> str:
-        """Extrapolate remaining time from the running avg batch time."""
+        """Extrapolate this epoch's remaining time from avg batch time."""
         time_meter = next((m for m in self.meters if m.name == "Time"), None)
         if time_meter is None or batch == 0:
             return "ETA: N/A"
         remain = max(self.num_batches - batch, 0)
         seconds = int(time_meter.avg * remain)
         return f"ETA: {datetime.timedelta(seconds=seconds)}"
+
+    def cal_run_eta(self, batch: int) -> str | None:
+        """Whole-run ETA across remaining epochs (reference `utils.py:246-252`)."""
+        if self._run is None:
+            return None
+        tic, cur_epoch, start_epoch, max_epoch = self._run
+        frac = batch / max(self.num_batches, 1)
+        ratio_running = (cur_epoch - start_epoch + frac) / max_epoch
+        if ratio_running <= 0:
+            return "ETA(run): N/A"
+        ratio_remaining = 1.0 - (cur_epoch + frac) / max_epoch
+        seconds = round((time.time() - tic) / ratio_running * max(ratio_remaining, 0.0))
+        return f"ETA(run): {datetime.timedelta(seconds=seconds)}"
 
     @staticmethod
     def _get_batch_fmtstr(num_batches: int) -> str:

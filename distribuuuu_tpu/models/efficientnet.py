@@ -46,8 +46,11 @@ _B0_STAGES = [
 
 
 def _bn(train: bool, axis_name: str | None, name: str) -> nn.BatchNorm:
-    # EfficientNet uses eps 1e-3 / torch momentum 0.01 (flax 0.99)
-    return batch_norm(train=train, axis_name=axis_name, name=name, momentum=0.99, epsilon=1e-3)
+    # BN hyperparams track the baseline source: the reference obtains
+    # efficientnet_b0 from *timm*, whose plain (non-tf_) variant uses torch
+    # defaults — momentum 0.1 (flax 0.9) and eps 1e-5. The TF-paper pair
+    # (0.99 / 1e-3) belongs to timm's tf_efficientnet_* weights only.
+    return batch_norm(train=train, axis_name=axis_name, name=name, momentum=0.9)
 
 
 class MBConv(nn.Module):

@@ -270,7 +270,10 @@ def _pretrained_path() -> str:
 # Epoch loops (reference `train_epoch`/`validate`, `trainer.py:14-103`)
 # ---------------------------------------------------------------------------
 
-def train_epoch(loader, mesh, train_step, state, epoch: int, rng, is_primary: bool):
+def train_epoch(
+    loader, mesh, train_step, state, epoch: int, rng, is_primary: bool,
+    start_epoch: int = 0, run_tic: float | None = None,
+):
     lr = optim.get_epoch_lr(epoch)
     if is_primary:
         logger.info(f"Epoch[{epoch}] current learning rate: {lr:.6f}")
@@ -279,6 +282,13 @@ def train_epoch(loader, mesh, train_step, state, epoch: int, rng, is_primary: bo
     topk = cfg.TRAIN.TOPK
     batch_time, data_time, losses, top1, topk_m, progress = construct_meters(
         len(loader), prefix=f"Epoch[{epoch}] ", topk=topk
+    )
+    # whole-run ETA across remaining epochs (reference cal_eta, utils.py:246-252)
+    progress.configure_run_eta(
+        tic=run_tic if run_tic is not None else time.time(),
+        cur_epoch=epoch,
+        start_epoch=start_epoch,
+        max_epoch=cfg.OPTIM.MAX_EPOCH,
     )
 
     profile = cfg.TRAIN.PROFILE and epoch == 0 and is_primary
@@ -300,7 +310,8 @@ def train_epoch(loader, mesh, train_step, state, epoch: int, rng, is_primary: bo
             jax.profiler.stop_trace()
             logger.info(f"Wrote profiler trace to {cfg.OUT_DIR}/profile")
             trace_active = False
-        step_rng = jax.random.fold_in(rng, epoch * 100_000 + it)
+        # two-level fold: no collisions however long the epoch runs
+        step_rng = jax.random.fold_in(jax.random.fold_in(rng, epoch), it)
         state, m = train_step(state, batch, lr_arr, step_rng)
         window.append(m)
         if it % cfg.TRAIN.PRINT_FREQ == 0 or it == len(loader) - 1:
@@ -342,11 +353,20 @@ def validate(loader, mesh, eval_step, state, is_primary: bool, print_freq=None, 
     )
     totals = zero_metrics(topk, mesh)
     t_end = time.time()
+    t_window = t_end
+    window_n = 0
     for it, batch in enumerate(prefetch_to_device(loader, mesh, cfg.TRAIN.PREFETCH)):
         data_time.update(time.time() - t_end)
         totals = eval_step(state, batch, totals)
+        window_n += 1
         if it % print_freq == 0 or it == len(loader) - 1:
             vals = jax.device_get(totals)  # sync point
+            # charge the whole window's wall time across its steps so the
+            # Time average is true step time, not just print-boundary steps
+            now = time.time()
+            batch_time.update((now - t_window) / window_n, n=window_n)
+            t_window = now
+            window_n = 0
             n = max(vals["n"], 1.0)
             losses.avg = float(vals["loss_sum"] / n)
             losses.val = losses.avg
@@ -354,7 +374,6 @@ def validate(loader, mesh, eval_step, state, is_primary: bool, print_freq=None, 
             top1.val = top1.avg
             topk_m.avg = float(100.0 * vals[f"correct{topk}"] / n)
             topk_m.val = topk_m.avg
-            batch_time.update(time.time() - t_end)
             if is_primary:
                 progress.display(it)
         t_end = time.time()
@@ -397,9 +416,9 @@ def train_model():
         )
     model = _build_cfg_model()
     init_key, dropout_key = jax.random.split(key)
-    # init_key is host-identical (replicated params); the dropout stream is
-    # diversified per host here and per device inside the step (axis_index).
-    dropout_key = jax.random.fold_in(dropout_key, info.process_index)
+    # Both keys must be host-identical: multi-controller JAX requires every
+    # process to pass the same value for replicated (P()) jit inputs. Per-
+    # device dropout diversity comes from fold_in(axis_index) inside the step.
     state, tx = create_train_state(model, init_key, mesh, cfg.TRAIN.IM_SIZE)
     logger.info(f"Model:\n{cfg.MODEL.ARCH}")
     logger.info(f"Params(M): {count_parameters(state.params):.3f}")
@@ -425,15 +444,18 @@ def train_model():
         state, _, _ = ckpt.load_checkpoint(_pretrained_path(), state, load_opt=False)
         logger.info(f"Initialized from pretrained weights ({cfg.MODEL.ARCH})")
 
+    run_tic = time.time()
     for epoch in range(start_epoch, cfg.OPTIM.MAX_EPOCH):
         state = train_epoch(
-            train_loader, mesh, train_step, state, epoch, dropout_key, info.is_primary
+            train_loader, mesh, train_step, state, epoch, dropout_key,
+            info.is_primary, start_epoch=start_epoch, run_tic=run_tic,
         )
         acc1, _ = validate(val_loader, mesh, eval_step, state, info.is_primary)
         is_best = acc1 > best_acc1
         best_acc1 = max(acc1, best_acc1)
         path = ckpt.save_checkpoint(cfg.OUT_DIR, epoch, state, best_acc1, is_best)
-        logger.info(f"Saved checkpoint: {path} (best Acc@1 {best_acc1:.3f})")
+        logger.info(f"Saving checkpoint (async): {path} (best Acc@1 {best_acc1:.3f})")
+    ckpt.wait_for_saves()  # don't exit with a checkpoint mid-commit
     return state
 
 

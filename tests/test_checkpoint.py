@@ -27,6 +27,10 @@ def test_naming_contract(tmp_path):
 def test_save_load_roundtrip(tmp_path, tiny_state):
     out = str(tmp_path)
     path = ckpt.save_checkpoint(out, 3, tiny_state, best_acc1=12.5, is_best=True)
+    # reference naming: finishing 0-based epoch 3 writes ckpt_ep_004
+    # (`/root/reference/distribuuuu/utils.py:381-384`)
+    assert path.endswith("ckpt_ep_004")
+    ckpt.wait_for_saves()
     assert os.path.isdir(path)
     assert ckpt.has_checkpoint(out)
     assert ckpt.get_last_checkpoint(out) == path
@@ -68,9 +72,10 @@ def test_resume_ignores_orbax_tmp_dirs(tmp_path, tiny_state):
     """A killed run's in-progress temp dir must never win the resume scan."""
     out = str(tmp_path)
     ckpt.save_checkpoint(out, 4, tiny_state, best_acc1=1.0, is_best=False)
+    ckpt.wait_for_saves()
     d = ckpt.get_checkpoint_dir(out)
     os.makedirs(os.path.join(d, "ckpt_ep_009.orbax-checkpoint-tmp-1234567890"))
-    assert ckpt.get_last_checkpoint(out).endswith("ckpt_ep_004")
+    assert ckpt.get_last_checkpoint(out).endswith("ckpt_ep_005")
 
     # tmp dirs alone ≠ resumable state
     empty = str(tmp_path / "fresh")
@@ -82,4 +87,32 @@ def test_highest_epoch_wins(tmp_path, tiny_state):
     out = str(tmp_path)
     for e in (0, 2, 10):
         ckpt.save_checkpoint(out, e, tiny_state, best_acc1=0.0, is_best=False)
-    assert ckpt.get_last_checkpoint(out).endswith("ckpt_ep_010")
+    ckpt.wait_for_saves()
+    assert ckpt.get_last_checkpoint(out).endswith("ckpt_ep_011")
+
+
+def test_async_saves_commit_and_roundtrip(tmp_path):
+    """Epoch-boundary stall fix (VERDICT r1 weak #5): saves run on Orbax
+    AsyncCheckpointer threads; back-to-back saves + a load interleave safely
+    and everything is durable after wait_for_saves()."""
+    import orbax.checkpoint as ocp
+
+    assert isinstance(ckpt._checkpointer("epoch"), ocp.AsyncCheckpointer)
+    assert isinstance(ckpt._checkpointer("best"), ocp.AsyncCheckpointer)
+
+    out = str(tmp_path)
+    big = TrainState(
+        params={"w": jnp.ones((512, 2048))},  # ~4MB: enough to have a write phase
+        batch_stats={},
+        opt_state={"momentum": {"w": jnp.zeros((512, 2048))}},
+    )
+    # back-to-back epoch saves (second must wait for first, not crash) with a
+    # best refresh in flight concurrently
+    ckpt.save_checkpoint(out, 0, big, best_acc1=1.0, is_best=True)
+    path = ckpt.save_checkpoint(out, 1, big, best_acc1=2.0, is_best=False)
+    # load without an explicit wait: load_checkpoint waits internally
+    blank = jax.tree.map(jnp.zeros_like, big)
+    restored, start_epoch, best = ckpt.load_checkpoint(path, blank)
+    assert start_epoch == 2 and best == 2.0
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]), np.ones((512, 2048)))
+    assert os.path.isdir(ckpt.get_best_path(out))

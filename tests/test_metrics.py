@@ -54,3 +54,26 @@ def test_progress_meter_eta():
     t.update(2.0)
     p = ProgressMeter(100, [t], prefix="Test: ")
     assert "0:03:" in p.cal_eta(10)  # 90 batches * 2s = 180s
+
+
+def test_progress_meter_run_eta(monkeypatch):
+    """Whole-run ETA extrapolates over remaining epochs (reference
+    `utils.py:246-252`), resume-aware: rate measured since start_epoch."""
+    import time as time_mod
+
+    from distribuuuu_tpu import metrics as metrics_mod
+
+    p = ProgressMeter(100, [], prefix="Epoch[5] ")
+    assert p.cal_run_eta(10) is None  # not configured (eval loops)
+
+    # resumed at epoch 4; now mid-epoch 5 of 10; 600s elapsed since resume.
+    # work done since tic = 1.5 epochs; remaining = 10 - 5.5 = 4.5 epochs
+    # → rate 400 s/epoch → ETA 1800s = 0:30:00
+    monkeypatch.setattr(metrics_mod.time, "time", lambda: 1600.0)
+    p.configure_run_eta(tic=1000.0, cur_epoch=5, start_epoch=4, max_epoch=10)
+    assert p.cal_run_eta(50) == "ETA(run): 0:30:00"
+
+    # epoch 0, batch 0: no information yet
+    p.configure_run_eta(tic=1600.0, cur_epoch=0, start_epoch=0, max_epoch=10)
+    assert p.cal_run_eta(0) == "ETA(run): N/A"
+    del time_mod

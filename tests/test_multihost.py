@@ -75,7 +75,7 @@ def test_two_process_training(tmp_path):
         assert rcs[rank] == 0, f"rank {rank} failed:\n{text[-3000:]}"
     r0 = open(tmp_path / "rank0.log").read()
     assert "2 hosts" in r0, r0[-2000:]
-    assert "Saved checkpoint" in r0
+    assert "Saving checkpoint (async)" in r0
     # checkpoint written exactly once, complete
     ckpts = os.listdir(out_dir / "checkpoints")
-    assert any(c == "ckpt_ep_000" for c in ckpts), ckpts
+    assert any(c == "ckpt_ep_001" for c in ckpts), ckpts

@@ -1,0 +1,164 @@
+"""Tutorial 7 — long-context training with ring attention (sequence parallelism).
+
+Rungs 1-6 mirror the reference ladder (`/root/reference/tutorial/`), which
+stops at data parallelism — the reference has no long-context story at all
+(SURVEY §5). This rung is the TPU-native extension: when one device cannot
+hold a full sequence's activations, shard the *sequence* over a mesh axis and
+attend with a ring — K/V blocks hop neighbor-to-neighbor (`lax.ppermute`
+rides the ICI torus) while each device folds them into an online softmax, so
+the full L×L score matrix never exists anywhere.
+
+What this teaches, in one file:
+
+- a 2-D mesh ``{"data": -1, "seq": 4}`` (`create_mesh`): batch sharded over
+  ``data``, tokens sharded over ``seq``, parameters replicated
+- `ring_attention(..., causal=True)` from `distribuuuu_tpu.parallel` inside
+  `shard_map` — exact causal attention; masking uses *global* token positions
+  recovered from `lax.axis_index("seq")`
+- gradients flow straight through the ring (ppermute/fori_loop are
+  differentiable); grads are `psum`-ed over **both** axes, so training is
+  identical to a single big device
+
+Train a 2-layer causal transformer LM on a next-token task (token t+1 =
+token t + 1 mod vocab) over 512-token sequences, 4-way sequence-sharded.
+Run on the fake 8-chip CPU mesh:
+
+    python ../scripts/cpu_mesh_run.py long_context_ring.py
+
+Expected output (CPU mesh, 2×4 data×seq, seeded — loss to ~0 as the model
+learns the successor rule):
+
+    mesh: data=2 seq=4 | params: 0.135M | tokens/step: 8192 (128 per seq shard)
+    step   0  loss 4.1808
+    step  20  loss 0.4818
+    step  40  loss 0.1693
+    step  60  loss 0.0947
+    step  80  loss 0.0639
+    step 100  loss 0.0477
+    final loss 0.0477 (< 0.2: the ring learned long-range structure)
+"""
+
+import functools
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from distribuuuu_tpu.parallel import ring_attention  # noqa: E402
+from distribuuuu_tpu.runtime import create_mesh  # noqa: E402
+
+VOCAB, D_MODEL, HEADS, LAYERS = 64, 64, 2, 2
+SEQ, BATCH, STEPS, LR = 512, 16, 101, 0.5
+
+
+def init_params(key):
+    def normal(key, *shape, scale=0.02):
+        return scale * jax.random.normal(key, shape, jnp.float32)
+
+    keys = iter(jax.random.split(key, 2 + 4 * LAYERS))
+    params = {
+        "embed": normal(next(keys), VOCAB, D_MODEL),
+        "pos": normal(next(keys), SEQ, D_MODEL),
+        "layers": [
+            {
+                "wqkv": normal(next(keys), D_MODEL, 3 * D_MODEL),
+                "wo": normal(next(keys), D_MODEL, D_MODEL),
+                "w1": normal(next(keys), D_MODEL, 4 * D_MODEL),
+                "w2": normal(next(keys), 4 * D_MODEL, D_MODEL),
+            }
+            for _ in range(LAYERS)
+        ],
+    }
+    return params
+
+
+def layernorm(x):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-6)
+
+
+def forward(params, tokens):
+    """Runs INSIDE shard_map: tokens [b_local, l_local] — one sequence shard."""
+    b, l_local = tokens.shape
+    # global token positions of this shard, for the positional table
+    gpos = jax.lax.axis_index("seq") * l_local + jnp.arange(l_local)
+    x = params["embed"][tokens] + params["pos"][gpos]
+    for lyr in params["layers"]:
+        h = layernorm(x)
+        qkv = h @ lyr["wqkv"]  # [b, l, 3D]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):  # [b, l, D] → [b, H, l, D/H]
+            return t.reshape(b, l_local, HEADS, D_MODEL // HEADS).transpose(0, 2, 1, 3)
+
+        a = ring_attention(heads(q), heads(k), heads(v), axis_name="seq", causal=True)
+        a = a.transpose(0, 2, 1, 3).reshape(b, l_local, D_MODEL)
+        x = x + a @ lyr["wo"]
+        x = x + jax.nn.relu(layernorm(x) @ lyr["w1"]) @ lyr["w2"]
+    return layernorm(x) @ params["embed"].T  # weight-tied readout
+
+
+def train_step(params, tokens, targets):
+    global_tokens = BATCH * SEQ
+
+    def loss_fn(p):
+        logits = forward(p, tokens)
+        ll = jax.nn.log_softmax(logits, axis=-1)
+        ce = -jnp.take_along_axis(ll, targets[..., None], axis=-1)
+        return jnp.sum(ce) / global_tokens  # local partial of the global mean
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    # sum partials over BOTH axes → exact global loss/grads, then plain SGD
+    loss = jax.lax.psum(loss, ("data", "seq"))
+    grads = jax.tree.map(lambda g: jax.lax.psum(g, ("data", "seq")), grads)
+    params = jax.tree.map(lambda p, g: p - LR * g, params, grads)
+    return params, loss
+
+
+def make_batch(rng):
+    """Successor-rule sequences: t+1 = (t + 1) % VOCAB from a random start."""
+    start = rng.integers(0, VOCAB, size=(BATCH, 1))
+    seq = (start + np.arange(SEQ + 1)) % VOCAB
+    return jnp.asarray(seq[:, :-1]), jnp.asarray(seq[:, 1:])
+
+
+def main():
+    mesh = create_mesh({"data": -1, "seq": 4})
+    n_data = mesh.shape["data"]
+    params = init_params(jax.random.PRNGKey(0))
+    n_params = sum(p.size for p in jax.tree.leaves(params)) / 1e6
+    print(
+        f"mesh: data={n_data} seq={mesh.shape['seq']} | params: {n_params:.3f}M "
+        f"| tokens/step: {BATCH * SEQ} ({SEQ // mesh.shape['seq']} per seq shard)"
+    )
+
+    step = jax.jit(
+        jax.shard_map(
+            train_step,
+            mesh=mesh,
+            in_specs=(P(), P("data", "seq"), P("data", "seq")),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+
+    rng = np.random.default_rng(0)
+    loss = None
+    for i in range(STEPS):
+        tokens, targets = make_batch(rng)
+        params, loss = step(params, tokens, targets)
+        if i % 20 == 0:
+            print(f"step {i:3d}  loss {float(loss):.4f}")
+    final = float(loss)
+    print(f"final loss {final:.4f} ({'<' if final < 0.2 else '>='} 0.2: "
+          "the ring learned long-range structure)")
+    return final
+
+
+if __name__ == "__main__":
+    main()
