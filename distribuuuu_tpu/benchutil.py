@@ -8,11 +8,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 
 def make_synthetic_batch(mesh, global_batch: int, im_size: int = 224, seed: int = 0):
-    """Synthetic sharded train batch with the loader's exact field contract."""
+    """Synthetic sharded train batch with the loader's exact field contract
+    (raw u8 images — the real H2D payload; normalize runs inside the step)."""
     rng = np.random.default_rng(seed)
     return {
         "image": jax.device_put(
-            rng.standard_normal((global_batch, im_size, im_size, 3)).astype(np.float32),
+            rng.integers(0, 256, (global_batch, im_size, im_size, 3), dtype=np.uint8),
             NamedSharding(mesh, P("data", None, None, None)),
         ),
         "label": jax.device_put(

@@ -53,9 +53,10 @@ class ImageFolder:
 class DummyDataset:
     """Random-pixel dataset with label 0 (reference `utils.py:109-118`).
 
-    Images are pre-normalized float32 so the loader can skip decode/augment
-    entirely — this measures the pure compute path, which is exactly what the
-    reference uses DUMMY_INPUT for.
+    Images are raw u8 like the real loader's batches, so DUMMY_INPUT smoke
+    runs exercise the same H2D copy + on-device normalize as real training —
+    it measures the pure compute path, which is exactly what the reference
+    uses DUMMY_INPUT for.
     """
 
     def __init__(self, length: int = 1000, im_size: int = 224, seed: int = 0):
@@ -65,8 +66,8 @@ class DummyDataset:
 
     def sample_batch(self, batch_size: int) -> dict:
         return {
-            "image": self._rng.standard_normal(
-                (batch_size, self.im_size, self.im_size, 3), dtype=np.float32
+            "image": self._rng.integers(
+                0, 256, (batch_size, self.im_size, self.im_size, 3), dtype=np.uint8
             ),
             "label": np.zeros((batch_size,), dtype=np.int32),
             "weight": np.ones((batch_size,), dtype=np.float32),

@@ -13,8 +13,8 @@ pads val shards by *double-counting* tail samples, biasing reported accuracy.
 Here padded samples carry ``weight 0`` and the metrics divide by the true
 sample count — exact distributed evaluation.
 
-Batches are dicts of numpy arrays ``{image: (B,H,W,3) f32, label: (B,) i32,
-weight: (B,) f32}`` where B is the *host* batch (per-device batch ×
+Batches are dicts of numpy arrays ``{image: (B,H,W,3) u8 raw RGB, label: (B,)
+i32, weight: (B,) f32}`` where B is the *host* batch (per-device batch ×
 local device count). A producer thread decodes ahead (thread pool — PIL
 releases the GIL during JPEG decode) into a bounded queue; `prefetch_to_device`
 then keeps TRAIN.PREFETCH global device batches in flight so H2D copy overlaps
@@ -37,7 +37,7 @@ from PIL import Image
 from distribuuuu_tpu.config import cfg, get_default
 from distribuuuu_tpu.data import native
 from distribuuuu_tpu.data.dataset import DummyDataset, ImageFolder
-from distribuuuu_tpu.data.transforms import eval_transform, train_transform
+from distribuuuu_tpu.data.transforms import eval_transform_u8, train_transform_u8
 
 
 class _ProducerError:
@@ -122,23 +122,25 @@ class HostDataLoader:
     def _load_one(self, idx: int, slot_seed: int):
         if idx < 0:  # eval padding slot: zero image, weight 0 (masked in metrics)
             size = self.im_size if self.train else self.crop_size
-            return np.zeros((size, size, 3), dtype=np.float32), 0, 0.0
+            return np.zeros((size, size, 3), dtype=np.uint8), 0, 0.0
         path, label = self.dataset.samples[idx]
         if self.use_native and path.lower().endswith((".jpg", ".jpeg")):
             # C++ decode+transform, GIL-free (native/dtpu_decode.cc); falls
-            # through to PIL on decode failure (e.g. odd colorspace)
+            # through to PIL on decode failure (e.g. odd colorspace). Raw u8
+            # out — normalization happens on-device (transforms.device_normalize)
+            # so the H2D copy is 4x smaller than shipping float32.
             if self.train:
-                arr = native.decode_train(path, self.im_size, slot_seed)
+                arr = native.decode_train_u8(path, self.im_size, slot_seed)
             else:
-                arr = native.decode_eval(path, self.im_size, self.crop_size)
+                arr = native.decode_eval_u8(path, self.im_size, self.crop_size)
             if arr is not None:
                 return arr, label, 1.0
         with Image.open(path) as im:
             im = im.convert("RGB")
             if self.train:
-                arr = train_transform(im, self.im_size, rng=random.Random(slot_seed))
+                arr = train_transform_u8(im, self.im_size, rng=random.Random(slot_seed))
             else:
-                arr = eval_transform(im, self.im_size, self.crop_size)
+                arr = eval_transform_u8(im, self.im_size, self.crop_size)
         return arr, label, 1.0
 
     def _qput(self, out_q: queue.Queue, item, stop: threading.Event) -> bool:

@@ -40,6 +40,16 @@ def _load():
             ctypes.POINTER(ctypes.c_float),
         ]
         lib.dtpu_decode_train.restype = ctypes.c_int
+        lib.dtpu_decode_train_u8.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.dtpu_decode_train_u8.restype = ctypes.c_int
+        lib.dtpu_decode_eval_u8.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.dtpu_decode_eval_u8.restype = ctypes.c_int
         _lib = lib
     return _lib
 
@@ -64,5 +74,32 @@ def decode_train(path: str, size: int, seed: int) -> np.ndarray | None:
     out = np.empty((size, size, 3), np.float32)
     rc = lib.dtpu_decode_train(
         path.encode(), size, ctypes.c_uint64(seed), out.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    )
+    return out if rc == 0 else None
+
+
+def decode_train_u8(path: str, size: int, seed: int) -> np.ndarray | None:
+    """Train transform emitting raw u8 RGB (normalize runs on-device).
+
+    Decodes only the sampled crop box, at a reduced DCT scale when the box is
+    larger than the target — the fast path for the input-throughput hard part
+    (SURVEY §7). Same seeded crop/flip stream as :func:`decode_train`.
+    """
+    lib = _load()
+    out = np.empty((size, size, 3), np.uint8)
+    rc = lib.dtpu_decode_train_u8(
+        path.encode(), size, ctypes.c_uint64(seed),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return out if rc == 0 else None
+
+
+def decode_eval_u8(path: str, resize: int, crop: int) -> np.ndarray | None:
+    """Eval transform emitting raw u8 RGB (full decode, PIL-parity resample)."""
+    lib = _load()
+    out = np.empty((crop, crop, 3), np.uint8)
+    rc = lib.dtpu_decode_eval_u8(
+        path.encode(), resize, crop,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
     )
     return out if rc == 0 else None

@@ -36,6 +36,7 @@ from distribuuuu_tpu.data import (
     construct_val_loader,
     prefetch_to_device,
 )
+from distribuuuu_tpu.data.transforms import device_normalize
 from distribuuuu_tpu.logging import logger, setup_logger
 from distribuuuu_tpu.metrics import (
     construct_meters,
@@ -63,17 +64,20 @@ class TrainState:
 
 def _forward_loss(model, params, batch_stats, batch, train: bool, rng):
     variables = {"params": params, "batch_stats": batch_stats}
+    # u8 batches are normalized here on-device (fused into the first conv);
+    # float inputs pass through for pre-normalized callers
+    images = device_normalize(batch["image"])
     if train:
         logits, mutated = model.apply(
             variables,
-            batch["image"],
+            images,
             train=True,
             mutable=["batch_stats"],
             rngs={"dropout": rng} if rng is not None else None,
         )
         new_stats = mutated["batch_stats"]
     else:
-        logits = model.apply(variables, batch["image"], train=False)
+        logits = model.apply(variables, images, train=False)
         new_stats = batch_stats
     loss = cross_entropy_loss(logits, batch["label"], cfg.TRAIN.LABEL_SMOOTH)
     return loss, (logits, new_stats)
@@ -177,7 +181,7 @@ def make_eval_step(model, mesh: Mesh, topk: int):
     def step(state: TrainState, batch, totals):
         logits = model.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
-            batch["image"],
+            device_normalize(batch["image"]),
             train=False,
         )
         w = batch["weight"]

@@ -29,6 +29,7 @@
 #include <csetjmp>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <vector>
 
@@ -199,16 +200,132 @@ struct Rng {
   }
 };
 
-}  // namespace
+// torchvision RandomResizedCrop box sampling (scale 0.08-1, ratio 3/4-4/3,
+// 10 tries then clamped-aspect center fallback). Consumes the same Rng
+// sequence as dtpu_decode_train so a given seed yields one crop everywhere.
+void sample_crop(Rng& rng, int w, int h, int* cx, int* cy, int* cw, int* ch) {
+  double area = double(w) * h;
+  const double log_lo = std::log(3.0 / 4.0), log_hi = std::log(4.0 / 3.0);
+  *cx = 0, *cy = 0, *cw = w, *ch = h;
+  for (int attempt = 0; attempt < 10; ++attempt) {
+    double target = area * (0.08 + rng.uniform() * (1.0 - 0.08));
+    double aspect = std::exp(log_lo + rng.uniform() * (log_hi - log_lo));
+    int tw = int(std::lround(std::sqrt(target * aspect)));
+    int th = int(std::lround(std::sqrt(target / aspect)));
+    if (tw > 0 && th > 0 && tw <= w && th <= h) {
+      *cy = rng.randint(0, h - th);
+      *cx = rng.randint(0, w - tw);
+      *cw = tw;
+      *ch = th;
+      return;
+    }
+  }
+  double in_ratio = double(w) / h;
+  if (in_ratio < 3.0 / 4.0) {
+    *cw = w;
+    *ch = int(std::lround(w / (3.0 / 4.0)));
+  } else if (in_ratio > 4.0 / 3.0) {
+    *ch = h;
+    *cw = int(std::lround(h * (4.0 / 3.0)));
+  } else {
+    *cw = w;
+    *ch = h;
+  }
+  *cy = (h - *ch) / 2;
+  *cx = (w - *cw) / 2;
+}
 
-extern "C" {
+// Decoded sub-rectangle of a JPEG, possibly at a reduced DCT scale.
+struct Region {
+  std::vector<uint8_t> px;  // h × w × 3
+  int w = 0, h = 0;         // buffer dims
+  int off_x = 0, off_y = 0; // buffer origin, in scaled-image coords
+  double sx = 1.0, sy = 1.0;  // scaled px per source px, per axis (libjpeg
+                              // rounds output dims up per axis, so x≠y)
+};
 
-// Decode + eval transform: resize shorter side to `resize`, center-crop
-// `crop`, normalize. dst must hold crop*crop*3 floats. Returns 0 on success.
-int dtpu_decode_eval(const char* path, int resize, int crop, float* dst) {
+// Sample (train) or accept a crop box, then decode only the pixels covering
+// it, at the largest DCT reduction (libjpeg scale_num/8) that keeps the
+// decoded box ≥ min_out on its short side — so the subsequent triangle
+// resample only ever *down*samples. Uses libjpeg-turbo partial decode
+// (jpeg_crop_scanline + jpeg_skip_scanlines) to touch only the needed iMCU
+// rows/cols. Decoded pixels drop from whole-image to crop-area × scale² —
+// the input-pipeline equivalent of the reference's reliance on torch's C++
+// loader workers. When `rng` is non-null the crop box is sampled here (one
+// header parse per image); otherwise the caller's box is used as given.
+bool decode_region(const char* path, Rng* rng, int* cx, int* cy, int* cw,
+                   int* ch, int min_out, Region* out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    fclose(f);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_stdio_src(&cinfo, f);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  if (rng)
+    sample_crop(*rng, cinfo.image_width, cinfo.image_height, cx, cy, cw, ch);
+  // largest reduction with short side of the decoded crop still >= min_out
+  // (DTPU_FULL_DECODE=1 forces full-resolution decode for A/B accuracy runs)
+  static const bool full = []() {
+    const char* e = getenv("DTPU_FULL_DECODE");
+    return e && e[0] == '1';
+  }();
+  int short_side = std::min(*cw, *ch);
+  int num = 8;
+  if (!full && short_side > min_out)
+    num = std::max(1, std::min(8, int(std::ceil(8.0 * min_out / short_side))));
+  cinfo.scale_num = num;
+  cinfo.scale_denom = 8;
+  jpeg_start_decompress(&cinfo);
+  // actual per-axis scales: libjpeg output dims are ceil(dim*num/8) per axis
+  double sx = double(cinfo.output_width) / cinfo.image_width;
+  double sy = double(cinfo.output_height) / cinfo.image_height;
+  int sw = cinfo.output_width, sh = cinfo.output_height;
+  // the triangle filter samples up to ceil(max(1, box/out)) px outside the
+  // box on each side; decode that margin too or edge pixels go wrong
+  int mx = int(std::ceil(std::max(1.0, *cw * sx / min_out))) + 1;
+  int my = int(std::ceil(std::max(1.0, *ch * sy / min_out))) + 1;
+  int x0 = std::max(0, std::min(sw - 1, int(std::floor(*cx * sx)) - mx));
+  int x1 = std::max(x0 + 1, std::min(sw, int(std::ceil((*cx + *cw) * sx)) + mx));
+  int y0 = std::max(0, std::min(sh - 1, int(std::floor(*cy * sy)) - my));
+  int y1 = std::max(y0 + 1, std::min(sh, int(std::ceil((*cy + *ch) * sy)) + my));
+  // horizontal crop (may widen to an iMCU boundary: updates x0/width)
+  JDIMENSION xoff = x0, xw = x1 - x0;
+  jpeg_crop_scanline(&cinfo, &xoff, &xw);
+  if (y0 > 0) jpeg_skip_scanlines(&cinfo, y0);
+  int rows = y1 - y0;
+  out->px.resize(size_t(xw) * rows * 3);
+  while (int(cinfo.output_scanline) < y1) {
+    uint8_t* row = out->px.data() + size_t(int(cinfo.output_scanline) - y0) * xw * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_abort_decompress(&cinfo);  // early out: remaining rows never decoded
+  jpeg_destroy_decompress(&cinfo);
+  fclose(f);
+  out->w = int(xw);
+  out->h = rows;
+  out->off_x = int(xoff);
+  out->off_y = y0;
+  out->sx = sx;
+  out->sy = sy;
+  return true;
+}
+
+// Shared eval geometry: resize-shorter + center-crop fused into one source
+// box, resampled to crop² floats (0..255). Both eval entry points use this
+// so the f32 and u8 paths cannot drift apart.
+bool eval_crop_to_float(const char* path, int resize, int crop, float* dst) {
   std::vector<uint8_t> px;
   int w, h;
-  if (!decode_jpeg(path, &px, &w, &h)) return 1;
+  if (!decode_jpeg(path, &px, &w, &h)) return false;
   // long side truncates, matching torchvision/_compute_resized_output_size
   // (and data/transforms.py resize_shorter)
   int rw, rh;
@@ -227,6 +344,34 @@ int dtpu_decode_eval(const char* path, int resize, int crop, float* dst) {
   float bx0 = float(left * sx), bx1 = float((left + crop) * sx);
   float by0 = float(top * sy), by1 = float((top + crop) * sy);
   resample_box(px.data(), w, h, bx0, by0, bx1, by1, crop, crop, dst);
+  return true;
+}
+
+// PIL-style rounding of the float resample output into u8 (clamp + round
+// half up) — matches torchvision, whose resize returns a uint8 image before
+// ToTensor/Normalize run in float.
+void round_to_u8(const float* src, int h, int w, bool hflip, uint8_t* dst) {
+  for (int y = 0; y < h; ++y) {
+    const float* srow = src + size_t(y) * w * 3;
+    uint8_t* drow = dst + size_t(y) * w * 3;
+    for (int x = 0; x < w; ++x) {
+      const float* p = srow + (hflip ? (w - 1 - x) : x) * 3;
+      for (int c = 0; c < 3; ++c) {
+        float v = p[c] + 0.5f;
+        drow[x * 3 + c] = uint8_t(v < 0 ? 0 : (v > 255 ? 255 : v));
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode + eval transform: resize shorter side to `resize`, center-crop
+// `crop`, normalize. dst must hold crop*crop*3 floats. Returns 0 on success.
+int dtpu_decode_eval(const char* path, int resize, int crop, float* dst) {
+  if (!eval_crop_to_float(path, resize, crop, dst)) return 1;
   normalize_inplace(dst, crop * crop, false, crop);
   return 0;
 }
@@ -237,38 +382,8 @@ int dtpu_decode_train(const char* path, int size, uint64_t seed, float* dst) {
   int w, h;
   if (!decode_jpeg(path, &px, &w, &h)) return 1;
   Rng rng(seed);
-  double area = double(w) * h;
-  const double log_lo = std::log(3.0 / 4.0), log_hi = std::log(4.0 / 3.0);
-  int cx = 0, cy = 0, cw = w, ch = h;
-  bool found = false;
-  for (int attempt = 0; attempt < 10 && !found; ++attempt) {
-    double target = area * (0.08 + rng.uniform() * (1.0 - 0.08));
-    double aspect = std::exp(log_lo + rng.uniform() * (log_hi - log_lo));
-    int tw = int(std::lround(std::sqrt(target * aspect)));
-    int th = int(std::lround(std::sqrt(target / aspect)));
-    if (tw > 0 && th > 0 && tw <= w && th <= h) {
-      cy = rng.randint(0, h - th);
-      cx = rng.randint(0, w - tw);
-      cw = tw;
-      ch = th;
-      found = true;
-    }
-  }
-  if (!found) {  // torchvision center fallback at clamped aspect
-    double in_ratio = double(w) / h;
-    if (in_ratio < 3.0 / 4.0) {
-      cw = w;
-      ch = int(std::lround(w / (3.0 / 4.0)));
-    } else if (in_ratio > 4.0 / 3.0) {
-      ch = h;
-      cw = int(std::lround(h * (4.0 / 3.0)));
-    } else {
-      cw = w;
-      ch = h;
-    }
-    cy = (h - ch) / 2;
-    cx = (w - cw) / 2;
-  }
+  int cx, cy, cw, ch;
+  sample_crop(rng, w, h, &cx, &cy, &cw, &ch);
   resample_box(px.data(), w, h, float(cx), float(cy), float(cx + cw),
                float(cy + ch), size, size, dst);
   bool flip = rng.uniform() < 0.5;
@@ -276,6 +391,41 @@ int dtpu_decode_train(const char* path, int size, uint64_t seed, float* dst) {
   return 0;
 }
 
-int dtpu_version() { return 1; }
+// u8 variants: raw RGB out (normalization runs on-device, fused into the
+// first conv by XLA), and the train path decodes only the sampled crop box
+// at a reduced DCT scale — both the H2D copy and the host decode shrink.
+
+// Train: sample crop (inside decode_region, one header parse) → partial
+// scaled decode of the box → downsample-only resample → flip → u8.
+// dst: size²×3.
+int dtpu_decode_train_u8(const char* path, int size, uint64_t seed,
+                         uint8_t* dst) {
+  Rng rng(seed);
+  int cx, cy, cw, ch;
+  Region reg;
+  if (!decode_region(path, &rng, &cx, &cy, &cw, &ch, size, &reg)) return 1;
+  // crop box mapped into the decoded buffer's coordinates
+  float bx0 = float(cx * reg.sx - reg.off_x);
+  float by0 = float(cy * reg.sy - reg.off_y);
+  float bx1 = float((cx + cw) * reg.sx - reg.off_x);
+  float by1 = float((cy + ch) * reg.sy - reg.off_y);
+  std::vector<float> tmp(size_t(size) * size * 3);
+  resample_box(reg.px.data(), reg.w, reg.h, bx0, by0, bx1, by1, size, size,
+               tmp.data());
+  bool flip = rng.uniform() < 0.5;
+  round_to_u8(tmp.data(), size, size, flip, dst);
+  return 0;
+}
+
+// Eval: full decode (bit-parity with the PIL path — no DCT scaling) +
+// fused resize/center-crop resample → u8. dst: crop²×3.
+int dtpu_decode_eval_u8(const char* path, int resize, int crop, uint8_t* dst) {
+  std::vector<float> tmp(size_t(crop) * crop * 3);
+  if (!eval_crop_to_float(path, resize, crop, tmp.data())) return 1;
+  round_to_u8(tmp.data(), crop, crop, false, dst);
+  return 0;
+}
+
+int dtpu_version() { return 2; }
 
 }  // extern "C"

@@ -5,7 +5,11 @@ import pytest
 from PIL import Image
 
 from distribuuuu_tpu.data import native
-from distribuuuu_tpu.data.transforms import eval_transform
+from distribuuuu_tpu.data.transforms import (
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    eval_transform,
+)
 
 pytestmark = pytest.mark.skipif(
     not native.available(), reason="native library not built (scripts/build_native.sh)"
@@ -58,3 +62,61 @@ def test_decode_failure_returns_none(tmp_path):
     bad.write_bytes(b"not a jpeg")
     assert native.decode_eval(str(bad), 64, 56) is None
     assert native.decode_train(str(bad), 48, 1) is None
+    assert native.decode_eval_u8(str(bad), 64, 56) is None
+    assert native.decode_train_u8(str(bad), 48, 1) is None
+
+
+# --- u8 fast path (region/DCT-scaled decode, on-device normalize) ----------
+
+
+def test_eval_u8_is_rounded_f32(jpeg_path):
+    """Eval u8 path = f32 path + PIL-style u8 rounding, bit-close."""
+    f32 = native.decode_eval(jpeg_path, 64, 56)
+    u8 = native.decode_eval_u8(jpeg_path, 64, 56)
+    assert u8.dtype == np.uint8 and u8.shape == (56, 56, 3)
+    rec = (f32 * IMAGENET_STD + IMAGENET_MEAN) * 255.0
+    assert np.abs(rec - u8.astype(np.float32)).max() <= 0.5 + 1e-3
+
+def test_train_u8_full_scale_exact(jpeg_path):
+    """On an image smaller than the target the region path decodes at full
+    resolution — it must agree with the f32 path exactly (up to rounding),
+    proving the partial-decode bookkeeping (margins, offsets) is right."""
+    for seed in range(8):
+        f32 = native.decode_train(jpeg_path, 224, seed)  # 80×96 src < 224 target
+        u8 = native.decode_train_u8(jpeg_path, 224, seed)
+        rec = (f32 * IMAGENET_STD + IMAGENET_MEAN) * 255.0
+        assert np.abs(rec - u8.astype(np.float32)).max() <= 0.5 + 1e-3
+
+
+def test_train_u8_scaled_decode_close(tmp_path):
+    """Large image → DCT-scaled decode of just the crop box. Numerics differ
+    from full decode (DCT-domain prefilter) but must stay close; and
+    DTPU_FULL_DECODE=1 is only read once per process so we just check the
+    scaled output is a plausible image of the right crop."""
+    rng = np.random.default_rng(7)
+    smooth = rng.integers(0, 255, (25, 31, 3), np.uint8)
+    big = Image.fromarray(smooth).resize((500, 400), Image.BILINEAR)
+    p = tmp_path / "big.jpg"
+    big.save(p, quality=95)
+    for seed in range(8):
+        f32 = native.decode_train(str(p), 224, seed)
+        u8 = native.decode_train_u8(str(p), 224, seed)
+        rec = (f32 * IMAGENET_STD + IMAGENET_MEAN) * 255.0
+        diff = np.abs(rec - u8.astype(np.float32))
+        # same crop/flip (shared Rng stream); only the resample chain differs
+        assert diff.mean() < 4.0, f"seed {seed}: mean diff {diff.mean()}"
+
+
+def test_device_normalize_matches_host():
+    import jax.numpy as jnp
+
+    from distribuuuu_tpu.data.transforms import device_normalize
+
+    rng = np.random.default_rng(3)
+    u8 = rng.integers(0, 256, (2, 8, 8, 3), np.uint8)
+    got = np.asarray(device_normalize(jnp.asarray(u8)))
+    expect = (u8.astype(np.float32) / 255.0 - IMAGENET_MEAN) / IMAGENET_STD
+    np.testing.assert_allclose(got, expect, atol=1e-6)
+    # float input passes through untouched
+    f = rng.standard_normal((2, 4, 4, 3)).astype(np.float32)
+    np.testing.assert_array_equal(np.asarray(device_normalize(jnp.asarray(f))), f)

@@ -38,7 +38,6 @@ learns the successor rule):
     final loss 0.0477 (< 0.2: the ring learned long-range structure)
 """
 
-import functools
 import os
 import sys
 
