@@ -24,34 +24,52 @@ _LIB_PATH = os.path.join(
 )
 
 _lib = None
+_lib_unusable = False  # stale/missing-symbol library: warn once, use PIL
 
 
 def _load():
-    global _lib
-    if _lib is None and os.path.exists(_LIB_PATH):
-        lib = ctypes.CDLL(_LIB_PATH)
-        lib.dtpu_decode_eval.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_float),
-        ]
-        lib.dtpu_decode_eval.restype = ctypes.c_int
-        lib.dtpu_decode_train.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_uint64,
-            ctypes.POINTER(ctypes.c_float),
-        ]
-        lib.dtpu_decode_train.restype = ctypes.c_int
-        lib.dtpu_decode_train_u8.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_uint64,
-            ctypes.POINTER(ctypes.c_uint8),
-        ]
-        lib.dtpu_decode_train_u8.restype = ctypes.c_int
-        lib.dtpu_decode_eval_u8.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_uint8),
-        ]
-        lib.dtpu_decode_eval_u8.restype = ctypes.c_int
-        _lib = lib
+    global _lib, _lib_unusable
+    if _lib is None and not _lib_unusable and os.path.exists(_LIB_PATH):
+        try:
+            _lib = _bind(ctypes.CDLL(_LIB_PATH))
+        except (OSError, AttributeError) as exc:
+            # e.g. a library built before the u8 API existed — transparent
+            # fallback to the PIL path, as the module contract promises
+            _lib_unusable = True
+            import warnings
+
+            warnings.warn(
+                f"native decode library at {_LIB_PATH} is unusable ({exc}); "
+                f"falling back to PIL. Rebuild with scripts/build_native.sh"
+            )
     return _lib
+
+
+def _bind(lib):
+    lib_version = getattr(lib, "dtpu_version", None)
+    if lib_version is None or lib_version() < 2:
+        raise AttributeError("library predates the u8 decode API (need v2+)")
+    lib.dtpu_decode_eval.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float),
+    ]
+    lib.dtpu_decode_eval.restype = ctypes.c_int
+    lib.dtpu_decode_train.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_float),
+    ]
+    lib.dtpu_decode_train.restype = ctypes.c_int
+    lib.dtpu_decode_train_u8.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.dtpu_decode_train_u8.restype = ctypes.c_int
+    lib.dtpu_decode_eval_u8.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.dtpu_decode_eval_u8.restype = ctypes.c_int
+    return lib
 
 
 def available() -> bool:

@@ -1,0 +1,53 @@
+"""Materialize small *real-image* datasets as JPEG ImageFolders — no network.
+
+The reference anchors its recipes with real-data oracles (CIFAR-10 via
+torchvision download, `/root/reference/tutorial/snsc.py:85-114`). TPU pods
+are typically egress-restricted, so the analog here uses scikit-learn's
+*bundled* digits scans (1,797 8×8 grayscale handwritten digits, 10 classes —
+real images shipped inside the sklearn package): written out as JPEGs in
+ImageFolder layout, they drive the full production path — JPEG decode
+(native C++), RandomResizedCrop/flip augmentation, sharding, the SPMD train
+step — and give a reproducible accuracy oracle (tutorial rung 8,
+`tutorial/real_data_oracle.py`).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+from PIL import Image
+
+
+def digits_imagefolder(root: str, im_size: int = 64, val_per_class: int = 30) -> str:
+    """Write sklearn digits as ``root/{train,val}/<class>/*.jpg``; idempotent.
+
+    Images are upscaled 8×8 → ``im_size`` with bilinear so the standard crop
+    pipeline has room to work. The split is deterministic: the *last*
+    ``val_per_class`` samples of each class go to val (sklearn's sample order
+    is fixed). Returns ``root``.
+    """
+    marker = os.path.join(root, ".complete")
+    if os.path.exists(marker):
+        return root
+    from sklearn.datasets import load_digits
+
+    digits = load_digits()
+    images = digits.images  # (1797, 8, 8) float64 in 0..16
+    labels = digits.target
+    by_class: dict[int, list[np.ndarray]] = {c: [] for c in range(10)}
+    for img, lab in zip(images, labels):
+        by_class[int(lab)].append(img)
+    for c, imgs in by_class.items():
+        n_val = min(val_per_class, len(imgs) // 5)
+        for i, img in enumerate(imgs):
+            split = "val" if i >= len(imgs) - n_val else "train"
+            d = os.path.join(root, split, f"digit_{c}")
+            os.makedirs(d, exist_ok=True)
+            u8 = np.round(img / 16.0 * 255.0).astype(np.uint8)
+            pil = Image.fromarray(u8, mode="L").convert("RGB")
+            pil = pil.resize((im_size, im_size), Image.BILINEAR)
+            pil.save(os.path.join(d, f"{i:04d}.jpg"), quality=95)
+    with open(marker, "w") as f:
+        f.write("ok\n")
+    return root

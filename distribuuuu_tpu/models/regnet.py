@@ -130,6 +130,13 @@ def regnetx_160(**kw):
     return RegNet(wa=55.59, w0=216, wm=2.1, depth=22, group_width=128, **kw)
 
 
+@register_model("regnety_040")
+def regnety_040(**kw):
+    """RegNetY-4GF — breadth-recipe example: a new design-space point is one
+    registration line (paper Table; timm regnety_040)."""
+    return RegNet(wa=31.41, w0=96, wm=2.24, depth=22, group_width=64, se_ratio=0.25, **kw)
+
+
 @register_model("regnety_160")
 def regnety_160(**kw):
     """RegNetY-16GF."""

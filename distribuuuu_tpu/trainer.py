@@ -395,7 +395,10 @@ def validate(loader, mesh, eval_step, state, is_primary: bool, print_freq=None, 
 # ---------------------------------------------------------------------------
 
 def train_model():
-    """Full training run (reference `trainer.py:106-173`)."""
+    """Full training run (reference `trainer.py:106-173`).
+
+    Returns ``(final_state, best_acc1)``.
+    """
     configure_determinism(cfg.CUDNN.DETERMINISTIC)  # before first backend use
     info = setup_distributed()
     key = setup_seed(cfg.RNG_SEED, info.process_index)
@@ -460,7 +463,7 @@ def train_model():
         path = ckpt.save_checkpoint(cfg.OUT_DIR, epoch, state, best_acc1, is_best)
         logger.info(f"Saving checkpoint (async): {path} (best Acc@1 {best_acc1:.3f})")
     ckpt.wait_for_saves()  # don't exit with a checkpoint mid-commit
-    return state
+    return state, best_acc1
 
 
 def test_model():

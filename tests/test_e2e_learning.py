@@ -64,3 +64,30 @@ def test_full_training_learns_colors(color_dataset, tmp_path, fresh_cfg):
     acc1, _ = trainer.test_model()
     # 3 linearly-separable color classes: near-perfect, far above 33% chance
     assert acc1 > 80.0, f"pipeline failed to learn separable colors: Acc@1={acc1}"
+
+
+@pytest.mark.slow
+def test_real_data_oracle_digits(tmp_path, fresh_cfg):
+    # fresh_cfg restores the global cfg singleton afterwards: main() below
+    # reset+freezes it with oracle settings
+    """Accuracy oracle on *real* images (sklearn's bundled digit scans) —
+    the egress-free analog of the reference's CIFAR tutorial oracle
+    (`/root/reference/tutorial/snsc.py:108-111`, ~65% in 5 epochs). Catches
+    augmentation/normalization/LR-recipe regressions that solid colors
+    can't: digits need real feature learning, and the band (≥65% val Acc@1,
+    observed 81.0 single-device / seed 1) fails on any gross recipe break.
+    """
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tutorial"))
+    try:
+        import real_data_oracle
+    finally:
+        sys.path.pop(0)
+
+    best = real_data_oracle.main(root=str(tmp_path / "digits"))
+    assert best >= real_data_oracle.ORACLE_MIN_ACC1, (
+        f"oracle band broken: best val Acc@1 {best:.1f} < "
+        f"{real_data_oracle.ORACLE_MIN_ACC1}"
+    )

@@ -19,7 +19,11 @@ EXPECTED_PARAMS_M = {
     "densenet201": 20.014,
     "botnet50": 20.859,
     "efficientnet_b0": 5.289,
+    # breadth-recipe variants (VERDICT round-1 #10): counts are the timm
+    # sizes for the same design points
+    "efficientnet_b1": 7.794,
     "regnetx_160": 54.279,
+    "regnety_040": 20.647,
     "regnety_160": 83.590,
     "regnety_320": 145.047,
 }

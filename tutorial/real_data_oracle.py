@@ -1,0 +1,91 @@
+"""Rung 8 — real-data accuracy oracle through the production trainer.
+
+The reference anchors its tutorial ladder with a real-dataset oracle: a
+CIFAR-10 run whose expected output is embedded in the script docstring
+(`/root/reference/tutorial/snsc.py:85-114`, ~65% train acc in 5 epochs).
+TPU pods are egress-restricted, so the analog here trains on scikit-learn's
+*bundled* digits scans (1,797 real 8×8 handwritten-digit images, 10 classes,
+no download) written out as JPEGs — which drives the full production path:
+native JPEG decode, RandomResizedCrop/flip augmentation, u8 H2D + on-device
+normalize, sharded SPMD train step, async checkpointing.
+
+Unlike rungs 1-6 this intentionally imports the framework (like rung 7): the
+point is an end-to-end accuracy oracle for `distribuuuu_tpu` itself, not a
+from-scratch lesson.
+
+Run (any platform; ~3 min on a 1-core CPU host, seconds on a TPU chip):
+
+    python tutorial/real_data_oracle.py
+    # or on the fake 8-chip CPU mesh:
+    python scripts/cpu_mesh_run.py tutorial/real_data_oracle.py
+
+Expected output (oracle transcript, 1 CPU device, seed 1, SyncBN — numbers
+drift a little across platforms/device counts; the oracle band is the
+assertion in `main()`):
+
+    Epoch[0] ...                          val * Acc@1 10.667
+    Epoch[1] ...                          val * Acc@1 10.000 Acc@5 50.000
+    Epoch[2] ...                          val * Acc@1 51.667 Acc@5 85.333
+    Epoch[3] ...                          val * Acc@1 77.333 Acc@5 96.667
+    Epoch[4] ...                          val * Acc@1 80.667 Acc@5 98.000
+    ORACLE OK: best val Acc@1 80.7 (band: >= 65)
+
+(The same recipe without SyncBN warms up faster — 35/55/64/71/81 — but its
+batch statistics depend on the per-device batch; SyncBN makes the oracle
+device-count-invariant.)
+
+Val accuracy runs ahead of train accuracy here: train sees aggressive
+RandomResizedCrop(0.08-1.0) crops of a 64px digit, eval sees clean center
+crops. The shape of the curve — not the exact numbers — is the regression
+oracle, exactly like the reference's CIFAR transcript.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+ORACLE_MIN_ACC1 = 65.0  # observed 81.0; generous margin for platform variance
+
+
+def main(root: str = "/tmp/distribuuuu_tpu_digits", epochs: int = 5) -> float:
+    import jax
+
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.config import cfg, reset_cfg
+    from distribuuuu_tpu.data.provision import digits_imagefolder
+
+    digits_imagefolder(root)
+    reset_cfg()
+    cfg.MODEL.ARCH = "resnet18"
+    cfg.MODEL.NUM_CLASSES = 10
+    # SyncBN → batch stats over the *global* batch: the oracle numbers hold
+    # whether this runs on 1 chip or a mesh (per-device batch shrinks with N)
+    cfg.MODEL.SYNCBN = True
+    cfg.TRAIN.DATASET = root
+    cfg.TRAIN.SPLIT = "train"
+    cfg.TEST.SPLIT = "val"
+    cfg.TRAIN.IM_SIZE = 32
+    cfg.TEST.IM_SIZE = 36
+    cfg.TEST.CROP_SIZE = 32
+    # global batch 64 (≥1/device on meshes larger than 64 chips)
+    cfg.TRAIN.BATCH_SIZE = max(1, 64 // max(1, jax.device_count()))
+    cfg.TEST.BATCH_SIZE = cfg.TRAIN.BATCH_SIZE
+    cfg.OPTIM.MAX_EPOCH = epochs
+    cfg.OPTIM.BASE_LR = 0.05  # linear scaling: 0.1 per 128 global batch
+    cfg.OPTIM.WARMUP_EPOCHS = 1
+    cfg.TRAIN.PRINT_FREQ = 10
+    cfg.RNG_SEED = 1
+    cfg.OUT_DIR = os.path.join(root, "out")
+    cfg.TRAIN.AUTO_RESUME = False
+    cfg.freeze()
+
+    _, best = trainer.train_model()
+    status = "OK" if best >= ORACLE_MIN_ACC1 else "FAILED"
+    print(f"ORACLE {status}: best val Acc@1 {best:.1f} (band: >= {ORACLE_MIN_ACC1:.0f})")
+    return best
+
+
+if __name__ == "__main__":
+    acc = main()
+    sys.exit(0 if acc >= ORACLE_MIN_ACC1 else 1)
