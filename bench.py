@@ -64,7 +64,10 @@ def main():
     global_batch = per_chip_batch * n_chips
 
     mesh = data_mesh(-1)
-    model = build_model("resnet50", num_classes=1000)  # bf16 trunk by default
+    # DTPU_BENCH_S2D=1 switches the stem to the space-to-depth transform
+    # (identical math, MXU-shaped; tests prove equality to f32 noise) for A/B runs
+    stem_s2d = os.environ.get("DTPU_BENCH_S2D", "0") == "1"
+    model = build_model("resnet50", num_classes=1000, stem_s2d=stem_s2d)  # bf16 trunk
     state, tx = create_train_state(model, jax.random.PRNGKey(0), mesh, 224)
     train_step = make_train_step(model, tx, mesh, topk=5)
 

@@ -40,6 +40,9 @@ _C.MODEL.DUMMY_INPUT = False
 # TPU additions
 _C.MODEL.DTYPE = "bfloat16"
 _C.MODEL.REMAT = False
+# Space-to-depth stem (resnet/botnet families): exact same math, MXU-shaped
+# compute for the 7x7/2 3-channel stem conv. Checkpoint-compatible both ways.
+_C.MODEL.STEM_S2D = False
 
 _C.TRAIN = CN()
 _C.TRAIN.BATCH_SIZE = 32  # per-device batch size, matching the reference's

@@ -27,9 +27,16 @@ def digits_imagefolder(root: str, im_size: int = 64, val_per_class: int = 30) ->
     ``val_per_class`` samples of each class go to val (sklearn's sample order
     is fixed). Returns ``root``.
     """
+    stamp = f"v1 im_size={im_size} val_per_class={val_per_class}\n"
     marker = os.path.join(root, ".complete")
     if os.path.exists(marker):
-        return root
+        with open(marker) as f:
+            if f.read() == stamp:
+                return root
+        # parameters changed: rebuild from scratch rather than serve stale data
+        import shutil
+
+        shutil.rmtree(root)
     from sklearn.datasets import load_digits
 
     digits = load_digits()
@@ -49,5 +56,5 @@ def digits_imagefolder(root: str, im_size: int = 64, val_per_class: int = 30) ->
             pil = pil.resize((im_size, im_size), Image.BILINEAR)
             pil.save(os.path.join(d, f"{i:04d}.jpg"), quality=95)
     with open(marker, "w") as f:
-        f.write("ok\n")
+        f.write(stamp)
     return root

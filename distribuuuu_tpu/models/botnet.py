@@ -208,11 +208,15 @@ class BoTNet50(nn.Module):
     dtype: Any = jnp.bfloat16
     bn_axis_name: str | None = None
     remat: bool = False
+    stem_s2d: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         # stages 1-3 of resnet50 (stage sizes 3,4,6), shared trunk definition
-        x = resnet_stem(x, train, dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        x = resnet_stem(
+            x, train, dtype=self.dtype, bn_axis_name=self.bn_axis_name,
+            stem_s2d=self.stem_s2d,
+        )
         x = resnet_stages(
             x,
             train,

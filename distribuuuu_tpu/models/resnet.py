@@ -19,12 +19,14 @@ from __future__ import annotations
 from typing import Any, Sequence, Type
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from distribuuuu_tpu.models.layers import (
     batch_norm,
     classifier_head,
     conv,
+    kaiming_normal_out,
     maybe_remat,
 )
 from distribuuuu_tpu.models.registry import register_model
@@ -103,13 +105,59 @@ class Bottleneck(nn.Module):
         return nn.relu(out + identity)
 
 
-def resnet_stem(x, train, *, dtype, bn_axis_name):
+class S2DStemConv(nn.Module):
+    """The 7×7/2 stem conv computed via space-to-depth — MXU-shaped.
+
+    A 7×7 stride-2 conv on 3 input channels is the least MXU-friendly op in
+    the network (3 channels vs 128-wide MXU lanes, big spatial extent). The
+    MLPerf-era TPU transform: zero-pad the kernel to 8×8 (top/left), block
+    both kernel and activations 2×2 (space-to-depth), and run the exact
+    equivalent 4×4 stride-1 VALID conv on (H/2, W/2, 12) — 4× the channel
+    utilization at identical math (`tests/test_models_resnet.py` asserts
+    equality to f32 accumulation noise).
+
+    The *logical parameter* stays ``(7,7,3,64)`` under the same flax name as
+    `nn.Conv` (``kernel``), so checkpoints, the torch converter, and
+    pretrained loading are byte-identical with the plain stem; only the
+    compute graph changes. Input H/W must be even (224 recipe is).
+    """
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        w = self.param("kernel", kaiming_normal_out, (7, 7, 3, 64), jnp.float32)
+        w = w.astype(self.dtype)
+        x = x.astype(self.dtype)
+        # kernel: zero row/col at top/left → 8×8, then 2×2 block → (4,4,12,64)
+        wp = jnp.pad(w, ((1, 0), (1, 0), (0, 0), (0, 0)))
+        wp = wp.reshape(4, 2, 4, 2, 3, 64).transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 12, 64)
+        # activations: pad (4,2) per spatial dim (≡ the original pad 3 once the
+        # kernel's leading zero tap is accounted for), then 2×2 block
+        n, h, width, c = x.shape
+        xp = jnp.pad(x, ((0, 0), (4, 2), (4, 2), (0, 0)))
+        xs = (
+            xp.reshape(n, (h + 6) // 2, 2, (width + 6) // 2, 2, c)
+            .transpose(0, 1, 3, 2, 4, 5)
+            .reshape(n, (h + 6) // 2, (width + 6) // 2, 4 * c)
+        )
+        return jax.lax.conv_general_dilated(
+            xs, wp, window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+
+def resnet_stem(x, train, *, dtype, bn_axis_name, stem_s2d=False):
     """7×7/2 conv-BN-ReLU + 3×3/2 maxpool (reference `resnet.py:186-196`).
 
     Plain function so composed trunks (BoTNet) share one definition; flax
-    binds the submodule names into the caller's scope.
+    binds the submodule names into the caller's scope. ``stem_s2d`` computes
+    the identical conv via the space-to-depth transform (see S2DStemConv).
     """
-    x = conv(64, 7, 2, padding=3, dtype=dtype, name="conv1")(x)
+    if stem_s2d:
+        x = S2DStemConv(dtype=dtype, name="conv1")(x)
+    else:
+        x = conv(64, 7, 2, padding=3, dtype=dtype, name="conv1")(x)
     x = batch_norm(train=train, axis_name=bn_axis_name, name="bn1")(x)
     x = nn.relu(x)
     return nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
@@ -164,10 +212,14 @@ class ResNet(nn.Module):
     dtype: Any = jnp.bfloat16
     bn_axis_name: str | None = None
     remat: bool = False
+    stem_s2d: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
-        x = resnet_stem(x, train, dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        x = resnet_stem(
+            x, train, dtype=self.dtype, bn_axis_name=self.bn_axis_name,
+            stem_s2d=self.stem_s2d,
+        )
         x = resnet_stages(
             x,
             train,

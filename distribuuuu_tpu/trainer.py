@@ -238,12 +238,16 @@ def create_train_state(model, key, mesh: Mesh, im_size: int):
 
 def _build_cfg_model():
     bn_axis = "data" if cfg.MODEL.SYNCBN else None
+    kwargs = {}
+    if cfg.MODEL.STEM_S2D:  # resnet/botnet-family option; loud TypeError elsewhere
+        kwargs["stem_s2d"] = True
     return build_model(
         cfg.MODEL.ARCH,
         num_classes=cfg.MODEL.NUM_CLASSES,
         dtype=jnp.bfloat16 if cfg.MODEL.DTYPE == "bfloat16" else jnp.float32,
         bn_axis_name=bn_axis,
         remat=cfg.MODEL.REMAT,
+        **kwargs,
     )
 
 

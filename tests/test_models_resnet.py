@@ -73,3 +73,23 @@ def test_forward_runs_and_bn_stats_update():
     # running stats must have moved off their init values
     mean_leaf = jax.tree.leaves(mutated["batch_stats"])[0]
     assert float(jnp.sum(jnp.abs(mean_leaf))) > 0.0
+
+
+def test_stem_s2d_exact_equivalence():
+    """MODEL.STEM_S2D computes the *same function*: with the one shared param
+    tree, the space-to-depth stem must reproduce the plain 7x7/2 stem's
+    logits to float32 accumulation noise, at multiple input sizes."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 64, 64, 3)), jnp.float32)
+    plain = build_model("resnet18", num_classes=10, dtype=jnp.float32)
+    s2d = build_model("resnet18", num_classes=10, dtype=jnp.float32, stem_s2d=True)
+    variables = plain.init(jax.random.PRNGKey(0), x, train=False)
+    # identical parameter trees: checkpoints are interchangeable
+    assert jax.tree_util.tree_structure(variables) == jax.tree_util.tree_structure(
+        s2d.init(jax.random.PRNGKey(0), x, train=False)
+    )
+    y_plain = plain.apply(variables, x, train=False)
+    y_s2d = s2d.apply(variables, x, train=False)
+    assert float(jnp.abs(y_plain - y_s2d).max()) < 1e-4
