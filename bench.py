@@ -98,8 +98,8 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "resnet50 train images/sec/chip (224px, bf16, global batch %d, %d chip%s)"
-                % (global_batch, n_chips, "s" if n_chips > 1 else ""),
+                "metric": "resnet50%s train images/sec/chip (224px, bf16, global batch %d, %d chip%s)"
+                % (" +s2d" if stem_s2d else "", global_batch, n_chips, "s" if n_chips > 1 else ""),
                 "value": round(per_chip, 1),
                 "unit": "images/sec/chip",
                 "vs_baseline": round(per_chip / A100_FP32_IMGS_PER_SEC_PER_GPU, 3),

@@ -14,12 +14,13 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 CASES = [
-    # (arch, per-chip batches)
-    ("resnet18", (256, 1024)),
-    ("resnet50", (128, 512)),
-    ("botnet50", (128, 256)),
-    ("efficientnet_b0", (256, 512)),
-    ("regnety_160", (64, 128)),
+    # (arch, per-chip batches, model kwargs, row label suffix)
+    ("resnet18", (256, 1024), {}, ""),
+    ("resnet50", (128, 512), {}, ""),
+    ("resnet50", (128, 512), {"stem_s2d": True}, " +s2d"),  # space-to-depth A/B
+    ("botnet50", (128, 256), {}, ""),
+    ("efficientnet_b0", (256, 512), {}, ""),
+    ("regnety_160", (64, 128), {}, ""),
 ]
 
 WARMUP, ITERS, QUICK_ITERS = 3, 10, 5
@@ -45,8 +46,8 @@ def main():
     key = jax.random.PRNGKey(1)
     iters = QUICK_ITERS if quick else ITERS
 
-    for arch, batches in CASES:
-        model = build_model(arch, num_classes=1000)
+    for arch, batches, model_kw, label in CASES:
+        model = build_model(arch, num_classes=1000, **model_kw)
         # tx is state-free; building the step does not allocate device memory
         step = make_train_step(model, optim.construct_optimizer(), mesh, topk=5)
         for B in batches[:1] if quick else batches:
@@ -64,9 +65,9 @@ def main():
                     state, m = step(state, batch, lr, key)
                     jax.device_get(m)
                 dt = (time.perf_counter() - t0) / iters
-                print(f"| {arch} | {B} | {dt * 1000:.1f} | {B / dt:.1f} |", flush=True)
+                print(f"| {arch}{label} | {B} | {dt * 1000:.1f} | {B / dt:.1f} |", flush=True)
             except Exception as e:  # OOM etc: report and continue the sweep
-                print(f"| {arch} | {B} | FAILED: {type(e).__name__} | — |", flush=True)
+                print(f"| {arch}{label} | {B} | FAILED: {type(e).__name__} | — |", flush=True)
             finally:
                 # release device memory even on the failure path, or a single
                 # OOM poisons every later row
