@@ -30,10 +30,11 @@ def _watchdog():
     # Runs on a timer thread and hard-exits: a Python-level signal handler
     # would never fire while the main thread is blocked inside a native
     # device call, which is exactly the wedge scenario this guards against.
+    s2d = " +s2d" if os.environ.get("DTPU_BENCH_S2D", "0") == "1" else ""
     print(
         json.dumps(
             {
-                "metric": "resnet50 train images/sec/chip (BENCH TIMED OUT: device unreachable/wedged)",
+                "metric": f"resnet50{s2d} train images/sec/chip (BENCH TIMED OUT: device unreachable/wedged)",
                 "value": 0.0,
                 "unit": "images/sec/chip",
                 "vs_baseline": 0.0,

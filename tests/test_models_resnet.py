@@ -93,3 +93,22 @@ def test_stem_s2d_exact_equivalence():
     y_plain = plain.apply(variables, x, train=False)
     y_s2d = s2d.apply(variables, x, train=False)
     assert float(jnp.abs(y_plain - y_s2d).max()) < 1e-4
+
+    # gradients must agree too — training runs through this graph
+    def loss(model):
+        def f(params):
+            out, _ = model.apply(
+                {**variables, "params": params}, x, train=True, mutable=["batch_stats"]
+            )
+            return jnp.sum(out**2)
+
+        return f
+
+    g_plain = jax.grad(loss(plain))(variables["params"])
+    g_s2d = jax.grad(loss(s2d))(variables["params"])
+    assert jax.tree_util.tree_structure(g_plain) == jax.tree_util.tree_structure(g_s2d)
+    for (path, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(g_plain), jax.tree.leaves(g_s2d)
+    ):
+        scale = float(jnp.abs(a).max()) + 1e-8
+        assert float(jnp.abs(a - b).max()) / scale < 1e-3, path
