@@ -23,6 +23,17 @@ import time
 
 A100_FP32_IMGS_PER_SEC_PER_GPU = 400.0  # 8xA100 DDP fp32 resnet50 reference point
 
+
+def _variant_tags() -> str:
+    """Metric-label suffixes for A/B env toggles, so recorded JSON lines from
+    different arms stay distinguishable (even on watchdog timeout)."""
+    tags = ""
+    if os.environ.get("DTPU_BENCH_S2D", "0") == "1":
+        tags += " +s2d"
+    if os.environ.get("DTPU_FUSED_ATTN", "0") == "1":
+        tags += " +fused-attn"
+    return tags
+
 WATCHDOG_SECONDS = 540  # the tunnel to the chip can wedge; never hang the driver
 
 
@@ -30,11 +41,12 @@ def _watchdog():
     # Runs on a timer thread and hard-exits: a Python-level signal handler
     # would never fire while the main thread is blocked inside a native
     # device call, which is exactly the wedge scenario this guards against.
-    s2d = " +s2d" if os.environ.get("DTPU_BENCH_S2D", "0") == "1" else ""
+    arch = os.environ.get("DTPU_BENCH_ARCH", "resnet50")
+    s2d = _variant_tags()
     print(
         json.dumps(
             {
-                "metric": f"resnet50{s2d} train images/sec/chip (BENCH TIMED OUT: device unreachable/wedged)",
+                "metric": f"{arch}{s2d} train images/sec/chip (BENCH TIMED OUT: device unreachable/wedged)",
                 "value": 0.0,
                 "unit": "images/sec/chip",
                 "vs_baseline": 0.0,
@@ -66,9 +78,13 @@ def main():
 
     mesh = data_mesh(-1)
     # DTPU_BENCH_S2D=1 switches the stem to the space-to-depth transform
-    # (identical math, MXU-shaped; tests prove equality to f32 noise) for A/B runs
+    # (identical math, MXU-shaped; tests prove equality to f32 noise) for A/B
+    # runs; DTPU_BENCH_ARCH benches another zoo arch with the same harness
+    # (s2d only applies to resnet/botnet families).
     stem_s2d = os.environ.get("DTPU_BENCH_S2D", "0") == "1"
-    model = build_model("resnet50", num_classes=1000, stem_s2d=stem_s2d)  # bf16 trunk
+    arch = os.environ.get("DTPU_BENCH_ARCH", "resnet50")
+    kw = {"stem_s2d": True} if stem_s2d else {}
+    model = build_model(arch, num_classes=1000, **kw)  # bf16 trunk by default
     state, tx = create_train_state(model, jax.random.PRNGKey(0), mesh, 224)
     train_step = make_train_step(model, tx, mesh, topk=5)
 
@@ -99,8 +115,8 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "resnet50%s train images/sec/chip (224px, bf16, global batch %d, %d chip%s)"
-                % (" +s2d" if stem_s2d else "", global_batch, n_chips, "s" if n_chips > 1 else ""),
+                "metric": "%s%s train images/sec/chip (224px, bf16, global batch %d, %d chip%s)"
+                % (arch, _variant_tags(), global_batch, n_chips, "s" if n_chips > 1 else ""),
                 "value": round(per_chip, 1),
                 "unit": "images/sec/chip",
                 "vs_baseline": round(per_chip / A100_FP32_IMGS_PER_SEC_PER_GPU, 3),

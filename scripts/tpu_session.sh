@@ -39,8 +39,16 @@ run_or_abort "bench.py (space-to-depth stem A/B)" \
     env DTPU_BENCH_S2D=1 timeout 600 python bench.py
 
 say "fused-attention soak"
-timeout 900 python scripts/soak_fused_attn.py >> "$LOG" 2>&1 \
-    && say "soak OK" || say "soak FAILED (see log)"
+timeout 900 python scripts/soak_fused_attn.py >> "$LOG" 2>&1
+soak_rc=$?
+if [ $soak_rc -eq 124 ]; then
+    say "soak TIMED OUT — chip likely wedged mid-ladder, aborting"
+    exit 1
+elif [ $soak_rc -ne 0 ]; then
+    say "soak FAILED numerically (rc=$soak_rc, see log) — continuing, fused attn stays off"
+else
+    say "soak OK"
+fi
 
 if [ "$QUICK" = "--quick" ]; then
     run_or_abort "perf sweep (quick)" timeout 1200 python scripts/perf_sweep.py --quick
@@ -48,30 +56,10 @@ else
     run_or_abort "perf sweep" timeout 2400 python scripts/perf_sweep.py
 fi
 
-say "botnet50 fused-attention bench"
-DTPU_FUSED_ATTN=1 DTPU_BENCH_BATCH=256 timeout 600 python - <<'EOF' 2>>"$LOG" | tee -a "$LOG"
-import os, time, json
-import jax, jax.numpy as jnp
-from distribuuuu_tpu import optim
-from distribuuuu_tpu.benchutil import make_synthetic_batch
-from distribuuuu_tpu.models import build_model
-from distribuuuu_tpu.runtime import data_mesh
-from distribuuuu_tpu.trainer import create_train_state, make_train_step
-
-mesh = data_mesh(-1)
-B = int(os.environ.get("DTPU_BENCH_BATCH", "256")) * jax.device_count()
-model = build_model("botnet50", num_classes=1000)
-state, _ = create_train_state(model, jax.random.PRNGKey(0), mesh, 224)
-step = make_train_step(model, optim.construct_optimizer(), mesh, topk=5)
-batch = make_synthetic_batch(mesh, B)
-lr, key = jnp.asarray(0.1, jnp.float32), jax.random.PRNGKey(1)
-for _ in range(3):
-    state, m = step(state, batch, lr, key); jax.device_get(m)
-t0 = time.perf_counter()
-for _ in range(10):
-    state, m = step(state, batch, lr, key); jax.device_get(m)
-dt = (time.perf_counter() - t0) / 10
-print(json.dumps({"metric": "botnet50 fused-attn img/s/chip", "value": round(B / dt / jax.device_count(), 1)}))
-EOF
+if [ $soak_rc -eq 0 ]; then
+    run_or_abort "botnet50 fused-attention bench" \
+        env DTPU_FUSED_ATTN=1 DTPU_BENCH_ARCH=botnet50 DTPU_BENCH_BATCH=256 \
+        timeout 600 python bench.py
+fi
 
 say "done — full log at $LOG"
