@@ -1,6 +1,11 @@
 """Input pipeline: datasets, torch-free transforms, sharded host loaders."""
 
-from distribuuuu_tpu.data.dataset import DummyDataset, ImageFolder
+from distribuuuu_tpu.data.dataset import (
+    DummyDataset,
+    ImageFolder,
+    TarImageFolder,
+    open_image_dataset,
+)
 from distribuuuu_tpu.data.loader import (
     construct_train_loader,
     construct_val_loader,
@@ -10,6 +15,8 @@ from distribuuuu_tpu.data.loader import (
 __all__ = [
     "DummyDataset",
     "ImageFolder",
+    "TarImageFolder",
+    "open_image_dataset",
     "construct_train_loader",
     "construct_val_loader",
     "prefetch_to_device",

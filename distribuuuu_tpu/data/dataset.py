@@ -1,17 +1,26 @@
-"""Datasets: ImageFolder (torch-free) and the dummy smoke-test dataset.
+"""Datasets: ImageFolder (torch-free), tar shards, and the dummy smoke set.
 
 `ImageFolder` replicates ``torchvision.datasets.ImageFolder`` semantics the
 reference trains on (`/root/reference/distribuuuu/utils.py:126-138`):
 class-per-subdirectory, classes sorted lexicographically → contiguous ids.
 
+`TarImageFolder` is the TPU-scale layout the reference lacks: the same
+class-per-subdirectory tree packed into `*.tar` shards (webdataset-style).
+ImageNet as an ImageFolder is 1.3M tiny files — metadata stalls kill feed
+rate on network filesystems; as a few hundred tar shards it is sequential
+reads. Members are indexed once per run (tar headers only) and read with
+positional `os.pread` (thread-safe, no per-image open), then decoded
+straight from memory by the native library (`decode_*_u8_mem`).
+
 `DummyDataset` is the DUMMY_INPUT fake-data path (`utils.py:109-118`): random
-normalized pixels, label 0, length 1000 — the framework's first-class
+u8 pixels, label 0, length 1000 — the framework's first-class
 integration-smoke mechanism (SURVEY §4.1), kept identical in contract.
 """
 
 from __future__ import annotations
 
 import os
+import tarfile
 from dataclasses import dataclass
 
 import numpy as np
@@ -48,6 +57,90 @@ class ImageFolder:
 
     def __len__(self) -> int:
         return len(self.samples)
+
+
+class TarImageFolder:
+    """ImageFolder semantics over ``root/*.tar`` shards.
+
+    Member names are ``<class_name>/<file>`` — i.e. a tarred ImageFolder
+    split (``tar cf shard-000.tar class_a/... class_b/...``, or
+    ``scripts/make_tar_shards.py``). Classes are the sorted union of member
+    top-level directories across shards, so labels match what `ImageFolder`
+    assigns to the unpacked tree. ``samples`` holds (member_name, class_id)
+    like ImageFolder's (path, class_id); bytes come from :meth:`read_bytes`.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        self.shards = sorted(
+            os.path.join(root, f) for f in os.listdir(root) if f.endswith(".tar")
+        )
+        if not self.shards:
+            raise FileNotFoundError(f"No .tar shards under {root}")
+        names: list[str] = []
+        locs: list[tuple[int, int, int]] = []  # (shard_idx, offset, size)
+        classes: set[str] = set()
+        for si, shard in enumerate(self.shards):
+            # header-only scan: streams the tar once, no member extraction
+            with tarfile.open(shard, "r:") as tf:
+                for m in tf:
+                    if not m.isfile() or "/" not in m.name:
+                        continue
+                    if not m.name.lower().endswith(IMG_EXTENSIONS):
+                        continue
+                    cls = m.name.split("/", 1)[0]
+                    classes.add(cls)
+                    names.append(m.name)
+                    locs.append((si, m.offset_data, m.size))
+        if not names:
+            raise FileNotFoundError(
+                f"No class-dir image members in the shards under {root}"
+            )
+        self.classes = sorted(classes)
+        self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
+        self.samples = [
+            (n, self.class_to_idx[n.split("/", 1)[0]]) for n in names
+        ]
+        self._locs = locs
+        # one O_RDONLY fd per shard; os.pread is positional → thread-safe
+        self._fds = [os.open(s, os.O_RDONLY) for s in self.shards]
+
+    def read_bytes(self, idx: int) -> tuple[bytes, str]:
+        """(jpeg_bytes, member_name) for sample idx; GIL-friendly pread."""
+        si, off, size = self._locs[idx]
+        fd = self._fds[si]
+        # pread may return short on network filesystems: accumulate to size
+        chunks = []
+        got = 0
+        while got < size:
+            chunk = os.pread(fd, size - got, off + got)
+            if not chunk:
+                raise IOError(
+                    f"short read in {self.shards[si]} at {off + got} "
+                    f"({got}/{size} bytes of {self.samples[idx][0]})"
+                )
+            chunks.append(chunk)
+            got += len(chunk)
+        return b"".join(chunks) if len(chunks) > 1 else chunks[0], self.samples[idx][0]
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def __del__(self):
+        for fd in getattr(self, "_fds", []):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+
+def open_image_dataset(root: str):
+    """ImageFolder or TarImageFolder, by what's in the directory."""
+    if os.path.isdir(root) and any(
+        f.endswith(".tar") for f in os.listdir(root)
+    ):
+        return TarImageFolder(root)
+    return ImageFolder(root)
 
 
 class DummyDataset:

@@ -23,6 +23,7 @@ compute (the pinned-memory/non_blocking analog, `trainer.py:40`).
 
 from __future__ import annotations
 
+import io
 import os
 import queue
 import random
@@ -36,7 +37,7 @@ from PIL import Image
 
 from distribuuuu_tpu.config import cfg, get_default
 from distribuuuu_tpu.data import native
-from distribuuuu_tpu.data.dataset import DummyDataset, ImageFolder
+from distribuuuu_tpu.data.dataset import DummyDataset, ImageFolder, open_image_dataset
 from distribuuuu_tpu.data.transforms import eval_transform_u8, train_transform_u8
 
 
@@ -52,7 +53,7 @@ class HostDataLoader:
 
     def __init__(
         self,
-        dataset: ImageFolder,
+        dataset: "ImageFolder | object",  # any dataset with .samples (+ optional .read_bytes)
         *,
         host_batch: int,
         train: bool,
@@ -123,19 +124,32 @@ class HostDataLoader:
         if idx < 0:  # eval padding slot: zero image, weight 0 (masked in metrics)
             size = self.im_size if self.train else self.crop_size
             return np.zeros((size, size, 3), dtype=np.uint8), 0, 0.0
-        path, label = self.dataset.samples[idx]
-        if self.use_native and path.lower().endswith((".jpg", ".jpeg")):
+        name, label = self.dataset.samples[idx]
+        # tar shards hand back member bytes (positional pread, no per-image
+        # open); plain ImageFolder decodes straight from the path
+        data = None
+        if hasattr(self.dataset, "read_bytes"):
+            data, name = self.dataset.read_bytes(idx)
+        if self.use_native and name.lower().endswith((".jpg", ".jpeg")):
             # C++ decode+transform, GIL-free (native/dtpu_decode.cc); falls
             # through to PIL on decode failure (e.g. odd colorspace). Raw u8
             # out — normalization happens on-device (transforms.device_normalize)
             # so the H2D copy is 4x smaller than shipping float32.
             if self.train:
-                arr = native.decode_train_u8(path, self.im_size, slot_seed)
+                arr = (
+                    native.decode_train_u8_mem(data, self.im_size, slot_seed)
+                    if data is not None
+                    else native.decode_train_u8(name, self.im_size, slot_seed)
+                )
             else:
-                arr = native.decode_eval_u8(path, self.im_size, self.crop_size)
+                arr = (
+                    native.decode_eval_u8_mem(data, self.im_size, self.crop_size)
+                    if data is not None
+                    else native.decode_eval_u8(name, self.im_size, self.crop_size)
+                )
             if arr is not None:
                 return arr, label, 1.0
-        with Image.open(path) as im:
+        with Image.open(io.BytesIO(data) if data is not None else name) as im:
             im = im.convert("RGB")
             if self.train:
                 arr = train_transform_u8(im, self.im_size, rng=random.Random(slot_seed))
@@ -257,7 +271,7 @@ def construct_train_loader():
             cfg.TRAIN.IM_SIZE,
             num_batches=1000 // max(1, step_batch * global_dev),
         )
-    dataset = ImageFolder(os.path.join(cfg.TRAIN.DATASET, cfg.TRAIN.SPLIT))
+    dataset = open_image_dataset(os.path.join(cfg.TRAIN.DATASET, cfg.TRAIN.SPLIT))
     return HostDataLoader(
         dataset,
         host_batch=host_batch,
@@ -297,7 +311,7 @@ def construct_val_loader():
         if cfg.TEST.DATASET != get_default("TEST.DATASET")
         else cfg.TRAIN.DATASET
     )
-    dataset = ImageFolder(os.path.join(val_root, cfg.TEST.SPLIT))
+    dataset = open_image_dataset(os.path.join(val_root, cfg.TEST.SPLIT))
     return HostDataLoader(
         dataset,
         host_batch=host_batch,

@@ -47,8 +47,8 @@ def _load():
 
 def _bind(lib):
     lib_version = getattr(lib, "dtpu_version", None)
-    if lib_version is None or lib_version() < 2:
-        raise AttributeError("library predates the u8 decode API (need v2+)")
+    if lib_version is None or lib_version() < 3:
+        raise AttributeError("library predates the mem-source decode API (need v3+)")
     lib.dtpu_decode_eval.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_float),
@@ -69,6 +69,16 @@ def _bind(lib):
         ctypes.POINTER(ctypes.c_uint8),
     ]
     lib.dtpu_decode_eval_u8.restype = ctypes.c_int
+    lib.dtpu_decode_train_u8_mem.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t, ctypes.c_int,
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.dtpu_decode_train_u8_mem.restype = ctypes.c_int
+    lib.dtpu_decode_eval_u8_mem.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.dtpu_decode_eval_u8_mem.restype = ctypes.c_int
     return lib
 
 
@@ -118,6 +128,34 @@ def decode_eval_u8(path: str, resize: int, crop: int) -> np.ndarray | None:
     out = np.empty((crop, crop, 3), np.uint8)
     rc = lib.dtpu_decode_eval_u8(
         path.encode(), resize, crop,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return out if rc == 0 else None
+
+
+def _u8_buf(data: bytes):
+    # zero-copy view of the bytes object's buffer; the bytes object outlives
+    # the synchronous decode call, so the pointer stays valid throughout
+    return ctypes.cast(ctypes.c_char_p(data), ctypes.POINTER(ctypes.c_uint8))
+
+
+def decode_train_u8_mem(data: bytes, size: int, seed: int) -> np.ndarray | None:
+    """:func:`decode_train_u8` from in-memory JPEG bytes (tar-shard members)."""
+    lib = _load()
+    out = np.empty((size, size, 3), np.uint8)
+    rc = lib.dtpu_decode_train_u8_mem(
+        _u8_buf(data), len(data), size, ctypes.c_uint64(seed),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return out if rc == 0 else None
+
+
+def decode_eval_u8_mem(data: bytes, resize: int, crop: int) -> np.ndarray | None:
+    """:func:`decode_eval_u8` from in-memory JPEG bytes."""
+    lib = _load()
+    out = np.empty((crop, crop, 3), np.uint8)
+    rc = lib.dtpu_decode_eval_u8_mem(
+        _u8_buf(data), len(data), resize, crop,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
     )
     return out if rc == 0 else None

@@ -48,22 +48,53 @@ void jpeg_err_exit(j_common_ptr cinfo) {
   longjmp(err->jump, 1);
 }
 
+// JPEG bytes come either from a file path or an in-memory buffer (tar-shard
+// members are read straight out of the archive, no temp files).
+struct Source {
+  const char* path = nullptr;     // used when buf == nullptr
+  const uint8_t* buf = nullptr;
+  size_t len = 0;
+};
+
+// Attach `src` to cinfo; returns the FILE* to close after decoding (or null
+// for memory sources). Null with failure when the path can't be opened.
+FILE* attach_source(jpeg_decompress_struct* cinfo, const Source& src, bool* ok) {
+  *ok = true;
+  if (src.buf) {
+    jpeg_mem_src(cinfo, src.buf, src.len);
+    return nullptr;
+  }
+  FILE* f = fopen(src.path, "rb");
+  if (!f) {
+    *ok = false;
+    return nullptr;
+  }
+  jpeg_stdio_src(cinfo, f);
+  return f;
+}
+
 // --- decode ---------------------------------------------------------------
 
-bool decode_jpeg(const char* path, std::vector<uint8_t>* pixels, int* w, int* h) {
-  FILE* f = fopen(path, "rb");
-  if (!f) return false;
+bool decode_jpeg(const Source& src, std::vector<uint8_t>* pixels, int* w, int* h) {
   jpeg_decompress_struct cinfo;
   JpegErr jerr;
   cinfo.err = jpeg_std_error(&jerr.mgr);
   jerr.mgr.error_exit = jpeg_err_exit;
+  // volatile: assigned between setjmp and longjmp, read in the recovery
+  // branch (C11 7.13.2.1 — same pattern as libjpeg's example.c)
+  FILE* volatile f = nullptr;
   if (setjmp(jerr.jump)) {
     jpeg_destroy_decompress(&cinfo);
-    fclose(f);
+    if (f) fclose(f);
     return false;
   }
   jpeg_create_decompress(&cinfo);
-  jpeg_stdio_src(&cinfo, f);
+  bool ok;
+  f = attach_source(&cinfo, src, &ok);
+  if (!ok) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
   jpeg_read_header(&cinfo, TRUE);
   cinfo.out_color_space = JCS_RGB;
   jpeg_start_decompress(&cinfo);
@@ -76,7 +107,7 @@ bool decode_jpeg(const char* path, std::vector<uint8_t>* pixels, int* w, int* h)
   }
   jpeg_finish_decompress(&cinfo);
   jpeg_destroy_decompress(&cinfo);
-  fclose(f);
+  if (f) fclose(f);
   return true;
 }
 
@@ -253,21 +284,27 @@ struct Region {
 // the input-pipeline equivalent of the reference's reliance on torch's C++
 // loader workers. When `rng` is non-null the crop box is sampled here (one
 // header parse per image); otherwise the caller's box is used as given.
-bool decode_region(const char* path, Rng* rng, int* cx, int* cy, int* cw,
+bool decode_region(const Source& src, Rng* rng, int* cx, int* cy, int* cw,
                    int* ch, int min_out, Region* out) {
-  FILE* f = fopen(path, "rb");
-  if (!f) return false;
   jpeg_decompress_struct cinfo;
   JpegErr jerr;
   cinfo.err = jpeg_std_error(&jerr.mgr);
   jerr.mgr.error_exit = jpeg_err_exit;
+  // volatile: assigned between setjmp and longjmp, read in the recovery
+  // branch (C11 7.13.2.1 — same pattern as libjpeg's example.c)
+  FILE* volatile f = nullptr;
   if (setjmp(jerr.jump)) {
     jpeg_destroy_decompress(&cinfo);
-    fclose(f);
+    if (f) fclose(f);
     return false;
   }
   jpeg_create_decompress(&cinfo);
-  jpeg_stdio_src(&cinfo, f);
+  bool ok;
+  f = attach_source(&cinfo, src, &ok);
+  if (!ok) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
   jpeg_read_header(&cinfo, TRUE);
   cinfo.out_color_space = JCS_RGB;
   if (rng)
@@ -309,7 +346,7 @@ bool decode_region(const char* path, Rng* rng, int* cx, int* cy, int* cw,
   }
   jpeg_abort_decompress(&cinfo);  // early out: remaining rows never decoded
   jpeg_destroy_decompress(&cinfo);
-  fclose(f);
+  if (f) fclose(f);
   out->w = int(xw);
   out->h = rows;
   out->off_x = int(xoff);
@@ -322,10 +359,10 @@ bool decode_region(const char* path, Rng* rng, int* cx, int* cy, int* cw,
 // Shared eval geometry: resize-shorter + center-crop fused into one source
 // box, resampled to crop² floats (0..255). Both eval entry points use this
 // so the f32 and u8 paths cannot drift apart.
-bool eval_crop_to_float(const char* path, int resize, int crop, float* dst) {
+bool eval_crop_to_float(const Source& src, int resize, int crop, float* dst) {
   std::vector<uint8_t> px;
   int w, h;
-  if (!decode_jpeg(path, &px, &w, &h)) return false;
+  if (!decode_jpeg(src, &px, &w, &h)) return false;
   // long side truncates, matching torchvision/_compute_resized_output_size
   // (and data/transforms.py resize_shorter)
   int rw, rh;
@@ -364,46 +401,12 @@ void round_to_u8(const float* src, int h, int w, bool hflip, uint8_t* dst) {
   }
 }
 
-}  // namespace
-
-extern "C" {
-
-// Decode + eval transform: resize shorter side to `resize`, center-crop
-// `crop`, normalize. dst must hold crop*crop*3 floats. Returns 0 on success.
-int dtpu_decode_eval(const char* path, int resize, int crop, float* dst) {
-  if (!eval_crop_to_float(path, resize, crop, dst)) return 1;
-  normalize_inplace(dst, crop * crop, false, crop);
-  return 0;
-}
-
-// Decode + train transform (RandomResizedCrop + flip), seeded. Returns 0 ok.
-int dtpu_decode_train(const char* path, int size, uint64_t seed, float* dst) {
-  std::vector<uint8_t> px;
-  int w, h;
-  if (!decode_jpeg(path, &px, &w, &h)) return 1;
-  Rng rng(seed);
-  int cx, cy, cw, ch;
-  sample_crop(rng, w, h, &cx, &cy, &cw, &ch);
-  resample_box(px.data(), w, h, float(cx), float(cy), float(cx + cw),
-               float(cy + ch), size, size, dst);
-  bool flip = rng.uniform() < 0.5;
-  normalize_inplace(dst, size * size, flip, size);
-  return 0;
-}
-
-// u8 variants: raw RGB out (normalization runs on-device, fused into the
-// first conv by XLA), and the train path decodes only the sampled crop box
-// at a reduced DCT scale — both the H2D copy and the host decode shrink.
-
-// Train: sample crop (inside decode_region, one header parse) → partial
-// scaled decode of the box → downsample-only resample → flip → u8.
-// dst: size²×3.
-int dtpu_decode_train_u8(const char* path, int size, uint64_t seed,
-                         uint8_t* dst) {
+// Shared train-u8 body for file and memory sources.
+int train_u8_impl(const Source& src, int size, uint64_t seed, uint8_t* dst) {
   Rng rng(seed);
   int cx, cy, cw, ch;
   Region reg;
-  if (!decode_region(path, &rng, &cx, &cy, &cw, &ch, size, &reg)) return 1;
+  if (!decode_region(src, &rng, &cx, &cy, &cw, &ch, size, &reg)) return 1;
   // crop box mapped into the decoded buffer's coordinates
   float bx0 = float(cx * reg.sx - reg.off_x);
   float by0 = float(cy * reg.sy - reg.off_y);
@@ -417,15 +420,70 @@ int dtpu_decode_train_u8(const char* path, int size, uint64_t seed,
   return 0;
 }
 
-// Eval: full decode (bit-parity with the PIL path — no DCT scaling) +
-// fused resize/center-crop resample → u8. dst: crop²×3.
-int dtpu_decode_eval_u8(const char* path, int resize, int crop, uint8_t* dst) {
+int eval_u8_impl(const Source& src, int resize, int crop, uint8_t* dst) {
   std::vector<float> tmp(size_t(crop) * crop * 3);
-  if (!eval_crop_to_float(path, resize, crop, tmp.data())) return 1;
+  if (!eval_crop_to_float(src, resize, crop, tmp.data())) return 1;
   round_to_u8(tmp.data(), crop, crop, false, dst);
   return 0;
 }
 
-int dtpu_version() { return 2; }
+}  // namespace
+
+extern "C" {
+
+// Decode + eval transform: resize shorter side to `resize`, center-crop
+// `crop`, normalize. dst must hold crop*crop*3 floats. Returns 0 on success.
+int dtpu_decode_eval(const char* path, int resize, int crop, float* dst) {
+  if (!eval_crop_to_float({path}, resize, crop, dst)) return 1;
+  normalize_inplace(dst, crop * crop, false, crop);
+  return 0;
+}
+
+// Decode + train transform (RandomResizedCrop + flip), seeded. Returns 0 ok.
+int dtpu_decode_train(const char* path, int size, uint64_t seed, float* dst) {
+  std::vector<uint8_t> px;
+  int w, h;
+  if (!decode_jpeg({path}, &px, &w, &h)) return 1;
+  Rng rng(seed);
+  int cx, cy, cw, ch;
+  sample_crop(rng, w, h, &cx, &cy, &cw, &ch);
+  resample_box(px.data(), w, h, float(cx), float(cy), float(cx + cw),
+               float(cy + ch), size, size, dst);
+  bool flip = rng.uniform() < 0.5;
+  normalize_inplace(dst, size * size, flip, size);
+  return 0;
+}
+
+// u8 variants: raw RGB out (normalization runs on-device, fused into the
+// first conv by XLA), and the train path decodes only the sampled crop box
+// at a reduced DCT scale — both the H2D copy and the host decode shrink.
+// The _mem twins decode from an in-memory buffer (tar-shard members read
+// straight out of the archive — no temp files, no per-image open()).
+
+// Train: sample crop (inside decode_region, one header parse) → partial
+// scaled decode of the box → downsample-only resample → flip → u8.
+// dst: size²×3.
+int dtpu_decode_train_u8(const char* path, int size, uint64_t seed,
+                         uint8_t* dst) {
+  return train_u8_impl({path}, size, seed, dst);
+}
+
+int dtpu_decode_train_u8_mem(const uint8_t* buf, size_t len, int size,
+                             uint64_t seed, uint8_t* dst) {
+  return train_u8_impl({nullptr, buf, len}, size, seed, dst);
+}
+
+// Eval: full decode (bit-parity with the PIL path — no DCT scaling) +
+// fused resize/center-crop resample → u8. dst: crop²×3.
+int dtpu_decode_eval_u8(const char* path, int resize, int crop, uint8_t* dst) {
+  return eval_u8_impl({path}, resize, crop, dst);
+}
+
+int dtpu_decode_eval_u8_mem(const uint8_t* buf, size_t len, int resize,
+                            int crop, uint8_t* dst) {
+  return eval_u8_impl({nullptr, buf, len}, resize, crop, dst);
+}
+
+int dtpu_version() { return 3; }
 
 }  // extern "C"

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Pack an ImageFolder split into tar shards (TarImageFolder layout).
+
+ImageNet as a 1.3M-file ImageFolder stalls network filesystems on metadata;
+as a few hundred tar shards it is sequential reads (see
+distribuuuu_tpu/data/dataset.py::TarImageFolder). Member names keep the
+``<class>/<file>`` layout, so labels match the unpacked tree exactly.
+
+    python scripts/make_tar_shards.py --src /data/ILSVRC/train \
+        --dst /data/ILSVRC-shards/train --shard-size 512
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tarfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distribuuuu_tpu.data.dataset import ImageFolder  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--src", required=True, help="ImageFolder split directory")
+    ap.add_argument("--dst", required=True, help="output directory for *.tar")
+    ap.add_argument("--shard-size", type=int, default=512, help="images per shard")
+    args = ap.parse_args()
+
+    ds = ImageFolder(args.src)
+    os.makedirs(args.dst, exist_ok=True)
+    stale = [f for f in os.listdir(args.dst) if f.endswith(".tar")]
+    if stale:
+        # TarImageFolder indexes every .tar in the directory: mixing
+        # generations silently duplicates samples. Refuse rather than append.
+        raise SystemExit(
+            f"{args.dst} already holds {len(stale)} .tar shard(s); "
+            f"remove them (or pick a fresh --dst) before re-packing"
+        )
+    n_shards = 0
+    tf = None
+    for i, (path, label) in enumerate(ds.samples):
+        if i % args.shard_size == 0:
+            if tf is not None:
+                tf.close()
+            tf = tarfile.open(
+                os.path.join(args.dst, f"shard-{n_shards:05d}.tar"), "w"
+            )
+            n_shards += 1
+        member = f"{ds.classes[label]}/{os.path.basename(path)}"
+        tf.add(path, arcname=member, recursive=False)
+    if tf is not None:
+        tf.close()
+    print(f"wrote {n_shards} shard(s), {len(ds.samples)} images → {args.dst}")
+
+
+if __name__ == "__main__":
+    main()
