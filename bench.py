@@ -20,6 +20,11 @@ import os
 import threading
 import time
 
+# Imported before the watchdog timer starts: benchutil is deliberately
+# jax-free (see its docstring), and having it in sys.modules means the
+# timer thread's _variant_tags() never touches the import machinery — the
+# main thread may be wedged *inside* `import jax` holding import locks.
+from distribuuuu_tpu.benchutil import bench_arms, s2d_default
 
 A100_FP32_IMGS_PER_SEC_PER_GPU = 400.0  # 8xA100 DDP fp32 resnet50 reference point
 
@@ -27,11 +32,14 @@ A100_FP32_IMGS_PER_SEC_PER_GPU = 400.0  # 8xA100 DDP fp32 resnet50 reference poi
 def _variant_tags() -> str:
     """Metric-label suffixes for A/B env toggles, so recorded JSON lines from
     different arms stay distinguishable (even on watchdog timeout)."""
+    arch, stem_s2d, bn_f32 = bench_arms()
     tags = ""
-    if os.environ.get("DTPU_BENCH_S2D", "0") == "1":
-        tags += " +s2d"
+    if stem_s2d != s2d_default(arch):
+        tags += " +s2d" if stem_s2d else " +nos2d"
     if os.environ.get("DTPU_FUSED_ATTN", "0") == "1":
         tags += " +fused-attn"
+    if bn_f32:
+        tags += " +bnf32"
     return tags
 
 WATCHDOG_SECONDS = 540  # the tunnel to the chip can wedge; never hang the driver
@@ -77,12 +85,15 @@ def main():
     global_batch = per_chip_batch * n_chips
 
     mesh = data_mesh(-1)
-    # DTPU_BENCH_S2D=1 switches the stem to the space-to-depth transform
-    # (identical math, MXU-shaped; tests prove equality to f32 noise) for A/B
-    # runs; DTPU_BENCH_ARCH benches another zoo arch with the same harness
-    # (s2d only applies to resnet/botnet families).
-    stem_s2d = os.environ.get("DTPU_BENCH_S2D", "0") == "1"
-    arch = os.environ.get("DTPU_BENCH_ARCH", "resnet50")
+    # Default arm = the shipped-best TPU recipe: bf16 BN boundaries
+    # (+20% measured; statistics still f32) and the space-to-depth stem for
+    # resnet/botnet families (identical math, MXU-shaped; tests prove
+    # equality to f32 noise). Env opt-outs select A/B arms — see
+    # benchutil.bench_arms.
+    from distribuuuu_tpu.models.layers import set_bn_compute_dtype
+
+    arch, stem_s2d, bn_f32 = bench_arms()
+    set_bn_compute_dtype(jnp.float32 if bn_f32 else jnp.bfloat16)
     kw = {"stem_s2d": True} if stem_s2d else {}
     model = build_model(arch, num_classes=1000, **kw)  # bf16 trunk by default
     state, tx = create_train_state(model, jax.random.PRNGKey(0), mesh, 224)
@@ -97,16 +108,25 @@ def main():
         state, m = train_step(state, batch, lr, key)
         jax.device_get(m)
 
-    # NOTE: syncs every step via a real device->host metric fetch
-    # (jax.device_get). On the experimental axon transport plain
-    # block_until_ready is a no-op, which silently inflated throughput ~100x;
-    # the 16-byte metric fetch costs <1% at ~130ms steps and bounds true
-    # device time.
+    # Timing is gated by real device->host metric fetches (jax.device_get):
+    # on the experimental axon transport plain block_until_ready is a no-op,
+    # which silently inflated throughput ~100x. The fetch cadence is every
+    # FETCH_EVERY steps — the production trainer's PRINT_FREQ behavior (its
+    # metrics accumulate on device, default PRINT_FREQ=30). This is NOT
+    # inflation: successive steps chain through `state`, so the fetch at step
+    # N gates on every prior step's device work, and the timer stops only
+    # after the final fetch returns. Per-step fetching (the round-1 method)
+    # serializes the tunnel's ~5 ms dispatch overhead into every step and
+    # under-reports by ~25% vs what a real training loop achieves
+    # (docs/BENCH_NOTES.md round-2 pipelining section).
+    FETCH_EVERY = 10
     iters = 20
     t0 = time.perf_counter()
-    for _ in range(iters):
+    for i in range(iters):
         state, m = train_step(state, batch, lr, key)
-        jax.device_get(m)
+        if (i + 1) % FETCH_EVERY == 0:
+            jax.device_get(m)
+    jax.device_get(m)
     dt = time.perf_counter() - t0
 
     timer.cancel()

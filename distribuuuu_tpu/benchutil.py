@@ -1,15 +1,46 @@
-"""Shared helpers for benchmarking scripts (bench.py, scripts/perf_sweep.py)."""
+"""Shared helpers for benchmarking scripts (bench.py, scripts/perf_sweep.py,
+scripts/profile_step.py).
+
+Import-light on purpose: bench.py's wedge watchdog calls :func:`bench_arms`
+from a timer thread while the main thread may be blocked *inside* `import
+jax` (the tunnel's known wedge point) holding the import lock — a top-level
+jax import here would deadlock that thread instead of letting it hard-exit.
+"""
 
 from __future__ import annotations
 
-import jax
-import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+import os
+
+
+def s2d_default(arch: str) -> bool:
+    """Space-to-depth stem exists for the resnet/botnet families (exact same
+    function — tests assert equality) and is the shipped-recipe default there."""
+    return arch.startswith(("resnet", "resnext", "wide_resnet", "botnet"))
+
+
+def bench_arms():
+    """Resolve the benched configuration from the A/B env opt-outs — ONE
+    policy shared by every measurement tool so they all measure the same arm.
+
+    Default arm = the shipped-best TPU recipe (bf16 BN boundaries, s2d stem
+    where applicable); ``DTPU_BENCH_BNF32=1`` / ``DTPU_BENCH_S2D=0`` select
+    the f32-boundary / plain-stem arms; ``DTPU_BENCH_ARCH`` picks the arch.
+    Returns (arch, stem_s2d, bn_f32).
+    """
+    arch = os.environ.get("DTPU_BENCH_ARCH", "resnet50")
+    s2d_env = os.environ.get("DTPU_BENCH_S2D")
+    stem_s2d = (s2d_env == "1") if s2d_env is not None else s2d_default(arch)
+    bn_f32 = os.environ.get("DTPU_BENCH_BNF32", "0") == "1"
+    return arch, stem_s2d, bn_f32
 
 
 def make_synthetic_batch(mesh, global_batch: int, im_size: int = 224, seed: int = 0):
     """Synthetic sharded train batch with the loader's exact field contract
     (raw u8 images — the real H2D payload; normalize runs inside the step)."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     rng = np.random.default_rng(seed)
     return {
         "image": jax.device_put(

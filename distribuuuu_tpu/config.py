@@ -43,6 +43,14 @@ _C.MODEL.REMAT = False
 # Space-to-depth stem (resnet/botnet families): exact same math, MXU-shaped
 # compute for the 7x7/2 3-channel stem conv. Checkpoint-compatible both ways.
 _C.MODEL.STEM_S2D = False
+# BatchNorm boundary dtype: what dtype BN *emits* between conv stages.
+# Statistics are always computed in float32 and running stats/affine params
+# always stored float32; "bfloat16" halves inter-stage HBM traffic (the
+# MLPerf-era TPU recipe: +20% measured on resnet50/v5e, docs/BENCH_NOTES.md),
+# "float32" keeps full-precision boundaries. "auto" (default) tracks
+# MODEL.DTYPE — bf16 training gets bf16 boundaries, f32 exact-parity runs
+# stay f32 end-to-end.
+_C.MODEL.BN_DTYPE = "auto"
 
 _C.TRAIN = CN()
 _C.TRAIN.BATCH_SIZE = 32  # per-device batch size, matching the reference's

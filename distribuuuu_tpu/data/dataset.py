@@ -64,10 +64,15 @@ class TarImageFolder:
 
     Member names are ``<class_name>/<file>`` — i.e. a tarred ImageFolder
     split (``tar cf shard-000.tar class_a/... class_b/...``, or
-    ``scripts/make_tar_shards.py``). Classes are the sorted union of member
-    top-level directories across shards, so labels match what `ImageFolder`
-    assigns to the unpacked tree. ``samples`` holds (member_name, class_id)
-    like ImageFolder's (path, class_id); bytes come from :meth:`read_bytes`.
+    ``scripts/make_tar_shards.py``). Leading ``./`` segments (``tar cf x.tar
+    ./class_a``) are normalized away. Classes come from a ``classes.txt``
+    manifest next to the shards when present (one name per line, written by
+    `make_tar_shards.py` from the *source tree's* class list — this is what
+    guarantees label parity with `ImageFolder` even when some class has no
+    samples in the shards); otherwise they are the sorted union of member
+    top-level directories, which matches `ImageFolder` only when every class
+    dir is represented. ``samples`` holds (member_name, class_id) like
+    ImageFolder's (path, class_id); bytes come from :meth:`read_bytes`.
     """
 
     def __init__(self, root: str):
@@ -84,19 +89,36 @@ class TarImageFolder:
             # header-only scan: streams the tar once, no member extraction
             with tarfile.open(shard, "r:") as tf:
                 for m in tf:
-                    if not m.isfile() or "/" not in m.name:
+                    if not m.isfile():
                         continue
-                    if not m.name.lower().endswith(IMG_EXTENSIONS):
+                    # normalize "./class_a/x.jpg" → "class_a/x.jpg"
+                    name = m.name
+                    while name.startswith("./"):
+                        name = name[2:]
+                    if "/" not in name:
                         continue
-                    cls = m.name.split("/", 1)[0]
+                    if not name.lower().endswith(IMG_EXTENSIONS):
+                        continue
+                    cls = name.split("/", 1)[0]
                     classes.add(cls)
-                    names.append(m.name)
+                    names.append(name)
                     locs.append((si, m.offset_data, m.size))
         if not names:
             raise FileNotFoundError(
                 f"No class-dir image members in the shards under {root}"
             )
-        self.classes = sorted(classes)
+        manifest = os.path.join(root, "classes.txt")
+        if os.path.isfile(manifest):
+            with open(manifest) as f:
+                self.classes = [ln.strip() for ln in f if ln.strip()]
+            missing = classes - set(self.classes)
+            if missing:
+                raise ValueError(
+                    f"{manifest} is missing classes found in the shards: "
+                    f"{sorted(missing)[:5]}{'...' if len(missing) > 5 else ''}"
+                )
+        else:
+            self.classes = sorted(classes)
         self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
         self.samples = [
             (n, self.class_to_idx[n.split("/", 1)[0]]) for n in names

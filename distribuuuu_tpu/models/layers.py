@@ -28,6 +28,24 @@ kaiming_normal_out = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
 # torch nn.Linear default: U(-1/sqrt(fan_in), 1/sqrt(fan_in)).
 linear_uniform = nn.initializers.variance_scaling(1.0 / 3.0, "fan_in", "uniform")
 
+# BN boundary (output) dtype for the whole zoo. float32 keeps every
+# conv→BN→relu boundary in full precision but doubles the HBM bytes between
+# conv stages and can split XLA fusions; bfloat16 is the MLPerf-era TPU
+# recipe (statistics are STILL computed in float32 — flax upcasts half dtypes
+# inside `_compute_stats` — and running stats/affine params stay float32;
+# only the normalized activations are emitted in bf16). bf16 boundaries are
+# +20% measured on resnet50/v5e (docs/BENCH_NOTES.md). Set once before
+# model construction — the trainer derives it from cfg.MODEL.BN_DTYPE
+# ("auto" tracks MODEL.DTYPE), bench.py sets the shipped-best arm. The bare
+# default stays float32 so direct build_model() calls are full-precision.
+# Reading happens at trace time, so flipping it requires re-jitting.
+_BN_COMPUTE_DTYPE: Any = jnp.float32
+
+
+def set_bn_compute_dtype(dtype: Any) -> None:
+    global _BN_COMPUTE_DTYPE
+    _BN_COMPUTE_DTYPE = dtype
+
 
 def conv(
     features: int,
@@ -78,13 +96,15 @@ def batch_norm(
     the XLA-collective replacement for `nn.SyncBatchNorm.convert_sync_batchnorm`
     (`/root/reference/distribuuuu/trainer.py:131`).
 
-    Always computes in float32 regardless of the surrounding compute dtype.
+    Statistics are always computed in float32; the module-level
+    :data:`_BN_COMPUTE_DTYPE` (single source of truth — see the note above
+    `set_bn_compute_dtype`) only controls the emitted activation dtype.
     """
     return nn.BatchNorm(
         use_running_average=not train,
         momentum=momentum,
         epsilon=epsilon,
-        dtype=jnp.float32,
+        dtype=_BN_COMPUTE_DTYPE,
         param_dtype=jnp.float32,
         axis_name=axis_name,
         scale_init=nn.initializers.zeros if zero_scale else nn.initializers.ones,

@@ -237,6 +237,12 @@ def create_train_state(model, key, mesh: Mesh, im_size: int):
 
 
 def _build_cfg_model():
+    from distribuuuu_tpu.models.layers import set_bn_compute_dtype
+
+    bn_dtype = cfg.MODEL.BN_DTYPE
+    if bn_dtype == "auto":
+        bn_dtype = cfg.MODEL.DTYPE
+    set_bn_compute_dtype(jnp.bfloat16 if bn_dtype == "bfloat16" else jnp.float32)
     bn_axis = "data" if cfg.MODEL.SYNCBN else None
     kwargs = {}
     if cfg.MODEL.STEM_S2D:  # resnet/botnet-family option; loud TypeError elsewhere

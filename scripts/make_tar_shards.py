@@ -4,7 +4,9 @@
 ImageNet as a 1.3M-file ImageFolder stalls network filesystems on metadata;
 as a few hundred tar shards it is sequential reads (see
 distribuuuu_tpu/data/dataset.py::TarImageFolder). Member names keep the
-``<class>/<file>`` layout, so labels match the unpacked tree exactly.
+``<class>/<file>`` layout, and a ``classes.txt`` manifest records the source
+tree's full class list, so labels match the unpacked tree exactly — even for
+classes that end up with zero samples in the shards.
 
     python scripts/make_tar_shards.py --src /data/ILSVRC/train \
         --dst /data/ILSVRC-shards/train --shard-size 512
@@ -39,6 +41,10 @@ def main() -> None:
             f"{args.dst} already holds {len(stale)} .tar shard(s); "
             f"remove them (or pick a fresh --dst) before re-packing"
         )
+    # label-parity manifest: TarImageFolder prefers this over the member
+    # union, so class ids survive even if a class has no packed samples
+    with open(os.path.join(args.dst, "classes.txt"), "w") as f:
+        f.write("\n".join(ds.classes) + "\n")
     n_shards = 0
     tf = None
     for i, (path, label) in enumerate(ds.samples):

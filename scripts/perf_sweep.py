@@ -3,7 +3,9 @@
     python scripts/perf_sweep.py [--quick]
 
 Measures the full SPMD train step with bench.py's methodology (3 warmup
-steps for compile+autotune, then device_get-synced timing) and prints a
+steps for compile+autotune, then timing gated by a device_get metric fetch
+every FETCH_EVERY steps — the production PRINT_FREQ cadence; steps chain
+through `state`, so the final fetch bounds all device work) and prints a
 markdown table for docs/BENCH_NOTES.md.
 """
 
@@ -14,16 +16,19 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 CASES = [
-    # (arch, per-chip batches, model kwargs, row label suffix)
-    ("resnet18", (256, 1024), {}, ""),
-    ("resnet50", (128, 512), {}, ""),
-    ("resnet50", (128, 512), {"stem_s2d": True}, " +s2d"),  # space-to-depth A/B
-    ("botnet50", (128, 256), {}, ""),
-    ("efficientnet_b0", (256, 512), {}, ""),
-    ("regnety_160", (64, 128), {}, ""),
+    # (arch, per-chip batches, model kwargs, f32 BN boundaries?, row label)
+    # Unlabeled rows are the shipped-best TPU recipe (bf16 BN boundaries,
+    # s2d stem on the resnet/botnet families); "-x" rows are A/B opt-outs.
+    ("resnet18", (256, 1024), {"stem_s2d": True}, False, ""),
+    ("resnet50", (128, 512), {"stem_s2d": True}, False, ""),
+    ("resnet50", (128, 512), {}, False, " -s2d"),
+    ("resnet50", (128, 512), {"stem_s2d": True}, True, " -bn16"),
+    ("botnet50", (128, 256), {"stem_s2d": True}, False, ""),
+    ("efficientnet_b0", (256, 512), {}, False, ""),
+    ("regnety_160", (64, 128), {}, False, ""),
 ]
 
-WARMUP, ITERS, QUICK_ITERS = 3, 10, 5
+WARMUP, ITERS, QUICK_ITERS, FETCH_EVERY = 3, 20, 10, 10
 
 
 def main():
@@ -46,7 +51,12 @@ def main():
     key = jax.random.PRNGKey(1)
     iters = QUICK_ITERS if quick else ITERS
 
-    for arch, batches, model_kw, label in CASES:
+    from distribuuuu_tpu.models.layers import set_bn_compute_dtype
+
+    for arch, batches, model_kw, bn_f32, label in CASES:
+        # read at trace time (inside make_train_step's first call), so set
+        # before any step of this case runs
+        set_bn_compute_dtype(jnp.float32 if bn_f32 else jnp.bfloat16)
         model = build_model(arch, num_classes=1000, **model_kw)
         # tx is state-free; building the step does not allocate device memory
         step = make_train_step(model, optim.construct_optimizer(), mesh, topk=5)
@@ -61,9 +71,11 @@ def main():
                     state, m = step(state, batch, lr, key)
                     jax.device_get(m)
                 t0 = time.perf_counter()
-                for _ in range(iters):
+                for it in range(iters):
                     state, m = step(state, batch, lr, key)
-                    jax.device_get(m)
+                    if (it + 1) % FETCH_EVERY == 0:
+                        jax.device_get(m)
+                jax.device_get(m)
                 dt = (time.perf_counter() - t0) / iters
                 print(f"| {arch}{label} | {B} | {dt * 1000:.1f} | {B / dt:.1f} |", flush=True)
             except Exception as e:  # OOM etc: report and continue the sweep

@@ -1,0 +1,137 @@
+"""Profile the SPMD train step and print a per-op device-time breakdown.
+
+TensorBoard isn't available on headless pods, so this parses the
+`jax.profiler` trace export (perfetto/chrome JSON inside
+`plugins/profile/<run>/*.trace.json.gz`) directly and aggregates complete
+('X') events on device tracks by op name — the profile-guided-fusion loop
+(VERDICT round-1 #1) without leaving the terminal.
+
+    python scripts/profile_step.py [--arch resnet50] [--batch 512] [--steps 5]
+
+The benched configuration matches bench.py's shipped-best arm (bf16 BN
+boundaries, s2d stem on resnet/botnet families); the same env opt-outs
+apply (DTPU_BENCH_BNF32=1, DTPU_BENCH_S2D=0).
+"""
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_and_trace(per_chip_batch: int, steps: int, logdir: str) -> str:
+    import jax
+    import jax.numpy as jnp
+
+    from distribuuuu_tpu.benchutil import bench_arms, make_synthetic_batch
+    from distribuuuu_tpu.models import build_model
+    from distribuuuu_tpu.models.layers import set_bn_compute_dtype
+    from distribuuuu_tpu.optim import construct_optimizer
+    from distribuuuu_tpu.runtime import data_mesh
+    from distribuuuu_tpu.trainer import create_train_state, make_train_step
+
+    mesh = data_mesh(-1)
+    arch, s2d, bn_f32 = bench_arms()
+    set_bn_compute_dtype(jnp.float32 if bn_f32 else jnp.bfloat16)
+    model = build_model(arch, num_classes=1000, **({"stem_s2d": True} if s2d else {}))
+    state, tx = create_train_state(model, jax.random.PRNGKey(0), mesh, 224)
+    step = make_train_step(model, tx, mesh, topk=5)
+    batch = make_synthetic_batch(mesh, per_chip_batch * jax.device_count())
+    lr = jnp.asarray(0.1, jnp.float32)
+    key = jax.random.PRNGKey(1)
+
+    for _ in range(3):  # compile + autotune outside the trace
+        state, m = step(state, batch, lr, key)
+        jax.device_get(m)
+
+    with jax.profiler.trace(logdir):
+        for _ in range(steps):
+            state, m = step(state, batch, lr, key)
+            jax.device_get(m)
+    return arch
+
+
+def load_trace_events(logdir: str):
+    paths = sorted(
+        glob.glob(os.path.join(logdir, "plugins", "profile", "*", "*.trace.json.gz"))
+    )
+    if not paths:
+        raise FileNotFoundError(f"no trace.json.gz under {logdir}")
+    with gzip.open(paths[-1], "rt") as f:
+        return json.load(f)["traceEvents"]
+
+
+def summarize(events, top: int):
+    # pid -> process (track) name from metadata events
+    track = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            track[e["pid"]] = e.get("args", {}).get("name", "")
+
+    def is_device(pid) -> bool:
+        name = track.get(pid, "").lower()
+        return ("tpu" in name or "device" in name or "xla ops" in name) and (
+            "host" not in name
+        )
+
+    by_op = defaultdict(float)
+    by_cat = defaultdict(float)
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or not is_device(e.get("pid")) or "dur" not in e:
+            continue
+        name = e["name"]
+        # skip the whole-module envelope and the step-number marker tracks —
+        # they overlap the individual op executions and would double-count
+        if name.startswith("jit_") or name.isdigit():
+            continue
+        by_op[name] += e["dur"]
+        # category = fusion kind without the ".N" instance suffix
+        by_cat[name.split(".", 1)[0]] += e["dur"]
+        total += e["dur"]
+    rows = sorted(by_op.items(), key=lambda kv: -kv[1])[:top]
+    cats = sorted(by_cat.items(), key=lambda kv: -kv[1])[:top]
+    return rows, cats, total, sorted(set(track.values()))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arch", default=None, help="override DTPU_BENCH_ARCH")
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--top", type=int, default=30)
+    ap.add_argument("--logdir", default=None, help="keep the raw trace here")
+    args = ap.parse_args()
+    if args.arch:
+        os.environ["DTPU_BENCH_ARCH"] = args.arch
+
+    logdir = args.logdir or tempfile.mkdtemp(prefix="dtpu_profile_")
+    arch = run_and_trace(args.batch, args.steps, logdir)
+    events = load_trace_events(logdir)
+    rows, cats, total, tracks = summarize(events, args.top)
+
+    print(f"tracks: {tracks}")
+    print(
+        f"\n{arch} batch {args.batch}/chip, {args.steps} traced steps — "
+        f"device op time {total / 1e3 / args.steps:.1f} ms/step\n"
+    )
+    print("| op category | ms/step | % |")
+    print("|---|---|---|")
+    for name, dur in cats:
+        print(f"| {name} | {dur / 1e3 / args.steps:.2f} | {100 * dur / total:.1f} |")
+    print("\n| hottest single ops | ms/step | % |")
+    print("|---|---|---|")
+    for name, dur in rows[: max(10, args.top // 3)]:
+        label = name if len(name) <= 70 else name[:67] + "..."
+        print(f"| {label} | {dur / 1e3 / args.steps:.2f} | {100 * dur / total:.1f} |")
+    print(f"\nraw trace: {logdir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -33,10 +33,13 @@ run_or_abort() {
     fi
 }
 
-run_or_abort "bench.py (baseline stem)" timeout 600 python bench.py
+run_or_abort "bench.py (shipped-best: bn16 + s2d)" timeout 600 python bench.py
 
-run_or_abort "bench.py (space-to-depth stem A/B)" \
-    env DTPU_BENCH_S2D=1 timeout 600 python bench.py
+run_or_abort "bench.py (A/B: f32 BN boundaries)" \
+    env DTPU_BENCH_BNF32=1 timeout 600 python bench.py
+
+run_or_abort "bench.py (A/B: plain 7x7 stem)" \
+    env DTPU_BENCH_S2D=0 timeout 600 python bench.py
 
 say "fused-attention soak"
 timeout 900 python scripts/soak_fused_attn.py >> "$LOG" 2>&1

@@ -27,9 +27,12 @@ import pytest  # noqa: E402
 
 @pytest.fixture()
 def fresh_cfg():
-    """Reset the global config singleton around a test."""
+    """Reset the global config singleton (and the BN-boundary-dtype global the
+    trainer derives from it) around a test."""
     from distribuuuu_tpu import config
+    from distribuuuu_tpu.models import layers
 
     config.reset_cfg()
     yield config.cfg
     config.reset_cfg()
+    layers.set_bn_compute_dtype(jax.numpy.float32)

@@ -91,3 +91,34 @@ def test_real_data_oracle_digits(tmp_path, fresh_cfg):
         f"oracle band broken: best val Acc@1 {best:.1f} < "
         f"{real_data_oracle.ORACLE_MIN_ACC1}"
     )
+
+
+@pytest.mark.slow
+def test_bn_bf16_learns(color_dataset, tmp_path, fresh_cfg):
+    """MODEL.BN_DTYPE=bfloat16 (bf16 activations at every BN boundary) must
+    train as well as float32 boundaries on the separable-colors task — the
+    end-to-end evidence behind defaulting bf16 boundaries on TPU (gradient
+    direction at random init is chaotic, so unit-level parity can't show
+    this; see test_models_resnet.py::test_bn_bf16_boundary_close_and_stats_f32)."""
+    c = fresh_cfg
+    c.MODEL.ARCH = "resnet18"
+    c.MODEL.NUM_CLASSES = 3
+    c.MODEL.DTYPE = "bfloat16"
+    c.MODEL.BN_DTYPE = "bfloat16"
+    c.MODEL.SYNCBN = True
+    c.TRAIN.DATASET = color_dataset
+    c.TEST.DATASET = color_dataset
+    c.TRAIN.BATCH_SIZE = 1
+    c.TRAIN.IM_SIZE = 32
+    c.TEST.IM_SIZE = 36
+    c.TEST.CROP_SIZE = 32
+    c.TEST.BATCH_SIZE = 1
+    c.OPTIM.MAX_EPOCH = 8
+    c.OPTIM.BASE_LR = 0.02
+    c.OPTIM.WARMUP_EPOCHS = 0
+    c.TRAIN.PRINT_FREQ = 5
+    c.RNG_SEED = 7
+    c.OUT_DIR = str(tmp_path / "out")
+
+    _, best = trainer.train_model()
+    assert best > 80.0, f"bf16 BN boundaries failed to learn: best Acc@1={best}"

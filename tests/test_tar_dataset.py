@@ -101,3 +101,66 @@ def test_loader_runs_on_tar_shards(folder_and_shards):
         assert batch["image"].shape[1:] == (40, 40, 3)
         seen += int(batch["weight"].sum())
     assert seen == len(tars)  # every member exactly once (weight-masked pad)
+
+
+def test_manifest_preserves_empty_class_ids(folder_and_shards, tmp_path):
+    """classes.txt keeps ImageFolder label parity even when a class has no
+    samples in the shards (e.g. partial sync): without the manifest, ids of
+    lexicographically-later classes would silently shift by one."""
+    src, dst = folder_and_shards
+    import shutil
+
+    src2 = tmp_path / "imgs2"
+    shutil.copytree(src, src2)
+    (src2 / "aardvark").mkdir()  # sorts first, contributes zero samples
+    dst2 = tmp_path / "shards2"
+    subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "scripts", "make_tar_shards.py"),
+            "--src", str(src2), "--dst", str(dst2), "--shard-size", "8",
+        ],
+        check=True,
+        capture_output=True,
+    )
+    folder = ImageFolder(str(src2))
+    tars = TarImageFolder(str(dst2))
+    assert folder.classes == ["aardvark", "ant", "bee", "cat"]
+    assert tars.classes == folder.classes  # from the manifest
+    by_name = {os.path.basename(p): l for p, l in folder.samples}
+    for name, label in tars.samples:
+        assert by_name[os.path.basename(name)] == label
+
+
+def test_hand_tarred_dot_slash_members(folder_and_shards, tmp_path):
+    """`tar cf shard.tar ./class_a ./class_b` names members './cls/f.jpg';
+    those must normalize to the same classes/labels, not collapse into a
+    single '.' class."""
+    src, _ = folder_and_shards
+    dst = tmp_path / "dotshards"
+    dst.mkdir()
+    with tarfile.open(dst / "shard-000.tar", "w") as tf:
+        for cls in sorted(os.listdir(src)):
+            for f in sorted(os.listdir(os.path.join(src, cls))):
+                tf.add(
+                    os.path.join(src, cls, f), arcname=f"./{cls}/{f}", recursive=False
+                )
+    tars = TarImageFolder(str(dst))
+    folder = ImageFolder(src)
+    assert tars.classes == folder.classes
+    by_name = {os.path.basename(p): l for p, l in folder.samples}
+    for name, label in tars.samples:
+        assert not name.startswith("./")
+        assert by_name[os.path.basename(name)] == label
+
+
+def test_manifest_missing_class_is_loud(folder_and_shards, tmp_path):
+    """A manifest that doesn't cover a shard's classes is a hard error, not a
+    silent relabeling."""
+    _, dst = folder_and_shards
+    import shutil
+
+    bad = tmp_path / "badshards"
+    shutil.copytree(dst, bad)
+    (bad / "classes.txt").write_text("ant\nbee\n")  # 'cat' missing
+    with pytest.raises(ValueError, match="missing classes"):
+        TarImageFolder(str(bad))
