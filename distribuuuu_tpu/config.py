@@ -67,6 +67,9 @@ _C.TRAIN.PRINT_FREQ = 30
 _C.TRAIN.TOPK = 5
 # TPU additions
 _C.TRAIN.PREFETCH = 2  # batches prefetched to device HBM ahead of compute
+# synthetic samples per DUMMY_INPUT epoch (reference DummyDataset length,
+# `utils.py:117`); raise for whole-loop throughput measurement runs
+_C.TRAIN.DUMMY_EPOCH_SAMPLES = 1000
 _C.TRAIN.LABEL_SMOOTH = 0.0
 # Gradient accumulation: each optimizer step averages grads over ACCUM_STEPS
 # micro-batches of BATCH_SIZE (effective global batch = BATCH_SIZE × devices

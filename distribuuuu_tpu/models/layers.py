@@ -34,17 +34,24 @@ linear_uniform = nn.initializers.variance_scaling(1.0 / 3.0, "fan_in", "uniform"
 # recipe (statistics are STILL computed in float32 — flax upcasts half dtypes
 # inside `_compute_stats` — and running stats/affine params stay float32;
 # only the normalized activations are emitted in bf16). bf16 boundaries are
-# +20% measured on resnet50/v5e (docs/BENCH_NOTES.md). Set once before
-# model construction — the trainer derives it from cfg.MODEL.BN_DTYPE
-# ("auto" tracks MODEL.DTYPE), bench.py sets the shipped-best arm. The bare
-# default stays float32 so direct build_model() calls are full-precision.
-# Reading happens at trace time, so flipping it requires re-jitting.
+# +20% measured on resnet50/v5e (docs/BENCH_NOTES.md). The trainer derives
+# it from cfg.MODEL.BN_DTYPE ("auto" tracks MODEL.DTYPE) for the duration of
+# train_model()/test_model() and restores the previous value on return, so
+# direct build_model() calls outside a run keep the float32 default.
+# Reading happens at *trace* time (batch_norm is called inside __call__), so
+# the value in effect when a step is jitted is the one that binds; flipping
+# it requires re-jitting. Process-global: concurrent runs in one process
+# share it.
 _BN_COMPUTE_DTYPE: Any = jnp.float32
 
 
 def set_bn_compute_dtype(dtype: Any) -> None:
     global _BN_COMPUTE_DTYPE
     _BN_COMPUTE_DTYPE = dtype
+
+
+def get_bn_compute_dtype() -> Any:
+    return _BN_COMPUTE_DTYPE
 
 
 def conv(

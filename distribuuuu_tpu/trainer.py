@@ -18,6 +18,7 @@ place in HBM, so peak memory is ~one copy of state + activations.
 
 from __future__ import annotations
 
+import functools
 import os
 import time
 from typing import Any
@@ -404,6 +405,25 @@ def validate(loader, mesh, eval_step, state, is_primary: bool, print_freq=None, 
 # Top-level entry points (reference `train_model`/`test_model`)
 # ---------------------------------------------------------------------------
 
+def _bn_dtype_scoped(fn):
+    """Restore the process-global BN boundary dtype on return: a run with
+    MODEL.BN_DTYPE=bfloat16 must not silently change what a later *direct*
+    build_model() call in the same process traces with."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        from distribuuuu_tpu.models import layers
+
+        prev = layers.get_bn_compute_dtype()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            layers.set_bn_compute_dtype(prev)
+
+    return wrapper
+
+
+@_bn_dtype_scoped
 def train_model():
     """Full training run (reference `trainer.py:106-173`).
 
@@ -476,6 +496,7 @@ def train_model():
     return state, best_acc1
 
 
+@_bn_dtype_scoped
 def test_model():
     """Evaluation run (reference `trainer.py:176-209`)."""
     configure_determinism(cfg.CUDNN.DETERMINISTIC)

@@ -185,3 +185,103 @@ def test_train_loader_rejects_dataset_smaller_than_batch(image_root):
     """A dataset below one global batch must fail loudly, not no-op epochs."""
     with pytest.raises(ValueError, match="zero batches"):
         _mk_loader(image_root, 0, 1, host_batch=64)  # 21 samples < 64
+
+
+def test_prefetch_to_device_threaded_and_memoized():
+    """prefetch_to_device ships a replayed host batch (DummyLoader) once and
+    reuses the device arrays; fresh host batches get fresh transfers; worker
+    exceptions propagate into the consuming loop."""
+    import numpy as np
+
+    from distribuuuu_tpu.data.loader import DummyLoader, prefetch_to_device
+    from distribuuuu_tpu.runtime import data_mesh
+
+    mesh = data_mesh(-1)
+
+    dummy = DummyLoader(host_batch=8, im_size=8, num_batches=4)
+    out = list(prefetch_to_device(iter(dummy), mesh))
+    assert len(out) == 4
+    # same host object replayed -> same device arrays (single H2D)
+    assert all(o["image"] is out[0]["image"] for o in out[1:])
+
+    def fresh():
+        for i in range(3):
+            yield {
+                "image": np.full((8, 8, 8, 3), i, np.uint8),
+                "label": np.zeros((8,), np.int32),
+                "weight": np.ones((8,), np.float32),
+            }
+
+    out = list(prefetch_to_device(fresh(), mesh))
+    assert len(out) == 3
+    assert out[0]["image"] is not out[1]["image"]
+    assert int(np.asarray(out[2]["image"])[0, 0, 0, 0]) == 2  # order preserved
+
+    def boom():
+        yield dummy._batch
+        raise RuntimeError("loader exploded")
+
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="loader exploded"):
+        list(prefetch_to_device(boom(), mesh))
+
+
+def test_prefetch_abandoned_consumer_unblocks_worker():
+    """Breaking out of the consuming loop (step failure / ctrl-C path) must
+    release the prefetch worker and close the upstream generator, not leave
+    either blocked on a full queue holding device batches."""
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from distribuuuu_tpu.data.loader import prefetch_to_device
+    from distribuuuu_tpu.runtime import data_mesh
+
+    mesh = data_mesh(-1)
+    closed = threading.Event()
+
+    def endless():
+        try:
+            i = 0
+            while True:
+                yield {
+                    "image": np.zeros((8, 8, 8, 3), np.uint8),
+                    "label": np.zeros((8,), np.int32),
+                    "weight": np.ones((8,), np.float32),
+                }
+                i += 1
+        finally:
+            closed.set()  # generator .close() reached us
+
+    gen = prefetch_to_device(endless(), mesh, prefetch=2)
+    next(gen)
+    gen.close()  # abandon mid-stream (what an aborted epoch does)
+    deadline = _time.time() + 5.0
+    while not closed.is_set() and _time.time() < deadline:
+        _time.sleep(0.05)
+    assert closed.is_set(), "upstream generator was never closed — worker leaked"
+
+
+def test_train_model_restores_bn_dtype_global(color_dataset_unused=None):
+    """train_model with bf16 BN boundaries must not leave the process-global
+    flipped for later direct build_model() users."""
+    import jax.numpy as jnp
+
+    from distribuuuu_tpu.models import layers
+
+    assert layers.get_bn_compute_dtype() == jnp.float32
+    # the scoped decorator restores even on failure paths
+    from distribuuuu_tpu import trainer
+
+    @trainer._bn_dtype_scoped
+    def boom():
+        layers.set_bn_compute_dtype(jnp.bfloat16)
+        raise RuntimeError("run died")
+
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError):
+        boom()
+    assert layers.get_bn_compute_dtype() == jnp.float32
