@@ -50,11 +50,12 @@ def _watchdog():
     # would never fire while the main thread is blocked inside a native
     # device call, which is exactly the wedge scenario this guards against.
     arch = os.environ.get("DTPU_BENCH_ARCH", "resnet50")
+    kind = "eval" if os.environ.get("DTPU_BENCH_EVAL", "0") == "1" else "train"
     s2d = _variant_tags()
     print(
         json.dumps(
             {
-                "metric": f"{arch}{s2d} train images/sec/chip (BENCH TIMED OUT: device unreachable/wedged)",
+                "metric": f"{arch}{s2d} {kind} images/sec/chip (BENCH TIMED OUT: device unreachable/wedged)",
                 "value": 0.0,
                 "unit": "images/sec/chip",
                 "vs_baseline": 0.0,
@@ -75,13 +76,21 @@ def main():
     from distribuuuu_tpu.benchutil import make_synthetic_batch
     from distribuuuu_tpu.models import build_model
     from distribuuuu_tpu.runtime import data_mesh
-    from distribuuuu_tpu.trainer import create_train_state, make_train_step
+    from distribuuuu_tpu.trainer import (
+        create_train_state,
+        make_eval_step,
+        make_train_step,
+        zero_metrics,
+    )
 
     n_chips = jax.device_count()
     # 512/chip saturates the v5e MXU pipeline (measured 1044 img/s @128 →
     # 1530 @512); the reference's own large-batch regime goes to 8192 global.
     # Env-overridable for smaller-HBM parts and for CPU-mesh smoke runs.
     per_chip_batch = int(os.environ.get("DTPU_BENCH_BATCH", "512"))
+    # 224 is the measured configuration; smaller values are for CPU-mesh
+    # smoke runs of the bench harness itself (scripts/cpu_mesh_run.py)
+    im_size = int(os.environ.get("DTPU_BENCH_IM_SIZE", "224"))
     global_batch = per_chip_batch * n_chips
 
     mesh = data_mesh(-1)
@@ -96,52 +105,104 @@ def main():
     set_bn_compute_dtype(jnp.float32 if bn_f32 else jnp.bfloat16)
     kw = {"stem_s2d": True} if stem_s2d else {}
     model = build_model(arch, num_classes=1000, **kw)  # bf16 trunk by default
-    state, tx = create_train_state(model, jax.random.PRNGKey(0), mesh, 224)
+    state, tx = create_train_state(model, jax.random.PRNGKey(0), mesh, im_size)
     train_step = make_train_step(model, tx, mesh, topk=5)
 
-    batch = make_synthetic_batch(mesh, global_batch)
+    batch = make_synthetic_batch(mesh, global_batch, im_size=im_size)
     lr = jnp.asarray(0.1, jnp.float32)
     key = jax.random.PRNGKey(1)
+
+    if os.environ.get("DTPU_BENCH_EVAL", "0") == "1":
+        _eval_bench(
+            jax, make_eval_step, zero_metrics, model, mesh, state, batch,
+            arch, im_size, global_batch, n_chips, timer,
+        )
+        return
 
     # warmup (compile + autotune)
     for _ in range(3):
         state, m = train_step(state, batch, lr, key)
         jax.device_get(m)
 
-    # Timing is gated by real device->host metric fetches (jax.device_get):
-    # on the experimental axon transport plain block_until_ready is a no-op,
-    # which silently inflated throughput ~100x. The fetch cadence is every
-    # FETCH_EVERY steps — the production trainer's PRINT_FREQ behavior (its
-    # metrics accumulate on device, default PRINT_FREQ=30). This is NOT
-    # inflation: successive steps chain through `state`, so the fetch at step
-    # N gates on every prior step's device work, and the timer stops only
-    # after the final fetch returns. Per-step fetching (the round-1 method)
-    # serializes the tunnel's ~5 ms dispatch overhead into every step and
-    # under-reports by ~25% vs what a real training loop achieves
-    # (docs/BENCH_NOTES.md round-2 pipelining section).
-    FETCH_EVERY = 10
-    iters = 20
+    def one_step(carry):
+        state, m = train_step(carry[0], batch, lr, key)
+        return (state, m), m
+
+    dt = _timed_cadence_loop(jax, one_step, (state, None), iters=20)
+    timer.cancel()
+    _print_metric(
+        "train", arch, im_size, global_batch, n_chips, dt, 20,
+        baseline=A100_FP32_IMGS_PER_SEC_PER_GPU,
+    )
+
+
+def _timed_cadence_loop(jax, one_step, carry, iters, fetch_every=10):
+    """The measurement method, shared by the train and eval arms.
+
+    Timing is gated by real device->host fetches (jax.device_get): on the
+    experimental axon transport plain block_until_ready is a no-op, which
+    silently inflated throughput ~100x. The fetch cadence is every
+    ``fetch_every`` steps — the production trainer's PRINT_FREQ behavior
+    (metrics accumulate on device, default PRINT_FREQ=30). This is NOT
+    inflation: each ``one_step(carry)`` chains through its carry (train:
+    `state`; eval: the running metric totals), so the fetch at step N gates
+    on every prior step's device work, and the timer stops only after the
+    final fetch returns. Per-step fetching (the round-1 method) serializes
+    the tunnel's ~5 ms dispatch overhead into every step and under-reports
+    by ~25% vs what a real training loop achieves (docs/BENCH_NOTES.md
+    round-2 pipelining section). Returns elapsed seconds.
+    """
+    fetchable = None
     t0 = time.perf_counter()
     for i in range(iters):
-        state, m = train_step(state, batch, lr, key)
-        if (i + 1) % FETCH_EVERY == 0:
-            jax.device_get(m)
-    jax.device_get(m)
-    dt = time.perf_counter() - t0
+        carry, fetchable = one_step(carry)
+        if (i + 1) % fetch_every == 0:
+            jax.device_get(fetchable)
+    jax.device_get(fetchable)
+    return time.perf_counter() - t0
 
-    timer.cancel()
-    imgs_per_sec = global_batch * iters / dt
-    per_chip = imgs_per_sec / n_chips
+
+def _print_metric(kind, arch, im_size, global_batch, n_chips, dt, iters, baseline):
+    per_chip = global_batch * iters / dt / n_chips
     print(
         json.dumps(
             {
-                "metric": "%s%s train images/sec/chip (224px, bf16, global batch %d, %d chip%s)"
-                % (arch, _variant_tags(), global_batch, n_chips, "s" if n_chips > 1 else ""),
+                "metric": "%s%s %s images/sec/chip (%dpx, bf16, global batch %d, %d chip%s)"
+                % (
+                    arch, _variant_tags(), kind, im_size, global_batch, n_chips,
+                    "s" if n_chips > 1 else "",
+                ),
                 "value": round(per_chip, 1),
                 "unit": "images/sec/chip",
-                "vs_baseline": round(per_chip / A100_FP32_IMGS_PER_SEC_PER_GPU, 3),
+                "vs_baseline": round(per_chip / baseline, 3),
             }
         )
+    )
+
+
+def _eval_bench(
+    jax, make_eval_step, zero_metrics, model, mesh, state, batch,
+    arch, im_size, global_batch, n_chips, timer,
+):
+    """DTPU_BENCH_EVAL=1: forward-only throughput. The eval step takes and
+    returns running metric totals — the cadence loop's chained carry."""
+    eval_step = make_eval_step(model, mesh, topk=5)
+    totals = zero_metrics(5, mesh)
+    for _ in range(3):  # warmup
+        totals = eval_step(state, batch, totals)
+        jax.device_get(totals)
+
+    def one_step(totals):
+        totals = eval_step(state, batch, totals)
+        return totals, totals
+
+    dt = _timed_cadence_loop(jax, one_step, totals, iters=40)
+    timer.cancel()
+    # forward ≈ 1/3 of train FLOPs: the A100 fp32 comparison point scales to
+    # ~3x its 400 img/s train rate
+    _print_metric(
+        "eval", arch, im_size, global_batch, n_chips, dt, 40,
+        baseline=3 * A100_FP32_IMGS_PER_SEC_PER_GPU,
     )
 
 
