@@ -41,6 +41,16 @@ run_or_abort "bench.py (A/B: f32 BN boundaries)" \
 run_or_abort "bench.py (A/B: plain 7x7 stem)" \
     env DTPU_BENCH_S2D=0 timeout 600 python bench.py
 
+run_or_abort "bench.py (eval mode)" \
+    env DTPU_BENCH_EVAL=1 timeout 600 python bench.py
+
+rm -rf /tmp/dtpu_session_loop
+run_or_abort "whole-loop: train_net.py DUMMY_INPUT 200-step epochs" \
+    timeout 900 python train_net.py --cfg config/resnet50.yaml \
+    MODEL.DUMMY_INPUT True TRAIN.BATCH_SIZE 512 \
+    TRAIN.DUMMY_EPOCH_SAMPLES 102400 TRAIN.PRINT_FREQ 30 \
+    OPTIM.MAX_EPOCH 2 OPTIM.WARMUP_EPOCHS 0 OUT_DIR /tmp/dtpu_session_loop
+
 say "fused-attention soak"
 timeout 900 python scripts/soak_fused_attn.py >> "$LOG" 2>&1
 soak_rc=$?

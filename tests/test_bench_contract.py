@@ -1,0 +1,57 @@
+"""bench.py driver contract: exactly one parseable JSON line, required keys.
+
+The driver records bench.py's stdout verbatim (BENCH_r{N}.json); a formatting
+regression or harness crash would cost the round its perf evidence, so the
+contract is pinned by a real subprocess run of both modes on the fake CPU
+mesh (tiny shapes via the DTPU_BENCH_* envs).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(extra_env):
+    env = dict(
+        os.environ,
+        DTPU_BENCH_BATCH="4",
+        DTPU_BENCH_IM_SIZE="32",
+        **extra_env,
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "cpu_mesh_run.py"),
+         os.path.join(REPO, "bench.py")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=540,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line, got: {lines}"
+    return json.loads(lines[0])
+
+
+@pytest.mark.slow
+def test_bench_train_json_contract():
+    rec = _run_bench({})
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert rec["unit"] == "images/sec/chip"
+    assert "train images/sec/chip" in rec["metric"]
+    assert "resnet50" in rec["metric"]
+    assert rec["value"] > 0
+    assert rec["vs_baseline"] > 0
+
+
+@pytest.mark.slow
+def test_bench_eval_json_contract():
+    rec = _run_bench({"DTPU_BENCH_EVAL": "1"})
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert "eval images/sec/chip" in rec["metric"]
+    assert rec["value"] > 0
