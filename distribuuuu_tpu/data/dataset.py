@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import os
 import tarfile
+from collections import Counter
 from dataclasses import dataclass
 
 import numpy as np
@@ -111,6 +112,14 @@ class TarImageFolder:
         if os.path.isfile(manifest):
             with open(manifest) as f:
                 self.classes = [ln.strip() for ln in f if ln.strip()]
+            dupes = [c for c, n in Counter(self.classes).items() if n > 1]
+            if dupes:
+                # a duplicate line would shift every later class id — exactly
+                # the ImageFolder label-parity bug the manifest exists to stop
+                raise ValueError(
+                    f"{manifest} has duplicate class lines: {sorted(dupes)[:5]}"
+                    f"{'...' if len(dupes) > 5 else ''}"
+                )
             missing = classes - set(self.classes)
             if missing:
                 raise ValueError(

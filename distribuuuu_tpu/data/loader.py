@@ -50,8 +50,8 @@ class _ProducerError:
 
 def _qput(out_q: queue.Queue, item, stop: threading.Event) -> bool:
     """Bounded put that gives up when the consumer is gone (never blocks
-    forever on a full queue after an aborted epoch). Shared by the decode
-    producer and the H2D prefetch worker."""
+    forever on a full queue after an aborted epoch). Used by the decode
+    producer; the H2D prefetch worker throttles via its ticket semaphore."""
     while not stop.is_set():
         try:
             out_q.put(item, timeout=0.2)
@@ -117,8 +117,10 @@ class HostDataLoader:
     def _shard_indices(self) -> np.ndarray:
         """DistributedSampler semantics: seeded global perm → round-robin shard,
         wrap-padded to equal length. Padding positions are flagged with -1 for
-        eval (masked), but wrap samples are used for train (harmless: dropped
-        by drop_last arithmetic in practice)."""
+        eval (masked). Train wrap samples are real duplicates and CAN train
+        when ``shard_size % host_batch`` leaves them before the drop_last
+        tail — identical to torch's DistributedSampler, which also trains on
+        its wrap padding (`utils.py:141-152` parity, not a divergence)."""
         total = len(self.dataset)
         if self.train:
             g = np.random.default_rng(self.seed + self.epoch)
@@ -371,11 +373,25 @@ def prefetch_to_device(iterator, mesh, prefetch: int = 2):
         }
 
     done = object()
-    q: queue.Queue = queue.Queue(maxsize=max(1, prefetch))
-    # _qput(stop): an abandoned epoch (step failure, KeyboardInterrupt) must
-    # not leave the worker blocked forever holding device batches, nor leave
-    # the upstream HostDataLoader generator (its own producer thread) unclosed
+    # The in-flight bound: the worker takes a ticket BEFORE starting each
+    # transfer and the consumer returns it when it picks the batch up, so
+    # (queued + mid-transfer) <= prefetch and peak global batches alive is
+    # ``prefetch`` + the one the consumer holds — the same PREFETCH+1 bound
+    # the old synchronous implementation gave (works for prefetch=1 too,
+    # which a bounded-queue size could not express). The queue itself is
+    # unbounded; the semaphore is the only throttle.
+    q: queue.Queue = queue.Queue()
+    tickets = threading.BoundedSemaphore(max(1, prefetch))
+    # stop: an abandoned epoch (step failure, KeyboardInterrupt) must not
+    # leave the worker blocked forever holding device batches, nor leave the
+    # upstream HostDataLoader generator (its own producer thread) unclosed
     stop = threading.Event()
+
+    def _take_ticket() -> bool:
+        while not stop.is_set():
+            if tickets.acquire(timeout=0.2):
+                return True
+        return False
 
     def worker():
         it = None
@@ -384,6 +400,8 @@ def prefetch_to_device(iterator, mesh, prefetch: int = 2):
         try:
             it = iter(iterator)
             for batch in it:
+                if not _take_ticket():
+                    break
                 if batch is last_host:
                     dev = last_dev  # marked replay batch: ship once
                 else:
@@ -393,12 +411,11 @@ def prefetch_to_device(iterator, mesh, prefetch: int = 2):
                         # every real batch would pin ~one extra host+device
                         # batch for the whole epoch with no reuse possible
                         last_host, last_dev = batch, dev
-                if not _qput(q, dev, stop):
-                    break
+                q.put(dev)
             else:
-                _qput(q, done, stop)
+                q.put(done)
         except BaseException as e:  # propagate into the training loop
-            _qput(q, e, stop)
+            q.put(e)
         finally:
             # close the upstream generator even on abandonment, so e.g.
             # HostDataLoader's generator-finally runs and stops its producer
@@ -415,6 +432,7 @@ def prefetch_to_device(iterator, mesh, prefetch: int = 2):
                 break
             if isinstance(item, BaseException):
                 raise item
+            tickets.release()  # hand the worker the slot this batch occupied
             yield item
     finally:
         stop.set()

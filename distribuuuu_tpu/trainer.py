@@ -240,6 +240,17 @@ def create_train_state(model, key, mesh: Mesh, im_size: int):
 def _build_cfg_model():
     from distribuuuu_tpu.models.layers import set_bn_compute_dtype
 
+    if cfg.MODEL.DTYPE not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"MODEL.DTYPE must be 'float32' or 'bfloat16', got {cfg.MODEL.DTYPE!r}"
+        )
+    if cfg.MODEL.BN_DTYPE not in ("auto", "float32", "bfloat16"):
+        # a typo ('bf16', 'float16') must not silently select float32
+        # boundaries — that would measure/train the wrong A/B arm
+        raise ValueError(
+            f"MODEL.BN_DTYPE must be 'auto', 'float32' or 'bfloat16', "
+            f"got {cfg.MODEL.BN_DTYPE!r}"
+        )
     bn_dtype = cfg.MODEL.BN_DTYPE
     if bn_dtype == "auto":
         bn_dtype = cfg.MODEL.DTYPE
@@ -334,7 +345,12 @@ def train_epoch(
             # some transports); fetch BEFORE timestamping the window
             vals = jax.device_get(window)
             now = time.time()
-            batch_time.update((now - t_window) / len(window), n=len(window))
+            if it == 0:
+                # first window = compile + autotune: show it as .val but keep
+                # it out of the running Time average (honest steady-state avg)
+                batch_time.val = (now - t_window) / len(window)
+            else:
+                batch_time.update((now - t_window) / len(window), n=len(window))
             t_window = now
             n = sum(v["n"] for v in vals)
             losses.update(float(sum(v["loss_sum"] for v in vals) / n), n=int(n))
@@ -379,7 +395,11 @@ def validate(loader, mesh, eval_step, state, is_primary: bool, print_freq=None, 
             # charge the whole window's wall time across its steps so the
             # Time average is true step time, not just print-boundary steps
             now = time.time()
-            batch_time.update((now - t_window) / window_n, n=window_n)
+            if it == 0:
+                # compile window: display-only, excluded from the average
+                batch_time.val = (now - t_window) / window_n
+            else:
+                batch_time.update((now - t_window) / window_n, n=window_n)
             t_window = now
             window_n = 0
             n = max(vals["n"], 1.0)

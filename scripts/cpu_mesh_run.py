@@ -23,6 +23,9 @@ def main():
         os.environ["XLA_FLAGS"] = (
             flags + f" --xla_force_host_platform_device_count={n}"
         ).strip()
+    # any device-health probe subprocess the wrapped script spawns (bench.py)
+    # must probe CPU too — a bare child would touch the box's real chip
+    os.environ.setdefault("DTPU_BENCH_PROBE_PLATFORM", "cpu")
     import jax
 
     jax.config.update("jax_platforms", "cpu")

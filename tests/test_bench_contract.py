@@ -21,6 +21,9 @@ def _run_bench(extra_env):
         os.environ,
         DTPU_BENCH_BATCH="4",
         DTPU_BENCH_IM_SIZE="32",
+        # probe paths have their own dedicated tests below; a redundant probe
+        # here would double each contract test's wall time (cold jax import)
+        DTPU_BENCH_SKIP_PROBE="1",
         **extra_env,
     )
     proc = subprocess.run(
@@ -54,4 +57,49 @@ def test_bench_eval_json_contract():
     rec = _run_bench({"DTPU_BENCH_EVAL": "1"})
     assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
     assert "eval images/sec/chip" in rec["metric"]
+    # the eval comparison point is an estimate, and the metric must say so
+    assert "est" in rec["metric"]
     assert rec["value"] > 0
+
+
+def test_bench_probe_healthy_device(monkeypatch):
+    """_probe_once against a healthy (CPU) platform returns True — the
+    success leg of the pre-run probe, without a full bench run."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)  # jax-free at import time by design
+    monkeypatch.setenv("DTPU_BENCH_PROBE_PLATFORM", "cpu")
+    assert bench._probe_once(timeout=120) is True
+
+
+def test_bench_probe_abort_contract():
+    """A wedged/unreachable device must yield a fast rc=2 abort with the same
+    one-JSON-line contract (not a 540s watchdog burn). Simulated by pointing
+    the probe subprocess at a nonexistent jax platform; the parent process
+    never initializes jax, so this never touches a real device."""
+    env = dict(
+        os.environ,
+        DTPU_BENCH_PROBE_PLATFORM="no_such_platform",
+        DTPU_BENCH_PROBE_TIMEOUT="120",
+        DTPU_BENCH_PROBE_BACKOFF="0",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=REPO,
+    )
+    assert proc.returncode == 2, (proc.stdout, proc.stderr[-2000:])
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line, got: {lines}"
+    rec = json.loads(lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert "BENCH ABORTED" in rec["metric"]
+    assert rec["value"] == 0.0
+    assert rec["vs_baseline"] == 0.0
