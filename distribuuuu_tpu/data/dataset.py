@@ -157,10 +157,12 @@ class TarImageFolder:
     def __len__(self) -> int:
         return len(self.samples)
 
-    def __del__(self):
+    def __del__(self, _close=os.close):
+        # default-arg capture: at interpreter shutdown the os module may
+        # already be torn down (os.close = None) when the GC runs this
         for fd in getattr(self, "_fds", []):
             try:
-                os.close(fd)
+                _close(fd)
             except OSError:
                 pass
 

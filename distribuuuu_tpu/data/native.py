@@ -6,13 +6,21 @@ answer to SURVEY §7's input-throughput hard part (the reference leans on
 torch's C++ DataLoader machinery for the same reason). Falls back to the
 PIL/numpy transforms transparently when the library isn't built.
 
-Build once per machine: ``scripts/build_native.sh``.
+Built AUTOMATICALLY on first use (one ~5s g++ invocation per machine, atomic
+rename so concurrent first-users can't see a half-written .so). A fresh
+clone therefore runs the fast decode path without a manual setup step — and
+the native tests run instead of skipping. ``DTPU_NATIVE_AUTOBUILD=0``
+disables; a failed build (no g++/libjpeg on the box) warns once and falls
+back to PIL. ``scripts/build_native.sh`` remains the manual equivalent.
 """
 
 from __future__ import annotations
 
 import ctypes
 import os
+import subprocess
+import threading
+import warnings
 
 import numpy as np
 
@@ -24,20 +32,81 @@ _LIB_PATH = os.path.join(
 )
 
 _lib = None
-_lib_unusable = False  # stale/missing-symbol library: warn once, use PIL
+_lib_unusable = False  # unusable and rebuild failed: warn once, use PIL
+_build_attempted = False
+
+
+_build_lock = threading.Lock()
+
+
+def build(timeout: float = 180.0) -> bool:
+    """Compile the library from ``native/dtpu_decode.cc``. The ONE compile
+    command — scripts/build_native.sh is a thin wrapper over this, so the
+    manual and automatic builds can't drift apart. Compiles to a
+    pid+thread-suffixed temp and atomically renames, so concurrent builders
+    (processes or threads) each install a whole .so. Returns success."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    src = os.path.join(root, "native", "dtpu_decode.cc")
+    if not os.path.isfile(src):  # installed without sources: nothing to build
+        return False
+    tmp = f"{_LIB_PATH}.tmp{os.getpid()}_{threading.get_ident()}"
+    try:
+        os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-fPIC", "-shared", "-o", tmp, src, "-ljpeg"],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            check=True,
+        )
+        os.replace(tmp, _LIB_PATH)
+        return True
+    except Exception as exc:
+        detail = getattr(exc, "stderr", "") or str(exc)
+        warnings.warn(
+            f"build of the native decode library failed ({detail[-300:]}); "
+            f"using the PIL fallback. Build manually with scripts/build_native.sh "
+            f"or set DTPU_NATIVE_AUTOBUILD=0 to silence."
+        )
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        return False
+
+
+def _autobuild() -> bool:
+    """One in-process attempt to compile the library on first use. Returns
+    True if ``_LIB_PATH`` exists afterwards (this build or anyone else's)."""
+    global _build_attempted
+    with _build_lock:
+        if _build_attempted:
+            return os.path.exists(_LIB_PATH)
+        _build_attempted = True
+        if os.environ.get("DTPU_NATIVE_AUTOBUILD", "1") != "1":
+            return False
+        return build()
 
 
 def _load():
     global _lib, _lib_unusable
-    if _lib is None and not _lib_unusable and os.path.exists(_LIB_PATH):
+    if _lib is None and not _lib_unusable:
+        if not os.path.exists(_LIB_PATH) and not _autobuild():
+            # NOT latched: a library built later (scripts/build_native.sh
+            # while this process lives, or by a sibling process) is picked
+            # up on the next call — the pre-autobuild contract. _autobuild
+            # itself only ever compiles once per process.
+            return None
         try:
             _lib = _bind(ctypes.CDLL(_LIB_PATH))
         except (OSError, AttributeError) as exc:
-            # e.g. a library built before the u8 API existed — transparent
-            # fallback to the PIL path, as the module contract promises
+            # e.g. a library built before the u8 API existed: rebuild once,
+            # then fall back to PIL as the module contract promises
+            if _autobuild():
+                try:
+                    _lib = _bind(ctypes.CDLL(_LIB_PATH))
+                    return _lib
+                except (OSError, AttributeError):
+                    pass
             _lib_unusable = True
-            import warnings
-
             warnings.warn(
                 f"native decode library at {_LIB_PATH} is unusable ({exc}); "
                 f"falling back to PIL. Rebuild with scripts/build_native.sh"

@@ -1,8 +1,12 @@
 #!/bin/bash
-# Build the native decode library (libjpeg-based, no Python deps).
+# Build the native decode library — thin wrapper over the ONE compile
+# command in distribuuuu_tpu.data.native.build(), so the manual build can
+# never drift from what first-use autobuild produces.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-mkdir -p native/build
-g++ -O3 -march=native -fPIC -shared -o native/build/libdtpu_decode.so \
-    native/dtpu_decode.cc -ljpeg
+python -c "
+import sys
+from distribuuuu_tpu.data import native
+sys.exit(0 if native.build() else 1)
+"
 echo "built native/build/libdtpu_decode.so"

@@ -51,6 +51,26 @@ run_or_abort "whole-loop: train_net.py DUMMY_INPUT 200-step epochs" \
     TRAIN.DUMMY_EPOCH_SAMPLES 102400 TRAIN.PRINT_FREQ 30 \
     OPTIM.MAX_EPOCH 2 OPTIM.WARMUP_EPOCHS 0 OUT_DIR /tmp/dtpu_session_loop
 
+# Real-data rung (VERDICT r2 #2): decode→assemble→H2D→step through the
+# production CLI. Dataset generation is CPU-heavy, so it runs while the
+# device is idle (contention rule, docs/TROUBLESHOOTING.md runbook #4);
+# generation is idempotent — reruns skip it.
+say "synth tar-shard dataset (host-side, device idle)"
+if ! timeout 900 python scripts/make_synth_shards.py --dst /tmp/dtpu_synth_shards >> "$LOG" 2>&1; then
+    say "dataset generation FAILED — skipping real-data rung"
+else
+    rm -rf /tmp/dtpu_session_real
+    run_or_abort "whole-loop: train_net.py REAL tar-shard data (native decode)" \
+        timeout 1500 python train_net.py --cfg config/resnet50.yaml \
+        MODEL.NUM_CLASSES 8 TRAIN.DATASET /tmp/dtpu_synth_shards \
+        TEST.DATASET /tmp/dtpu_synth_shards \
+        TRAIN.BATCH_SIZE 256 TRAIN.PRINT_FREQ 5 \
+        OPTIM.MAX_EPOCH 1 OPTIM.WARMUP_EPOCHS 0 OUT_DIR /tmp/dtpu_session_real
+fi
+
+run_or_abort "per-stage conv roofline (VERDICT r2 #3)" \
+    timeout 1600 python scripts/stage_roofline.py
+
 say "fused-attention soak"
 timeout 900 python scripts/soak_fused_attn.py >> "$LOG" 2>&1
 soak_rc=$?
@@ -73,6 +93,17 @@ if [ $soak_rc -eq 0 ]; then
     run_or_abort "botnet50 fused-attention bench" \
         env DTPU_FUSED_ATTN=1 DTPU_BENCH_ARCH=botnet50 DTPU_BENCH_BATCH=256 \
         timeout 600 python bench.py
+fi
+
+# End-of-session protocol (docs/TROUBLESHOOTING.md runbook #5): leave a
+# health verdict in the log so a wedge is detected at cause time, not by
+# the next session's (or the driver's) burned timeout.
+say "post-ladder probe"
+if timeout 240 python -c "import jax; print(jax.devices())" >> "$LOG" 2>&1; then
+    say "device healthy at session end"
+else
+    say "DEVICE WEDGED AT SESSION END — record the last rung above in TROUBLESHOOTING.md"
+    exit 1
 fi
 
 say "done — full log at $LOG"

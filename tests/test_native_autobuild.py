@@ -1,0 +1,49 @@
+"""First-use autobuild of the native decode library (VERDICT r2 #2).
+
+Lives in its OWN module: test_native_decode.py is skipif-gated on
+``native.available()``, and a broken autobuild makes that False on a fresh
+clone — gating this test there would skip it exactly when it should fail.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+
+def test_autobuild_fresh_tree(tmp_path):
+    """A fresh clone (no native/build/) must build the library on first use
+    — the silent-PIL-fallback failure mode VERDICT r2 flagged. Runs in a
+    subprocess so this process's cached handle is untouched."""
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ on this box")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    build = os.path.join(repo, "native", "build")
+    moved = str(tmp_path / "build.bak")
+    had_build = os.path.isdir(build)  # gitignored: absent on a fresh clone
+    if had_build:
+        shutil.move(build, moved)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "from distribuuuu_tpu.data import native; print(native.available())"],
+            capture_output=True, text=True, timeout=240, cwd=repo,
+            # pin the behavior under test: an inherited opt-out would make
+            # this fail with no hint the environment caused it
+            env={**os.environ, "DTPU_NATIVE_AUTOBUILD": "1"},
+        )
+        assert proc.returncode == 0, proc.stderr[-1000:]
+        assert proc.stdout.strip() == "True", (proc.stdout, proc.stderr[-500:])
+        assert os.path.exists(os.path.join(build, "libdtpu_decode.so"))
+    finally:
+        if had_build and not os.path.exists(
+            os.path.join(build, "libdtpu_decode.so")
+        ):
+            # a failed autobuild leaves an empty build/ dir; clear it or
+            # shutil.move would NEST the backup inside it instead of
+            # restoring the prebuilt library to _LIB_PATH
+            if os.path.isdir(build):
+                shutil.rmtree(build)
+            shutil.move(moved, build)

@@ -120,3 +120,5 @@ def test_device_normalize_matches_host():
     # float input passes through untouched
     f = rng.standard_normal((2, 4, 4, 3)).astype(np.float32)
     np.testing.assert_array_equal(np.asarray(device_normalize(jnp.asarray(f))), f)
+
+
