@@ -71,6 +71,11 @@ fi
 run_or_abort "per-stage conv roofline (VERDICT r2 #3)" \
     timeout 1600 python scripts/stage_roofline.py
 
+# each arm is probe-guarded by bench.py itself; a wedged chip costs ~260s
+# per arm, and the rung's timeout bounds the whole sweep
+run_or_abort "XLA flag sweep (VERDICT r2 #3)" \
+    timeout 3000 python scripts/xla_flag_sweep.py
+
 say "fused-attention soak"
 timeout 900 python scripts/soak_fused_attn.py >> "$LOG" 2>&1
 soak_rc=$?
