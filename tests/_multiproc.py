@@ -1,0 +1,54 @@
+"""Shared scaffolding for true multi-process tests — NOT a pytest module.
+
+Used by tests/test_multihost.py and tests/test_multihost_ring.py: launch N
+rank subprocesses with per-rank logs, wait them out, kill stragglers, and
+hand back (rc, log_text) per rank — rc is None when the wait timed out, and
+the log text is always available so a hung rank's output makes it into the
+assertion message instead of being lost.
+"""
+
+import socket
+import subprocess
+
+
+def pick_port() -> int:
+    """Ephemeral rendezvous port. Best-effort: the port is released before
+    the workers bind it, so a parallel process could steal it in between —
+    in that case the workers fail loudly at rendezvous and the test reruns."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_ranks(tmp_path, n, make_cmd, make_env, cwd, timeout):
+    """Run ``make_cmd(rank)`` for each rank; returns [(rc, log_text)]."""
+    procs = []
+    try:
+        for rank in range(n):
+            log = open(tmp_path / f"rank{rank}.log", "w")
+            procs.append(
+                (
+                    subprocess.Popen(
+                        make_cmd(rank),
+                        env=make_env(rank),
+                        stdout=log,
+                        stderr=subprocess.STDOUT,
+                        cwd=cwd,
+                    ),
+                    log,
+                )
+            )
+        rcs = []
+        for p, _ in procs:
+            try:
+                rcs.append(p.wait(timeout=timeout))
+            except subprocess.TimeoutExpired:
+                rcs.append(None)  # killed in finally; log still reported
+    finally:
+        for p, log in procs:
+            p.poll() is None and p.kill()
+            log.close()
+    return [
+        (rc, open(tmp_path / f"rank{rank}.log").read())
+        for rank, rc in enumerate(rcs)
+    ]

@@ -1,0 +1,47 @@
+"""Synthetic tar-shard generator: completion-marker + dataset contract.
+
+The generator feeds the ladder's real-data rung unattended; its idempotency
+must not accept a truncated dataset (a run killed mid-write would otherwise
+poison every later measurement session).
+"""
+
+import os
+import subprocess
+import sys
+
+from distribuuuu_tpu.data.dataset import TarImageFolder
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "make_synth_shards.py")
+
+
+def run(dst, *extra):
+    return subprocess.run(
+        [sys.executable, SCRIPT, "--dst", str(dst), *extra],
+        capture_output=True, text=True, timeout=300, check=True,
+    ).stdout
+
+
+def test_generate_marker_and_contract(tmp_path):
+    dst = tmp_path / "shards"
+    args = ("--train-images", "24", "--val-images", "8",
+            "--classes", "4", "--shard-size", "16")
+    out = run(dst, *args)
+    assert "wrote 24+8" in out
+    assert os.path.isfile(dst / ".complete")
+
+    for split, n in [("train", 24), ("val", 8)]:
+        ds = TarImageFolder(str(dst / split))
+        assert len(ds) == n
+        assert ds.classes == [f"class_{c:03d}" for c in range(4)]
+        data, name = ds.read_bytes(0)
+        assert data[:2] == b"\xff\xd8", name  # JPEG SOI
+
+    # complete -> rerun is a no-op
+    assert "nothing to do" in run(dst)
+
+    # marker gone (killed mid-write) -> regenerated from scratch, not trusted
+    os.remove(dst / ".complete")
+    out = run(dst, *args)
+    assert "regenerating" in out and "wrote 24+8" in out
+    assert os.path.isfile(dst / ".complete")

@@ -8,25 +8,38 @@ collectives, and write one coordinated checkpoint.
 """
 
 import os
-import subprocess
 import sys
 
 import pytest
+
+from _multiproc import pick_port, run_ranks
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.slow
 def test_two_process_training(tmp_path):
-    import socket
-
-    with socket.socket() as s:  # ephemeral port: parallel runs can't collide
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    port = pick_port()
     out_dir = tmp_path / "out"
-    procs = []
-    logs = []
-    for rank in range(2):
+
+    def make_cmd(rank):
+        return [
+            sys.executable,
+            os.path.join(REPO, "scripts", "cpu_mesh_run.py"),
+            os.path.join(REPO, "train_net.py"),
+            "--cfg", os.path.join(REPO, "config", "resnet18.yaml"),
+            "MODEL.DUMMY_INPUT", "True",
+            "MODEL.NUM_CLASSES", "8",
+            "TRAIN.BATCH_SIZE", "2",
+            "TRAIN.IM_SIZE", "32",
+            "TEST.BATCH_SIZE", "2",
+            "TEST.CROP_SIZE", "32",
+            "OPTIM.MAX_EPOCH", "1",
+            "RNG_SEED", "5",
+            "OUT_DIR", str(out_dir),
+        ]
+
+    def make_env(rank):
         env = dict(
             os.environ,
             RANK=str(rank),
@@ -38,42 +51,12 @@ def test_two_process_training(tmp_path):
             XLA_FLAGS="--xla_force_host_platform_device_count=4",
         )
         env.pop("JAX_PLATFORMS", None)
-        log = open(tmp_path / f"rank{rank}.log", "w")
-        logs.append(log)
-        procs.append(
-            subprocess.Popen(
-                [
-                    sys.executable,
-                    os.path.join(REPO, "scripts", "cpu_mesh_run.py"),
-                    os.path.join(REPO, "train_net.py"),
-                    "--cfg", os.path.join(REPO, "config", "resnet18.yaml"),
-                    "MODEL.DUMMY_INPUT", "True",
-                    "MODEL.NUM_CLASSES", "8",
-                    "TRAIN.BATCH_SIZE", "2",
-                    "TRAIN.IM_SIZE", "32",
-                    "TEST.BATCH_SIZE", "2",
-                    "TEST.CROP_SIZE", "32",
-                    "OPTIM.MAX_EPOCH", "1",
-                    "RNG_SEED", "5",
-                    "OUT_DIR", str(out_dir),
-                ],
-                env=env,
-                stdout=log,
-                stderr=subprocess.STDOUT,
-                cwd=REPO,
-            )
-        )
-    try:
-        rcs = [p.wait(timeout=540) for p in procs]
-    finally:
-        for p in procs:
-            p.poll() is None and p.kill()
-        for log in logs:
-            log.close()
-    for rank in range(2):
-        text = open(tmp_path / f"rank{rank}.log").read()
-        assert rcs[rank] == 0, f"rank {rank} failed:\n{text[-3000:]}"
-    r0 = open(tmp_path / "rank0.log").read()
+        return env
+
+    results = run_ranks(tmp_path, 2, make_cmd, make_env, REPO, timeout=540)
+    for rank, (rc, text) in enumerate(results):
+        assert rc == 0, f"rank {rank} rc={rc}:\n{text[-3000:]}"
+    r0 = results[0][1]
     assert "2 hosts" in r0, r0[-2000:]
     assert "Saving checkpoint (async)" in r0
     # checkpoint written exactly once, complete
