@@ -88,15 +88,14 @@ def _watchdog():
 
 # Runs a real tiny computation, not just device enumeration: the observed
 # wedge mode can enumerate devices fine and then hang on the first dispatch.
-# DTPU_BENCH_PROBE_PLATFORM pins the probe's jax platform — needed when the
-# parent run itself is platform-pinned programmatically (cpu_mesh_run.py),
-# since a bare subprocess would otherwise probe the default device.
-_PROBE_CODE = (
-    "import os, jax, jax.numpy as jnp; "
-    "p = os.environ.get('DTPU_BENCH_PROBE_PLATFORM'); "
-    "p and jax.config.update('jax_platforms', p); "
-    "x = jnp.ones((128, 128), jnp.float32); "
-    "print('DTPU_PROBE_OK', float(jax.device_get(x.sum())))"
+# scripts/probe_chip.py is the ONE probe definition, shared with the
+# session-ladder and wait-for-chip shell tools; it honors
+# DTPU_BENCH_PROBE_PLATFORM to pin the probe's jax platform — needed when
+# the parent run itself is platform-pinned programmatically
+# (cpu_mesh_run.py), since a bare subprocess would otherwise probe the
+# default device.
+_PROBE_SCRIPT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "scripts", "probe_chip.py"
 )
 
 
@@ -107,7 +106,7 @@ def _probe_once(timeout: float) -> bool:
     a probe child wedged inside native tunnel code still dies."""
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", _PROBE_CODE],
+            [sys.executable, _PROBE_SCRIPT],
             capture_output=True,
             text=True,
             timeout=timeout,

@@ -1,0 +1,26 @@
+"""Device-health probe: one real dispatch, not just enumeration (exit 0 = healthy).
+
+The observed wedge mode can enumerate devices fine and then hang on the
+first dispatch, so a `jax.devices()` probe can declare a wedged chip
+healthy; this runs an actual computation and a device->host fetch. The ONE
+probe used by bench.py, scripts/tpu_session.sh, and scripts/wait_for_chip.sh.
+Run under an external `timeout -k` (SIGTERM can be absorbed by a child
+wedged in native tunnel code; only SIGKILL is guaranteed):
+
+    timeout -k 10 240 python scripts/probe_chip.py
+
+``DTPU_BENCH_PROBE_PLATFORM`` pins the jax platform (e.g. ``cpu`` for
+device-free smoke runs) — needed because this box pins the platform
+programmatically, so the JAX_PLATFORMS env var alone is not honored.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+p = os.environ.get("DTPU_BENCH_PROBE_PLATFORM")
+if p:
+    jax.config.update("jax_platforms", p)
+x = jnp.ones((128, 128), jnp.float32)
+print("DTPU_PROBE_OK", float(jax.device_get(x.sum())))

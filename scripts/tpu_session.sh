@@ -17,7 +17,7 @@ QUICK="${1:-}"
 say() { echo "=== $* ===" | tee -a "$LOG"; }
 
 say "probe"
-if ! timeout 240 python -c "import jax; print(jax.devices())" >> "$LOG" 2>&1; then
+if ! timeout -k 10 240 python scripts/probe_chip.py >> "$LOG" 2>&1; then
     say "CHIP WEDGED — aborting (see docs/TROUBLESHOOTING.md)"
     exit 1
 fi
@@ -104,7 +104,7 @@ fi
 # health verdict in the log so a wedge is detected at cause time, not by
 # the next session's (or the driver's) burned timeout.
 say "post-ladder probe"
-if timeout 240 python -c "import jax; print(jax.devices())" >> "$LOG" 2>&1; then
+if timeout -k 10 240 python scripts/probe_chip.py >> "$LOG" 2>&1; then
     say "device healthy at session end"
 else
     say "DEVICE WEDGED AT SESSION END — record the last rung above in TROUBLESHOOTING.md"

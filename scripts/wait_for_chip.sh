@@ -1,0 +1,20 @@
+#!/bin/bash
+# Probe the device until it is healthy, then exit 0 — the runbook's
+# "schedule periodic re-probes" step (docs/TROUBLESHOOTING.md #5) as a
+# command. Pair with your shell's notification or `&& bash scripts/tpu_session.sh`
+# ONLY if nothing CPU-heavy can be running when it fires (runbook #4).
+#
+#   DTPU_PROBE_INTERVAL=600 bash scripts/wait_for_chip.sh
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL="${DTPU_PROBE_INTERVAL:-600}"
+while true; do
+    # dispatch-exercising probe (enumeration can pass on a wedged chip);
+    # -k: a child wedged in native code can absorb SIGTERM — escalate to KILL
+    if timeout -k 10 240 python scripts/probe_chip.py >/dev/null 2>&1; then
+        echo "device healthy at $(date -u '+%Y-%m-%d %H:%M:%S') UTC"
+        exit 0
+    fi
+    echo "still wedged at $(date -u '+%Y-%m-%d %H:%M:%S') UTC; next probe in ${INTERVAL}s"
+    sleep "$INTERVAL"
+done
