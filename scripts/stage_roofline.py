@@ -60,7 +60,7 @@ CONVS = [
     ("s4", "1x1 1024->512", 14, 14, 1, 1, 1024, 512, 1),
     ("s4", "3x3/2 512->512", 14, 14, 3, 2, 512, 512, 1),
     ("s4", "ds 1x1/2 1024->2048", 14, 14, 1, 2, 1024, 2048, 1),
-    ("s4", "1x1 2048->512", 14, 14, 1, 1, 2048, 512, 2),
+    ("s4", "1x1 2048->512", 7, 7, 1, 1, 2048, 512, 2),
     ("s4", "3x3 512->512", 7, 7, 3, 1, 512, 512, 2),
     ("s4", "1x1 512->2048", 7, 7, 1, 1, 512, 2048, 3),
 ]
@@ -84,6 +84,17 @@ def main():
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--stage", default=None, help="stem|s1|s2|s3|s4 | mm | step | all")
     args = ap.parse_args()
+
+    # Inventory sanity line: 3x-fwd over all rows should land ~24.7 GF/img —
+    # the XLA-measured 24.43 (scripts/cost_analysis.py) plus the stem dgrad
+    # (~0.3 GF) that a real step never computes (no image gradients needed)
+    # but the per-shape fwd+bwd microbench does. A bigger drift means the
+    # table no longer matches models/resnet.py — fix it before trusting rows.
+    inv = sum(
+        3 * 2.0 * out_hw(h, k, s) * out_hw(w, k, s) * cout * k * k * cin * cnt
+        for _, _, h, w, k, s, cin, cout, cnt in CONVS
+    ) / 1e9
+    print(f"inventory: {inv:.2f} GF/img train (XLA whole-step: 24.43 + ~0.3 stem dgrad)")
 
     timer = threading.Timer(WATCHDOG_SECONDS, _watchdog)
     timer.daemon = True
