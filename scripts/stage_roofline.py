@@ -9,7 +9,8 @@ whole-step ms — the gap is BN/relu/residual/optimizer/metrics + fusion
 overhead. This either finds the stage to attack or proves "emitter-bound,
 nothing left at this width" on paper.
 
-    python scripts/stage_roofline.py [--batch 512] [--iters 10] [--stage stem|s1|s2|s3|s4|mm|step]
+    python scripts/stage_roofline.py [--batch 512] [--iters 10] \
+        [--stage stem|s1|s2|s3|s4|mm|strided|step]
 
 Methodology matches bench.py (docs/BENCH_NOTES.md): timing gated by real
 device_get fetches (block_until_ready is a no-op on the axon transport),
@@ -82,7 +83,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=512)
     ap.add_argument("--iters", type=int, default=10)
-    ap.add_argument("--stage", default=None, help="stem|s1|s2|s3|s4 | mm | step | all")
+    ap.add_argument(
+        "--stage", default=None,
+        help="stem|s1|s2|s3|s4 | mm | strided | step | all (default all)",
+    )
     args = ap.parse_args()
 
     # Inventory sanity line: 3x-fwd over all rows should land ~24.7 GF/img —
@@ -120,6 +124,29 @@ def main():
             carry, out = fn(carry)
         jax.device_get(out)
         return (time.perf_counter() - t0) / n
+
+    def make_fwdbwd(f):
+        """fwd+bwd timing harness for a conv-like f(x, wt).
+
+        Measurement-validity notes (each bit one smoke run): wt/ct are
+        runtime ARGUMENTS, not closure constants — a closure ct+wt makes
+        dgrad = conv(ct, rot(wt)) all-constant and XLA constant-folds it
+        out of the timed program. The full dw reduction (not an element
+        slice) keeps the wgrad entirely live, and the non-zero chain
+        coefficients defeat the algebraic simplifier's mul-by-0 folding.
+        """
+
+        @jax.jit
+        def fb(x, wt, ct):
+            y, vjp = jax.vjp(f, x, wt)
+            dx, dw = vjp(ct)
+            return (
+                x + jnp.bfloat16(1e-6) * dx,
+                wt + jnp.bfloat16(1e-9) * dw,
+                ct,
+            ), jnp.sum(dw.astype(jnp.float32))
+
+        return fb
 
     # --- matmul ceiling, same session -------------------------------------
     mm_tf = None
@@ -163,12 +190,9 @@ def main():
                     dimension_numbers=("NHWC", "HWIO", "NHWC"),
                 )
 
-            # Measurement-validity notes (each bit one smoke run): wt/ct are
-            # runtime ARGUMENTS, not closure constants — a closure ct+wt makes
-            # dgrad = conv(ct, rot(wt)) all-constant and XLA constant-folds it
-            # out of the timed program. Full reductions (not element slices)
-            # keep y/dw entirely live, and the non-zero chain coefficient
-            # defeats the algebraic simplifier's mul-by-0 folding.
+            # see make_fwdbwd for the measurement-validity rationale; fwd's
+            # full y reduction + non-zero chain coefficient follow the same
+            # rules
             @jax.jit
             def fwd(x, wt):
                 y = conv(x, wt)
@@ -178,16 +202,7 @@ def main():
                     wt,
                 ), s
 
-            @jax.jit
-            def fwdbwd(x, wt, ct):
-                y, vjp = jax.vjp(conv, x, wt)
-                dx, dw = vjp(ct)
-                return (
-                    x + jnp.bfloat16(1e-6) * dx,
-                    wt + jnp.bfloat16(1e-9) * dw,
-                    ct,
-                ), jnp.sum(dw.astype(jnp.float32))
-
+            fwdbwd = make_fwdbwd(conv)
             try:
                 dt_f = timed(lambda c: fwd(*c), (x, wt))
                 dt_fb = timed(lambda c: fwdbwd(*c), (x, wt, ct))
@@ -199,6 +214,94 @@ def main():
             print(
                 f"| {stage} | {label} | {count} | {dt_f*1e3:.2f} | {dt_fb*1e3:.2f} "
                 f"| {tf_fb:.1f} | {3*fwd_flops/B/1e9:.2f} |",
+                flush=True,
+            )
+            del x, wt, ct
+
+    # --- strided-conv alternatives: the candidate MFU lever ----------------
+    # Stride-2 convs are the classic TPU soft spot (their dgrad is a
+    # transposed strided conv). Same transform as the stem: zero-pad the 3x3
+    # kernel to 4x4 (top/left), 2x2-block kernel and activations, run the
+    # exact-equivalent 2x2 STRIDE-1 conv on (H/2, W/2, 4C) — dgrad becomes a
+    # stride-1 dgrad. 1x1/2 convs become slice + 1x1. Equality asserted in
+    # f32 before timing; the 3x3 alt executes 16/9 the MACs (zero taps), so
+    # compare ms, not TF. Measure-first: models/ only adopts this if it wins.
+    if want in ("all", "strided"):
+        print("\n| strided conv | direct f+b ms | s2d f+b ms | speedup |")
+        print("|---|---|---|---|", flush=True)
+        for stage, label, h, w, k, s, cin, cout, count in CONVS:
+            if s != 2:
+                continue
+            ho, wo = out_hw(h, k, s), out_hw(w, k, s)
+
+            def direct_fn(x, wt, s=s):
+                return jax.lax.conv_general_dilated(
+                    x, wt, window_strides=(s, s), padding="SAME",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                )
+
+            if k == 3:
+
+                def alt_fn(x, wt, cin=cin, cout=cout):
+                    wp = jnp.pad(wt, ((1, 0), (1, 0), (0, 0), (0, 0)))
+                    wp = (
+                        wp.reshape(2, 2, 2, 2, cin, cout)
+                        .transpose(0, 2, 1, 3, 4, 5)
+                        .reshape(2, 2, 4 * cin, cout)
+                    )
+                    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+                    n, hp, wpx, c = xp.shape
+                    xs = (
+                        xp.reshape(n, hp // 2, 2, wpx // 2, 2, c)
+                        .transpose(0, 1, 3, 2, 4, 5)
+                        .reshape(n, hp // 2, wpx // 2, 4 * c)
+                    )
+                    return jax.lax.conv_general_dilated(
+                        xs, wp, window_strides=(1, 1), padding="VALID",
+                        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    )
+
+            else:  # 1x1 stride 2 == slice even pixels + 1x1
+
+                def alt_fn(x, wt):
+                    return jax.lax.conv_general_dilated(
+                        x[:, ::2, ::2, :], wt, window_strides=(1, 1),
+                        padding="VALID",
+                        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    )
+
+            # exact-math check in f32 on a small batch before timing; a
+            # mismatch fails THIS row and the sweep continues, like every
+            # other per-row failure in the script
+            try:
+                xf = jnp.asarray(rng.standard_normal((2, h, w, cin)), jnp.float32)
+                wf = jnp.asarray(
+                    rng.standard_normal((k, k, cin, cout)) * 0.05, jnp.float32
+                )
+                np.testing.assert_allclose(
+                    np.asarray(direct_fn(xf, wf)), np.asarray(alt_fn(xf, wf)),
+                    rtol=1e-4, atol=1e-4, err_msg=label,
+                )
+                del xf, wf
+            except AssertionError:
+                print(f"| {label} | MISMATCH (s2d != direct) | | |", flush=True)
+                continue
+
+            x = jnp.asarray(rng.standard_normal((B, h, w, cin)) * 0.1, jnp.bfloat16)
+            wt = jnp.asarray(rng.standard_normal((k, k, cin, cout)) * 0.05, jnp.bfloat16)
+            ct = jnp.asarray(rng.standard_normal((B, ho, wo, cout)) * 0.1, jnp.bfloat16)
+
+            try:
+                fb_d = make_fwdbwd(direct_fn)
+                fb_a = make_fwdbwd(alt_fn)
+                dt_d = timed(lambda c: fb_d(*c), (x, wt, ct))
+                dt_a = timed(lambda c: fb_a(*c), (x, wt, ct))
+            except Exception as e:
+                print(f"| {label} | FAILED {type(e).__name__} | | |", flush=True)
+                continue
+            print(
+                f"| {label} | {dt_d*1e3:.2f} | {dt_a*1e3:.2f} "
+                f"| {dt_d/dt_a:.2f}x |",
                 flush=True,
             )
             del x, wt, ct
