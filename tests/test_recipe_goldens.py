@@ -78,26 +78,28 @@ def test_lr_golden_steps_recipe(fresh_cfg):
         assert optim.get_epoch_lr(epoch) == pytest.approx(want, abs=1e-12), epoch
 
 
-# Golden per-epoch mean training loss for the fixed tiny run below,
-# recorded 2026-07-29 on the 8-device CPU mesh (two identical runs were
-# bit-equal). The shape of this curve is a fingerprint of the recipe: e.g.
-# dropping warmup multiplies epoch-0 LR by 10 and blows up epoch 1+;
-# breaking momentum or smoothing shifts every entry by >>0.12.
-_LOSS_GOLDEN = [0.709294, 0.500817, 1.440113, 1.797884, 0.902636, 0.820162]
+# Golden per-epoch mean training losses for the fixed tiny runs below,
+# recorded 2026-07-29/30 on the 8-device CPU mesh (two identical runs were
+# bit-equal for each). The shape of each curve is a fingerprint of its
+# recipe: e.g. dropping warmup multiplies epoch-0 LR by 10 and blows up
+# epoch 1+; breaking momentum or smoothing shifts every entry by >>0.12;
+# the two policies produce visibly different curves from epoch 1 on.
+_LOSS_GOLDEN_COS = [0.709294, 0.500817, 1.440113, 1.797884, 0.902636, 0.820162]
+_LOSS_GOLDEN_STEPS = [0.709294, 0.794066, 1.251569, 1.146183, 1.087298, 1.052239]
 
 
-@pytest.mark.slow
-def test_loss_trajectory_golden(fresh_cfg):
+def _run_fixed_trajectory(c):
+    """The fixed tiny run both trajectory goldens fingerprint: resnet18/4cls,
+    8-device mesh, one replayed 16-image batch, 6 epochs x 2 iters.
+
+    ``c`` must be the global config singleton (the fresh_cfg fixture): the
+    trainer/model builders read it ambiently, not through this argument."""
     from distribuuuu_tpu.models import build_model
     from distribuuuu_tpu.runtime import create_mesh
     from distribuuuu_tpu.trainer import create_train_state, make_train_step
 
-    c = fresh_cfg
-    c.OPTIM.LR_POLICY = "cos"
     c.OPTIM.BASE_LR = 0.1
     c.OPTIM.MAX_EPOCH = 6
-    c.OPTIM.WARMUP_EPOCHS = 2
-    c.OPTIM.WARMUP_FACTOR = 0.1
     c.OPTIM.MOMENTUM = 0.9
     c.OPTIM.WEIGHT_DECAY = 5e-4
     c.TRAIN.LABEL_SMOOTH = 0.1
@@ -129,4 +131,26 @@ def test_loss_trajectory_golden(fresh_cfg):
             state, m = step(state, batch, lr, k)
         m = jax.device_get(m)
         losses.append(float(m["loss_sum"] / m["n"]))
-    assert losses == pytest.approx(_LOSS_GOLDEN, abs=0.12), losses
+    return losses
+
+
+@pytest.mark.slow
+def test_loss_trajectory_golden(fresh_cfg):
+    c = fresh_cfg
+    c.OPTIM.LR_POLICY = "cos"
+    c.OPTIM.WARMUP_EPOCHS = 2
+    c.OPTIM.WARMUP_FACTOR = 0.1
+    losses = _run_fixed_trajectory(c)
+    assert losses == pytest.approx(_LOSS_GOLDEN_COS, abs=0.12), losses
+
+
+@pytest.mark.slow
+def test_loss_trajectory_golden_steps(fresh_cfg):
+    c = fresh_cfg
+    c.OPTIM.LR_POLICY = "steps"
+    c.OPTIM.STEPS = [0, 2, 4]
+    c.OPTIM.LR_MULT = 0.1
+    c.OPTIM.WARMUP_EPOCHS = 1
+    c.OPTIM.WARMUP_FACTOR = 0.1
+    losses = _run_fixed_trajectory(c)
+    assert losses == pytest.approx(_LOSS_GOLDEN_STEPS, abs=0.12), losses
