@@ -6,7 +6,10 @@
 # non-interactively (fewer chances to wedge the chip between steps), logging
 # everything to docs/tpu_session_<ts>.log for BENCH_NOTES.
 #
-# Usage: bash scripts/tpu_session.sh [--quick]
+# Usage: bash scripts/tpu_session.sh [--quick|--bench-only]
+#   --quick       shorter perf sweep
+#   --bench-only  probe + headline bench.py + post-probe (~8 min) — for when
+#                 the chip recovers too late in a round for the full ladder
 
 set -uo pipefail
 cd "$(dirname "$0")/.."
@@ -33,7 +36,27 @@ run_or_abort() {
     fi
 }
 
+# End-of-session protocol (docs/TROUBLESHOOTING.md runbook #5), shared by
+# the full ladder and --bench-only: leave a health verdict in the log so a
+# wedge is detected at cause time, not by the next session's (or the
+# driver's) burned timeout.
+post_probe() {
+    say "end-of-session probe"
+    if timeout -k 10 240 python scripts/probe_chip.py >> "$LOG" 2>&1; then
+        say "device healthy at session end"
+    else
+        say "DEVICE WEDGED AT SESSION END — record the last rung above in TROUBLESHOOTING.md"
+        exit 1
+    fi
+}
+
 run_or_abort "bench.py (shipped-best: bn16 + s2d)" timeout 600 python bench.py
+
+if [ "$QUICK" = "--bench-only" ]; then
+    post_probe
+    say "done (bench-only) — full log at $LOG"
+    exit 0
+fi
 
 run_or_abort "bench.py (A/B: f32 BN boundaries)" \
     env DTPU_BENCH_BNF32=1 timeout 600 python bench.py
@@ -100,15 +123,6 @@ if [ $soak_rc -eq 0 ]; then
         timeout 600 python bench.py
 fi
 
-# End-of-session protocol (docs/TROUBLESHOOTING.md runbook #5): leave a
-# health verdict in the log so a wedge is detected at cause time, not by
-# the next session's (or the driver's) burned timeout.
-say "post-ladder probe"
-if timeout -k 10 240 python scripts/probe_chip.py >> "$LOG" 2>&1; then
-    say "device healthy at session end"
-else
-    say "DEVICE WEDGED AT SESSION END — record the last rung above in TROUBLESHOOTING.md"
-    exit 1
-fi
+post_probe
 
 say "done — full log at $LOG"
