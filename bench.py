@@ -57,8 +57,18 @@ WATCHDOG_SECONDS = 540  # total wall budget: the tunnel can wedge; never hang th
 # Per-attempt subprocess budget (healthy chip answers in ~15-30s) and the
 # pause between the two attempts. Env-overridable so the contract tests can
 # exercise the abort path without waiting out production timeouts.
-PROBE_TIMEOUT = float(os.environ.get("DTPU_BENCH_PROBE_TIMEOUT", "120"))
-PROBE_BACKOFF = float(os.environ.get("DTPU_BENCH_PROBE_BACKOFF", "20"))
+def _float_env(name: str, default: float) -> float:
+    """A malformed override must not crash bench before the watchdog/_fail_line
+    exist (the one-JSON-line contract): fall back to the default instead."""
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        print(f"bench: ignoring malformed {name}={os.environ[name]!r}", file=sys.stderr, flush=True)
+        return default
+
+
+PROBE_TIMEOUT = _float_env("DTPU_BENCH_PROBE_TIMEOUT", 120.0)
+PROBE_BACKOFF = _float_env("DTPU_BENCH_PROBE_BACKOFF", 20.0)
 
 
 def _fail_line(reason: str) -> None:

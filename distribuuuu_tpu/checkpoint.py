@@ -25,7 +25,6 @@ rank-0-only save gate at `utils.py:369-370`.
 
 from __future__ import annotations
 
-import os
 import re
 from typing import Any
 
@@ -33,21 +32,23 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from distribuuuu_tpu.runtime import pathio
+
 _NAME_PREFIX = "ckpt_ep_"
 _DIR_NAME = "checkpoints"
 _BEST_NAME = "best"
 
 
 def get_checkpoint_dir(out_dir: str) -> str:
-    return os.path.join(out_dir, _DIR_NAME)
+    return pathio.join(out_dir, _DIR_NAME)
 
 
 def get_checkpoint_path(out_dir: str, epoch: int) -> str:
-    return os.path.join(get_checkpoint_dir(out_dir), f"{_NAME_PREFIX}{epoch:03d}")
+    return pathio.join(get_checkpoint_dir(out_dir), f"{_NAME_PREFIX}{epoch:03d}")
 
 
 def get_best_path(out_dir: str) -> str:
-    return os.path.join(get_checkpoint_dir(out_dir), _BEST_NAME)
+    return pathio.join(get_checkpoint_dir(out_dir), _BEST_NAME)
 
 
 # Exact-name match so Orbax in-progress temp dirs
@@ -57,14 +58,17 @@ _CKPT_RE = re.compile(rf"^{_NAME_PREFIX}(\d+)$")
 
 
 def _complete_checkpoints(out_dir: str) -> list[tuple[int, str]]:
+    # pathio, not os: OUT_DIR is commonly gs:// on a pod, and auto-resume
+    # must scan it the same way Orbax wrote it (reference parity:
+    # `utils.py:340` does this through g_pathmgr.ls for the same reason).
     d = get_checkpoint_dir(out_dir)
-    if not os.path.isdir(d):
+    if not pathio.isdir(d):
         return []
     out = []
-    for f in os.listdir(d):
+    for f in pathio.listdir(d):
         m = _CKPT_RE.match(f)
         if m:
-            out.append((int(m.group(1)), os.path.join(d, f)))
+            out.append((int(m.group(1)), pathio.join(d, f)))
     return sorted(out)
 
 

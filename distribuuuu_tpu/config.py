@@ -22,7 +22,6 @@ TPU-native additions (new sections; absent keys in old YAMLs simply keep default
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 
 from distribuuuu_tpu.cfgnode import CfgNode as CN
@@ -140,10 +139,15 @@ def merge_from_file(cfg_file: str) -> None:
 
 
 def dump_cfg() -> None:
-    """Dump the config to OUT_DIR/CFG_DEST (provenance, `config.py:75-79`)."""
-    os.makedirs(_C.OUT_DIR, exist_ok=True)
-    cfg_file = os.path.join(_C.OUT_DIR, _C.CFG_DEST)
-    with open(cfg_file, "w") as f:
+    """Dump the config to OUT_DIR/CFG_DEST (provenance, `config.py:75-79`).
+
+    Through pathio so OUT_DIR may be an object store — the reference routes
+    this through g_pathmgr (`config.py:70-78`) for the same reason."""
+    from distribuuuu_tpu.runtime import pathio
+
+    pathio.makedirs(_C.OUT_DIR)
+    cfg_file = pathio.join(_C.OUT_DIR, _C.CFG_DEST)
+    with pathio.open_write(cfg_file) as f:
         _C.dump(stream=f)
 
 

@@ -9,8 +9,8 @@ enough that the reference's log-reading habits transfer.
 
 from __future__ import annotations
 
+import atexit
 import logging
-import os
 import sys
 import time
 
@@ -37,9 +37,21 @@ def setup_logger(out_dir: str | None = None, process_index: int = 0) -> logging.
     if process_index == 0:
         logger.setLevel(logging.INFO)
         if out_dir:
-            os.makedirs(out_dir, exist_ok=True)
-            logfile = os.path.join(out_dir, time.strftime("%Y%m%d_%H%M%S") + ".log")
-            fh = logging.FileHandler(logfile)
+            from distribuuuu_tpu.runtime import pathio
+
+            pathio.makedirs(out_dir)
+            logfile = pathio.join(out_dir, time.strftime("%Y%m%d_%H%M%S") + ".log")
+            if pathio.is_remote(logfile):
+                # Object stores have no append: stream into one open writer
+                # whose content commits at close (atexit). A kill that skips
+                # atexit (SIGKILL/OOM) loses the whole remote log object —
+                # stderr carries the live copy, and the pod runner's stderr
+                # capture is the durable record for crashed runs.
+                stream = pathio.open_write(logfile)
+                atexit.register(stream.close)
+                fh = logging.StreamHandler(stream)
+            else:
+                fh = logging.FileHandler(logfile)
             fh.setFormatter(fmt)
             logger.addHandler(fh)
     else:

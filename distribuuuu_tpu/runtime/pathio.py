@@ -1,0 +1,50 @@
+"""Storage-abstracted path I/O for everything that touches ``OUT_DIR``.
+
+The reference routes all checkpoint/config/log I/O through iopath's
+``g_pathmgr`` (`/root/reference/distribuuuu/utils.py:12`, `utils.py:340`,
+`config.py:70-78`) precisely so OUT_DIR can be non-POSIX — on real pods it
+is typically ``gs://``. The TPU-native analog is `etils.epath` (the same
+path layer Orbax uses internally for its own writes), so the auto-resume
+scan, config provenance dump, and rank-0 log file work against local disk
+and object stores through one code path.
+
+Only OUT_DIR artifacts go through here. Dataset roots stay `os.*`: input
+pipelines read local host storage by design (the reference's ImageFolder
+does too), and the hot decode loop must not pay a VFS indirection.
+"""
+
+from __future__ import annotations
+
+from typing import IO
+
+from etils import epath
+
+
+def is_remote(path: str) -> bool:
+    """True for URL-style paths (gs://, s3://, ...) that bare ``os`` breaks on."""
+    return "://" in str(path)
+
+
+def makedirs(path: str) -> None:
+    epath.Path(path).mkdir(parents=True, exist_ok=True)
+
+
+def isdir(path: str) -> bool:
+    return epath.Path(path).is_dir()
+
+
+def listdir(path: str) -> list[str]:
+    """Child basenames of a directory (the ``os.listdir`` contract)."""
+    return [p.name for p in epath.Path(path).iterdir()]
+
+
+def join(path: str, *parts: str) -> str:
+    return str(epath.Path(path).joinpath(*parts))
+
+
+def open_write(path: str) -> IO[str]:
+    """Open ``path`` for text writing. On object stores the content becomes
+    visible at ``close()`` (no partial writes), which is exactly right for
+    provenance dumps; callers that stream (the log handler) flush best-effort
+    and rely on close for durability."""
+    return epath.Path(path).open("w")

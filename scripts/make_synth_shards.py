@@ -67,14 +67,24 @@ def main() -> None:
     # Completion marker, written LAST: a .tar existing is not "done" — a run
     # killed mid-write (tpu_session.sh's timeout) would otherwise poison
     # every later session with a truncated shard that "already exists".
+    # The marker records the generation parameters, so a rerun with different
+    # sizes regenerates instead of silently reusing a mismatched dataset.
+    gen_args = (
+        f"train-images={args.train_images} val-images={args.val_images} "
+        f"classes={args.classes} shard-size={args.shard_size}\n"
+    )
     marker = os.path.join(args.dst, ".complete")
     if os.path.isfile(marker):
-        print(f"{args.dst}: shards already present, nothing to do")
-        return
+        with open(marker) as f:
+            existing = f.read()
+        if existing == gen_args:
+            print(f"{args.dst}: shards already present, nothing to do")
+            return
+        print(f"{args.dst}: complete but generated with {existing.strip()!r} != requested")
     if os.path.isdir(args.dst):
         import shutil
 
-        print(f"{args.dst}: exists without completion marker — regenerating")
+        print(f"{args.dst}: regenerating")
         shutil.rmtree(args.dst)
 
     classes = [f"class_{c:03d}" for c in range(args.classes)]
@@ -84,7 +94,7 @@ def main() -> None:
     write_split(os.path.join(args.dst, "val"), args.val_images, classes,
                 args.shard_size, seed=1)
     with open(marker, "w") as f:
-        f.write("ok\n")
+        f.write(gen_args)
     print(
         f"wrote {args.train_images}+{args.val_images} JPEGs (mean {kb:.0f} KB) "
         f"-> {args.dst} in {time.perf_counter() - t0:.0f}s"

@@ -46,7 +46,14 @@ def run_ranks(tmp_path, n, make_cmd, make_env, cwd, timeout):
                 rcs.append(None)  # killed in finally; log still reported
     finally:
         for p, log in procs:
-            p.poll() is None and p.kill()
+            if p.poll() is None:
+                p.kill()
+            # Reap the child (no zombie for the rest of the pytest run) and
+            # let it flush its final buffered output before the logs are read.
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
             log.close()
     return [
         (rc, open(tmp_path / f"rank{rank}.log").read())

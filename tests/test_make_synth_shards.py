@@ -37,8 +37,15 @@ def test_generate_marker_and_contract(tmp_path):
         data, name = ds.read_bytes(0)
         assert data[:2] == b"\xff\xd8", name  # JPEG SOI
 
-    # complete -> rerun is a no-op
-    assert "nothing to do" in run(dst)
+    # complete + same parameters -> rerun is a no-op
+    assert "nothing to do" in run(dst, *args)
+
+    # complete but different parameters -> regenerated, not silently reused
+    out = run(dst, "--train-images", "16", "--val-images", "8",
+              "--classes", "4", "--shard-size", "16")
+    assert "regenerating" in out and "wrote 16+8" in out
+    out = run(dst, *args)  # back to the original request: regenerates again
+    assert "wrote 24+8" in out
 
     # marker gone (killed mid-write) -> regenerated from scratch, not trusted
     os.remove(dst / ".complete")

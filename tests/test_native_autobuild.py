@@ -5,6 +5,7 @@ Lives in its OWN module: test_native_decode.py is skipif-gated on
 clone — gating this test there would skip it exactly when it should fail.
 """
 
+import fcntl
 import os
 import shutil
 import subprocess
@@ -16,10 +17,18 @@ import pytest
 def test_autobuild_fresh_tree(tmp_path):
     """A fresh clone (no native/build/) must build the library on first use
     — the silent-PIL-fallback failure mode VERDICT r2 flagged. Runs in a
-    subprocess so this process's cached handle is untouched."""
+    subprocess so this process's cached handle is untouched.
+
+    Mutates the repo-shared ``native/build`` directory: an exclusive flock
+    on ``native/.autobuild_test.lock`` serializes concurrent runs of this
+    test (pytest-xdist workers, parallel sessions). Other processes that
+    merely *use* the library while this runs may still observe a missing
+    .so and trigger a redundant (atomic, so harmless) rebuild."""
     if shutil.which("g++") is None:
         pytest.skip("no g++ on this box")
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    lock = open(os.path.join(repo, "native", ".autobuild_test.lock"), "w")
+    fcntl.flock(lock, fcntl.LOCK_EX)  # released on close at test exit
     build = os.path.join(repo, "native", "build")
     moved = str(tmp_path / "build.bak")
     had_build = os.path.isdir(build)  # gitignored: absent on a fresh clone
@@ -47,3 +56,4 @@ def test_autobuild_fresh_tree(tmp_path):
             if os.path.isdir(build):
                 shutil.rmtree(build)
             shutil.move(moved, build)
+        lock.close()
