@@ -31,6 +31,12 @@ cfg = _C
 
 _C.MODEL = CN()
 _C.MODEL.ARCH = "resnet18"
+# Out-of-tree architectures: comma-separated module path(s) imported before
+# MODEL.ARCH is resolved, so external packages can self-register archs with
+# @register_model. The loud, explicit answer to the reference's silent timm
+# fallback (`trainer.py:117-128`) — an import failure or unknown arch raises
+# with the full story instead of quietly training a different model.
+_C.MODEL.MODULE = ""
 _C.MODEL.NUM_CLASSES = 1000
 _C.MODEL.PRETRAINED = False
 _C.MODEL.SYNCBN = False

@@ -19,6 +19,7 @@ place in HBM, so peak memory is ~one copy of state + activations.
 from __future__ import annotations
 
 import functools
+import importlib
 import os
 import time
 from typing import Any
@@ -237,9 +238,27 @@ def create_train_state(model, key, mesh: Mesh, im_size: int):
     return state, tx
 
 
+def _import_arch_modules() -> None:
+    """Import MODEL.MODULE so out-of-tree archs self-register (the explicit
+    analog of the reference's timm fallback, `trainer.py:117-128`). External
+    factories must accept the `build_model` kwargs: ``num_classes``,
+    ``dtype``, ``bn_axis_name``, ``remat`` (and ``stem_s2d`` when opted in).
+    """
+    for mod in filter(None, (m.strip() for m in cfg.MODEL.MODULE.split(","))):
+        try:
+            importlib.import_module(mod)
+        except ImportError as exc:
+            raise ImportError(
+                f"MODEL.MODULE {mod!r} failed to import ({exc}). It must be "
+                f"an importable module that registers archs via "
+                f"distribuuuu_tpu.models.register_model."
+            ) from exc
+
+
 def _build_cfg_model():
     from distribuuuu_tpu.models.layers import set_bn_compute_dtype
 
+    _import_arch_modules()
     if cfg.MODEL.DTYPE not in ("float32", "bfloat16"):
         raise ValueError(
             f"MODEL.DTYPE must be 'float32' or 'bfloat16', got {cfg.MODEL.DTYPE!r}"

@@ -1,0 +1,117 @@
+"""Out-of-tree architecture hook (MODEL.MODULE).
+
+The reference can train arbitrary archs via its silent timm fallback
+(`/root/reference/distribuuuu/trainer.py:117-128`); the TPU-native answer is
+explicit: MODEL.MODULE names module(s) imported before MODEL.ARCH resolves,
+and the external module self-registers archs with ``@register_model``. These
+tests pin the contract end to end: in-process build, loud import failure,
+and a real CLI training run on an external arch.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# A miniature external package: BN included so the bn_axis_name/batch_stats
+# plumbing is exercised, not just the registry lookup.
+_EXT_SRC = textwrap.dedent(
+    """
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from distribuuuu_tpu.models import register_model
+
+
+    class TinyExtNet(nn.Module):
+        num_classes: int
+        dtype: object = jnp.float32
+        bn_axis_name: str | None = None
+
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = x.astype(self.dtype)
+            x = nn.Conv(8, (3, 3), dtype=self.dtype)(x)
+            x = nn.BatchNorm(
+                use_running_average=not train, axis_name=self.bn_axis_name
+            )(x)
+            x = nn.relu(x)
+            x = x.mean(axis=(1, 2))
+            return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+    @register_model("{name}")
+    def {name}(num_classes, dtype, bn_axis_name=None, remat=False):
+        return TinyExtNet(
+            num_classes=num_classes, dtype=dtype, bn_axis_name=bn_axis_name
+        )
+    """
+)
+
+
+def _write_ext_module(dirpath, modname, archname):
+    path = os.path.join(str(dirpath), f"{modname}.py")
+    with open(path, "w") as f:
+        f.write(_EXT_SRC.format(name=archname))
+    return path
+
+
+def test_external_arch_builds_in_process(tmp_path, monkeypatch, fresh_cfg):
+    _write_ext_module(tmp_path, "ext_models_a", "ext_tinynet_a")
+    monkeypatch.syspath_prepend(str(tmp_path))
+    fresh_cfg.MODEL.MODULE = "ext_models_a"
+    fresh_cfg.MODEL.ARCH = "ext_tinynet_a"
+    fresh_cfg.MODEL.NUM_CLASSES = 7
+    from distribuuuu_tpu.trainer import _build_cfg_model
+
+    model = _build_cfg_model()
+    assert type(model).__name__ == "TinyExtNet"
+    assert model.num_classes == 7
+
+
+def test_external_arch_import_failure_is_loud(fresh_cfg):
+    fresh_cfg.MODEL.MODULE = "no_such_module_xyz"
+    from distribuuuu_tpu.trainer import _build_cfg_model
+
+    with pytest.raises(ImportError, match="MODEL.MODULE 'no_such_module_xyz'"):
+        _build_cfg_model()
+
+
+@pytest.mark.slow
+def test_external_arch_through_cli(tmp_path):
+    """The verdict's done-bar: an external arch trains through the real
+    train_net.py CLI (8-device CPU mesh), checkpoint and all."""
+    _write_ext_module(tmp_path, "ext_models_cli", "ext_tinynet_cli")
+    out_dir = tmp_path / "out"
+    env = {
+        **os.environ,
+        "PYTHONPATH": f"{tmp_path}{os.pathsep}" + os.environ.get("PYTHONPATH", ""),
+    }
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "scripts", "cpu_mesh_run.py"),
+            os.path.join(REPO, "train_net.py"),
+            "MODEL.MODULE", "ext_models_cli",
+            "MODEL.ARCH", "ext_tinynet_cli",
+            "MODEL.NUM_CLASSES", "8",
+            "MODEL.DTYPE", "float32",
+            "MODEL.DUMMY_INPUT", "True",
+            "OPTIM.MAX_EPOCH", "1",
+            "OPTIM.WARMUP_EPOCHS", "0",
+            "TRAIN.BATCH_SIZE", "8",
+            "TRAIN.IM_SIZE", "16",
+            "TEST.IM_SIZE", "18",
+            "TEST.CROP_SIZE", "16",
+            "TEST.BATCH_SIZE", "16",
+            "TRAIN.DUMMY_EPOCH_SAMPLES", "64",
+            "TRAIN.TOPK", "5",
+            "OUT_DIR", str(out_dir),
+        ],
+        capture_output=True, text=True, timeout=420, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert (out_dir / "checkpoints" / "ckpt_ep_001").is_dir(), proc.stderr[-500:]
