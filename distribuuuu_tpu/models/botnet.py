@@ -88,12 +88,17 @@ class AbsPosEmb(nn.Module):
     dim_head: int
 
     @nn.compact
-    def __call__(self, q: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, q: jnp.ndarray, return_table: bool = False) -> jnp.ndarray:
+        """Bias logits ``q·embᵀ`` — or, with ``return_table``, the shared
+        [L, dim_head] table itself so the fused kernel can apply it in-VMEM
+        instead of round-tripping the [B,N,L,L] product through HBM."""
         scale = self.dim_head**-0.5
         init = nn.initializers.normal(stddev=scale)
         emb_h = self.param("height", init, (self.height, self.dim_head), jnp.float32)
         emb_w = self.param("width", init, (self.width, self.dim_head), jnp.float32)
         emb = (emb_h[:, None, :] + emb_w[None, :, :]).reshape(-1, self.dim_head)
+        if return_table:
+            return emb.astype(q.dtype)
         return jnp.einsum("bnid,jd->bnij", q, emb.astype(q.dtype))
 
 
@@ -129,9 +134,9 @@ class MHSA(nn.Module):
         v = heads_first(v, dv)
 
         pos_cls = RelPosEmb if self.rel_pos_emb else AbsPosEmb
-        bias = pos_cls(
+        pos = pos_cls(
             height=self.fmap_size[0], width=self.fmap_size[1], dim_head=dqk, name="pos_emb"
-        )(q)
+        )
         fuse = self.fuse
         if fuse is None:
             # opt-in while the kernel soaks: auto-enables on TPU only when
@@ -143,8 +148,21 @@ class MHSA(nn.Module):
                 jax.default_backend() == "tpu"
                 and os.environ.get("DTPU_FUSED_ATTN") == "1"
             )
-        attn = fused_attention if fuse else xla_attention
-        out = attn(q, k, v, bias)
+        # off-TPU a forced fuse runs the Pallas interpreter (tests; a user
+        # setting fuse=True on CPU gets slow-but-correct instead of a crash)
+        interpret = jax.default_backend() != "tpu"
+        if fuse and not self.rel_pos_emb:
+            # abs-bias fast path: hand the kernel the [L, dqk] table and let
+            # it form q·embᵀ in VMEM — skips writing+reading the [B,N,L,L]
+            # bias product through HBM (ops/attention.py, "Absolute-position
+            # variant")
+            from distribuuuu_tpu.ops import fused_attention_abs
+
+            out = fused_attention_abs(q, k, v, pos(q, return_table=True), interpret=interpret)
+        elif fuse:
+            out = fused_attention(q, k, v, pos(q), interpret=interpret)
+        else:
+            out = xla_attention(q, k, v, pos(q))
         return out.transpose(0, 2, 1, 3).reshape(b, h, w, heads * dv)
 
 

@@ -1,5 +1,9 @@
 """Custom TPU kernels (Pallas) with XLA fallbacks."""
 
-from distribuuuu_tpu.ops.attention import fused_attention, xla_attention
+from distribuuuu_tpu.ops.attention import (
+    fused_attention,
+    fused_attention_abs,
+    xla_attention,
+)
 
-__all__ = ["fused_attention", "xla_attention"]
+__all__ = ["fused_attention", "fused_attention_abs", "xla_attention"]

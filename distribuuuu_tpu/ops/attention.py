@@ -113,3 +113,101 @@ def fused_attention(q, k, v, bias, *, interpret: bool = False):
     ``interpret=True`` runs the kernel in the Pallas interpreter (CPU tests).
     """
     return _fused_attention(q, k, v, bias, interpret)
+
+
+# ---------------------------------------------------------------------------
+# Absolute-position variant: bias computed IN-KERNEL from the shared table
+# ---------------------------------------------------------------------------
+#
+# BoTNet's default (abs) position bias is ``q·embᵀ`` with one [L, D] table
+# shared by every batch element and head (`models/botnet.py::AbsPosEmb`).
+# Passing the *product* to the kernel makes XLA materialize a [B,N,L,L]
+# float32 bias in HBM that the kernel immediately re-reads — at production
+# shapes (B·N=1024 tiles, L=196) that is ~300 MB of pure round-trip per
+# forward. Here the kernel takes the 100 KB table instead and computes the
+# bias tile on the MXU while everything is VMEM-resident.
+
+
+def _attn_kernel_abs(q_ref, k_ref, v_ref, emb_ref, o_ref):
+    """One (batch·head) tile: q·kᵀ + q·embᵀ → softmax(f32) → weighted sum."""
+    q = q_ref[0]  # [L, D]
+    k = k_ref[0]
+    v = v_ref[0]
+    emb = emb_ref[...]  # [L, D], same block for every grid step
+    logits = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) + jax.lax.dot_general(
+        q, emb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    e = jnp.exp(logits - m)
+    p = e / jnp.sum(e, axis=-1, keepdims=True)
+    o_ref[0] = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(o_ref.dtype)
+
+
+def _fused_abs_fwd_impl(q, k, v, emb, *, interpret: bool = False):
+    b, n, l, d = q.shape
+    dv = v.shape[-1]
+    qf = q.reshape(b * n, l, d)
+    kf = k.reshape(b * n, l, d)
+    vf = v.reshape(b * n, l, dv)
+    embf = emb.astype(q.dtype)  # [L, D]
+    out = pl.pallas_call(
+        _attn_kernel_abs,
+        grid=(b * n,),
+        in_specs=[
+            pl.BlockSpec((1, l, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, l, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, l, dv), lambda i: (i, 0, 0)),
+            pl.BlockSpec((l, d), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, l, dv), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * n, l, dv), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, embf)
+    return out.reshape(b, n, l, dv)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fused_attention_abs(q, k, v, emb, interpret=False):
+    return _fused_abs_fwd_impl(q, k, v, emb, interpret=interpret)
+
+
+def _abs_fwd(q, k, v, emb, interpret):
+    return _fused_abs_fwd_impl(q, k, v, emb, interpret=interpret), (q, k, v, emb)
+
+
+def _abs_bwd(interpret, res, g):
+    q, k, v, emb = res
+    # recompute logits (XLA, flash-style): standard attention gradients plus
+    # the table path — bias = q·embᵀ, so dq += dsoft·emb and
+    # demb = Σ_{b,n} dsoftᵀ·q
+    q32, k32, e32 = (t.astype(jnp.float32) for t in (q, k, emb))
+    logits = jnp.einsum("bnxd,bnyd->bnxy", q32, k32) + jnp.einsum(
+        "bnxd,jd->bnxj", q32, e32
+    )
+    p = jax.nn.softmax(logits, axis=-1)
+    g32 = g.astype(jnp.float32)
+    v32 = v.astype(jnp.float32)
+    dp = jnp.einsum("bnxd,bnyd->bnxy", g32, v32)
+    dv = jnp.einsum("bnxy,bnxd->bnyd", p, g32).astype(v.dtype)
+    dsoft = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    dq = (
+        jnp.einsum("bnxy,bnyd->bnxd", dsoft, k32)
+        + jnp.einsum("bnxj,jd->bnxd", dsoft, e32)
+    ).astype(q.dtype)
+    dk = jnp.einsum("bnxy,bnxd->bnyd", dsoft, q32).astype(k.dtype)
+    demb = jnp.einsum("bnxj,bnxd->jd", dsoft, q32).astype(emb.dtype)
+    return dq, dk, dv, demb
+
+
+_fused_attention_abs.defvjp(_abs_fwd, _abs_bwd)
+
+
+def fused_attention_abs(q, k, v, emb, *, interpret: bool = False):
+    """softmax(q·kᵀ + q·embᵀ)·v with the [L, D] position table applied
+    in-kernel; differentiable (incl. d/d emb). q pre-scaled, as above."""
+    return _fused_attention_abs(q, k, v, emb, interpret)

@@ -20,7 +20,11 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
-    from distribuuuu_tpu.ops.attention import fused_attention, xla_attention
+    from distribuuuu_tpu.ops.attention import (
+        fused_attention,
+        fused_attention_abs,
+        xla_attention,
+    )
 
     print(f"devices: {jax.devices()}", flush=True)
     rng = np.random.default_rng(0)
@@ -57,7 +61,44 @@ def main():
             jax.device_get(f(q, k, v, bias))
         print(f"{name}: {(time.perf_counter() - t0) / 10 * 1000:.2f} ms", flush=True)
 
-    ok = fwd_diff < 0.1 and grad_diff < 1.0
+    # 4) abs-table path (botnet50's default position bias): the fused arm
+    # forms q·embᵀ in VMEM; the fair XLA arm must therefore INCLUDE the
+    # bias matmul + [B,N,L,L] materialization it absorbs
+    emb = jnp.asarray(rng.standard_normal((L, D)) * 0.1, jnp.float32)
+
+    def loss_abs_fused(q, k, v, emb):
+        return jnp.sum(fused_attention_abs(q, k, v, emb).astype(jnp.float32) ** 2)
+
+    def loss_abs_xla(q, k, v, emb):
+        bias_ = jnp.einsum("bnid,jd->bnij", q, emb.astype(q.dtype))
+        return jnp.sum(xla_attention(q, k, v, bias_).astype(jnp.float32) ** 2)
+
+    oaf = jax.device_get(jax.jit(loss_abs_fused)(q, k, v, emb))
+    oax = jax.device_get(jax.jit(loss_abs_xla)(q, k, v, emb))
+    abs_fwd_rel = float(abs(oaf - oax) / max(abs(oax), 1e-6))
+    print(f"abs fwd rel|diff| = {abs_fwd_rel:.5f}", flush=True)
+    gaf = jax.device_get(jax.jit(jax.grad(loss_abs_fused, argnums=(0, 1, 2, 3)))(q, k, v, emb))
+    gax = jax.device_get(jax.jit(jax.grad(loss_abs_xla, argnums=(0, 1, 2, 3)))(q, k, v, emb))
+    abs_grad_diff = max(
+        float(np.max(np.abs(a.astype(np.float32) - b.astype(np.float32))))
+        for a, b in zip(jax.tree.leaves(gaf), jax.tree.leaves(gax))
+    )
+    print(f"abs grad max|diff| = {abs_grad_diff:.4f}", flush=True)
+    abs_ms = {}
+    for name, f in [("abs-fused", jax.jit(jax.grad(loss_abs_fused))),
+                    ("abs-xla", jax.jit(jax.grad(loss_abs_xla)))]:
+        jax.device_get(f(q, k, v, emb))
+        t0 = time.perf_counter()
+        for _ in range(10):
+            jax.device_get(f(q, k, v, emb))
+        abs_ms[name] = (time.perf_counter() - t0) / 10 * 1000
+        print(f"{name} (fwd+bwd): {abs_ms[name]:.2f} ms", flush=True)
+    print(
+        f"abs speedup: {abs_ms['abs-xla'] / abs_ms['abs-fused']:.3f}x "
+        f"(>1 = fused wins)", flush=True,
+    )
+
+    ok = fwd_diff < 0.1 and grad_diff < 1.0 and abs_fwd_rel < 0.02 and abs_grad_diff < 1.0
     print("SOAK", "PASS — consider enabling DTPU_FUSED_ATTN=1" if ok else "FAIL", flush=True)
     sys.exit(0 if ok else 1)
 
