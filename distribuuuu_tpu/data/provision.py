@@ -19,15 +19,25 @@ import numpy as np
 from PIL import Image
 
 
-def digits_imagefolder(root: str, im_size: int = 64, val_per_class: int = 30) -> str:
+def digits_imagefolder(
+    root: str,
+    im_size: int = 64,
+    val_per_class: int = 30,
+    train_per_class: int | None = None,
+) -> str:
     """Write sklearn digits as ``root/{train,val}/<class>/*.jpg``; idempotent.
 
     Images are upscaled 8×8 → ``im_size`` with bilinear so the standard crop
     pipeline has room to work. The split is deterministic: the *last*
     ``val_per_class`` samples of each class go to val (sklearn's sample order
-    is fixed). Returns ``root``.
+    is fixed). ``train_per_class`` caps the train split (first N per class) —
+    the quick-tier oracle uses this; the val split is never subsampled, so
+    accuracy bands stay comparable. Returns ``root``.
     """
-    stamp = f"v1 im_size={im_size} val_per_class={val_per_class}\n"
+    stamp = (
+        f"v1 im_size={im_size} val_per_class={val_per_class}"
+        f" train_per_class={train_per_class}\n"
+    )
     marker = os.path.join(root, ".complete")
     if os.path.exists(marker):
         with open(marker) as f:
@@ -49,6 +59,8 @@ def digits_imagefolder(root: str, im_size: int = 64, val_per_class: int = 30) ->
         n_val = min(val_per_class, len(imgs) // 5)
         for i, img in enumerate(imgs):
             split = "val" if i >= len(imgs) - n_val else "train"
+            if split == "train" and train_per_class is not None and i >= train_per_class:
+                continue
             d = os.path.join(root, split, f"digit_{c}")
             os.makedirs(d, exist_ok=True)
             u8 = np.round(img / 16.0 * 255.0).astype(np.uint8)

@@ -1,0 +1,23 @@
+"""Repo-local persistent XLA compilation cache — ONE definition.
+
+Shared by tests/conftest.py and scripts/cpu_mesh_run.py so the test suite
+and the CLI wrapper always hit the same cache (identical programs compile
+once per machine, not once per process per run). Dev tooling only: the
+cache lands next to the repo checkout this package was imported from.
+Call before the first computation (jax may already be imported; only
+backend-touching work must come after).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_persistent_cache() -> str:
+    import jax
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    cache_dir = os.path.join(root, ".cache", "jax_compile")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    return cache_dir

@@ -29,6 +29,13 @@ def main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # share the repo-local persistent compile cache with the test suite: the
+    # CLI tests re-exec this wrapper per rank, and identical programs should
+    # compile once per machine, not once per process per run
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from distribuuuu_tpu.runtime.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
 
     if len(sys.argv) < 2:
         raise SystemExit("usage: cpu_mesh_run.py <script.py> [args...]")

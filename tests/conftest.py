@@ -22,6 +22,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache (repo-local, gitignored): heavy compiles
+# dedupe across processes (the multi-process CLI tests) and across runs.
+from distribuuuu_tpu.runtime.compile_cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+
 import pytest  # noqa: E402
 
 

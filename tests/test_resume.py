@@ -37,6 +37,10 @@ def _launch(out_dir, max_epoch):
             "TEST.BATCH_SIZE", "8",
             "TEST.CROP_SIZE", "32",
             "OPTIM.MAX_EPOCH", str(max_epoch),
+            # 256 synthetic samples/epoch (vs the 1000 default): epochs stay
+            # long enough (~10s+) that the kill reliably lands between
+            # ckpt_ep_002 committing and the run finishing, at 1/4 the cost
+            "TRAIN.DUMMY_EPOCH_SAMPLES", "256",
             "RNG_SEED", "3",
             "OUT_DIR", str(out_dir),
         ],

@@ -50,14 +50,18 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 ORACLE_MIN_ACC1 = 65.0  # observed 81.0; generous margin for platform variance
 
 
-def main(root: str = "/tmp/distribuuuu_tpu_digits", epochs: int = 5) -> float:
+def main(
+    root: str = "/tmp/distribuuuu_tpu_digits",
+    epochs: int = 5,
+    train_per_class: int | None = None,
+) -> float:
     import jax
 
     from distribuuuu_tpu import trainer
     from distribuuuu_tpu.config import cfg, reset_cfg
     from distribuuuu_tpu.data.provision import digits_imagefolder
 
-    digits_imagefolder(root)
+    digits_imagefolder(root, train_per_class=train_per_class)
     reset_cfg()
     cfg.MODEL.ARCH = "resnet18"
     cfg.MODEL.NUM_CLASSES = 10
