@@ -103,6 +103,14 @@ _C.CUDNN.BENCHMARK = True
 _C.CUDNN.DETERMINISTIC = False
 
 _C.OPTIM = CN()
+# TPU addition: 'sgd' (reference-exact default) or 'lamb' (layerwise-adaptive
+# large-batch training — the standard recipe beyond the linear-scaling
+# envelope the reference's SGD recipes stop at). BETA1/BETA2/EPS apply to
+# lamb only.
+_C.OPTIM.OPTIMIZER = "sgd"
+_C.OPTIM.BETA1 = 0.9
+_C.OPTIM.BETA2 = 0.999
+_C.OPTIM.EPS = 1e-6
 # Learning rate policy select from {'cos', 'steps'}
 _C.OPTIM.MAX_EPOCH = 100
 _C.OPTIM.LR_POLICY = "cos"

@@ -1,5 +1,6 @@
 """LR-schedule math parity and torch-semantics SGD update tests."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -75,3 +76,42 @@ def test_construct_optimizer_includes_weight_decay(fresh_cfg):
     updates, _ = tx.update({"w": jnp.array([0.0])}, state, params)
     # zero grad → update is pure decay: wd * p
     np.testing.assert_allclose(np.asarray(updates["w"]), [0.2], rtol=1e-6)
+
+
+def test_lamb_matches_optax_reference(fresh_cfg):
+    """cfg-built LAMB (LR-free chain + trainer's -lr apply) must trace the
+    canonical `optax.lamb(lr)` trajectory exactly — pins that splitting the
+    LR out of the chain preserves semantics (the trust ratio is
+    LR-independent)."""
+    import optax
+
+    fresh_cfg.OPTIM.OPTIMIZER = "lamb"
+    fresh_cfg.OPTIM.WEIGHT_DECAY = 0.01
+    lr = 0.1
+    tx = optim.construct_optimizer()
+    ref = optax.lamb(
+        lr, b1=0.9, b2=0.999, eps=1e-6, weight_decay=0.01,
+        # same decay mask the cfg branch builds: multi-dim params only
+        mask=lambda params: jax.tree.map(lambda p: p.ndim > 1, params),
+    )
+
+    # 2-D weight (decayed) + 1-D bias (excluded from decay by the mask)
+    params = {"w": jnp.array([[1.0, -2.0], [3.0, 0.7]]), "b": jnp.array([0.5])}
+    ref_params = jax.tree.map(lambda x: x, params)
+    state, ref_state = tx.init(params), ref.init(ref_params)
+    for step in range(4):
+        grads = jax.tree.map(
+            lambda p: 0.3 * p + 0.1 * (step + 1), params
+        )
+        updates, state = tx.update(grads, state, params)
+        params = optim.apply_updates_with_lr(params, updates, lr)
+        ref_updates, ref_state = ref.update(grads, ref_state, ref_params)
+        ref_params = optax.apply_updates(ref_params, ref_updates)
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(ref_params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_unknown_optimizer_is_loud(fresh_cfg):
+    fresh_cfg.OPTIM.OPTIMIZER = "adamw"
+    with pytest.raises(ValueError, match="Unknown OPTIM.OPTIMIZER 'adamw'"):
+        optim.construct_optimizer()
