@@ -8,6 +8,9 @@ building blocks that extend the same mesh design to other axes:
 - `ring_attention`: sequence/context parallelism — exact blockwise attention
   with k/v blocks rotating over the mesh's sequence axis via `ppermute`,
   online-softmax accumulation (memory O(L_local²) instead of O(L²)).
+- `ulysses_attention`: the all-to-all dual — scatter heads / gather sequence,
+  dense local attention, reshard back; two fused collectives instead of P-1
+  hops when heads divide the axis.
 """
 
 from distribuuuu_tpu.parallel.collectives import (
@@ -16,5 +19,12 @@ from distribuuuu_tpu.parallel.collectives import (
     scaled_all_reduce,
 )
 from distribuuuu_tpu.parallel.ring_attention import ring_attention
+from distribuuuu_tpu.parallel.ulysses import ulysses_attention
 
-__all__ = ["barrier", "pmean_tree", "scaled_all_reduce", "ring_attention"]
+__all__ = [
+    "barrier",
+    "pmean_tree",
+    "scaled_all_reduce",
+    "ring_attention",
+    "ulysses_attention",
+]

@@ -1,0 +1,79 @@
+"""All-to-all (Ulysses-style) sequence parallelism — the head-scatter dual
+of ring attention.
+
+Second of the two standard sequence-parallel layouts (SURVEY: "ring
+attention or all-to-all sequence/context parallelism"). Where
+`ring_attention` keeps heads replicated and rotates K/V blocks around the
+mesh axis (P-1 neighbor hops, memory O(L_local²)), the all-to-all layout
+re-shards once: scatter heads across the axis, gather the full sequence per
+head, run plain dense attention locally, and re-shard back. Two
+`lax.all_to_all` collectives total (they ride ICI as a single fused
+shuffle) instead of P-1 ppermute rounds — the better trade when
+``heads % axis_size == 0`` and L fits per-device memory; ring remains the
+choice for extreme L or few heads.
+
+Use inside `shard_map` exactly like ring_attention::
+
+    out = shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, axis_name="seq"),
+        mesh=mesh,
+        in_specs=(P(None, None, "seq", None),) * 3,
+        out_specs=P(None, None, "seq", None),
+    )(q, k, v)
+
+Causal masking uses global positions; the result equals single-device
+causal attention exactly (equivalence-tested against the global oracle and
+against ring_attention).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str = "seq",
+    causal: bool = False,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Exact attention with sequence sharded over ``axis_name`` via all-to-all.
+
+    Args: q/k/v ``[B, H, L_local, D]`` (local sequence shard, heads
+    replicated on this axis); requires ``H % axis_size == 0``. Returns the
+    local shard of the attention output in q's dtype.
+    """
+    p = jax.lax.axis_size(axis_name)
+    b, h, l_local, d = q.shape
+    if h % p != 0:
+        raise ValueError(
+            f"ulysses_attention needs heads ({h}) divisible by the "
+            f"'{axis_name}' axis size ({p}); use ring_attention otherwise"
+        )
+    if scale is None:
+        scale = d**-0.5
+
+    def scatter_heads(t):
+        # [B, H, L_local, D] -> [B, H/P, L_global, D]: split heads across the
+        # axis, gather every device's sequence shard (in axis-index order, so
+        # the concatenated sequence is in global token order)
+        return jax.lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+
+    s = jnp.einsum("bhqd,bhkd->bhqk", qg, kg, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        l_global = l_local * p
+        pos = jnp.arange(l_global)
+        s = jnp.where(pos[:, None] >= pos[None, :], s, -jnp.inf)
+    w = jax.nn.softmax(s, axis=-1)
+    og = jnp.einsum("bhqk,bhkd->bhqd", w, vg.astype(jnp.float32))
+
+    # inverse reshard: [B, H/P, L_global, D] -> [B, H, L_local, D]
+    out = jax.lax.all_to_all(og, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    return out.astype(q.dtype)

