@@ -11,6 +11,9 @@ building blocks that extend the same mesh design to other axes:
 - `ulysses_attention`: the all-to-all dual — scatter heads / gather sequence,
   dense local attention, reshard back; two fused collectives instead of P-1
   hops when heads divide the axis.
+- `tensor`: class-parallel classifier head (column-sharded kernel +
+  vocab-parallel cross-entropy) for label spaces too big to replicate
+  (ImageNet-21k-scale heads).
 """
 
 from distribuuuu_tpu.parallel.collectives import (
@@ -19,6 +22,7 @@ from distribuuuu_tpu.parallel.collectives import (
     scaled_all_reduce,
 )
 from distribuuuu_tpu.parallel.ring_attention import ring_attention
+from distribuuuu_tpu.parallel.tensor import column_parallel_logits, tp_cross_entropy
 from distribuuuu_tpu.parallel.ulysses import ulysses_attention
 
 __all__ = [
@@ -27,4 +31,6 @@ __all__ = [
     "scaled_all_reduce",
     "ring_attention",
     "ulysses_attention",
+    "column_parallel_logits",
+    "tp_cross_entropy",
 ]
