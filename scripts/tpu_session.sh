@@ -118,6 +118,12 @@ else
 fi
 
 if [ $soak_rc -eq 0 ]; then
+    # same-session A/B: baseline XLA attention first, then the fused path
+    # (which now applies the abs position bias in-kernel — see
+    # docs/BENCH_NOTES.md round-4 section for why this changes the verdict)
+    run_or_abort "botnet50 baseline bench (xla attention)" \
+        env DTPU_BENCH_ARCH=botnet50 DTPU_BENCH_BATCH=256 \
+        timeout 600 python bench.py
     run_or_abort "botnet50 fused-attention bench" \
         env DTPU_FUSED_ATTN=1 DTPU_BENCH_ARCH=botnet50 DTPU_BENCH_BATCH=256 \
         timeout 600 python bench.py
