@@ -19,13 +19,39 @@ _DATEFMT = "%Y-%m-%d %H:%M:%S"
 
 logger = logging.getLogger("distribuuuu_tpu")
 
+# The remote-log writer currently owned by setup_logger, if any. Held at
+# module level so a repeat setup_logger call closes (= commits) the previous
+# object instead of leaking one open writer per call, and so atexit holds a
+# single idempotent closer rather than one registration per call.
+_owned_stream = None
+
+
+def _close_owned_stream() -> None:
+    global _owned_stream
+    if _owned_stream is not None:
+        try:
+            if not getattr(_owned_stream, "closed", False):
+                _owned_stream.close()
+        finally:
+            _owned_stream = None
+
+
+atexit.register(_close_owned_stream)
+
 
 def setup_logger(out_dir: str | None = None, process_index: int = 0) -> logging.Logger:
     """Configure the package logger. Call once after distributed bring-up.
 
     Process 0: INFO to stderr + ``{out_dir}/{timestamp}.log`` (mirrors
     `utils.py:74-79`). Other processes: WARNING to stderr only.
+
+    Safe to call repeatedly: previously attached file/remote handlers are
+    closed (committing any remote log object) before being replaced.
     """
+    for h in logger.handlers:
+        if isinstance(h, logging.FileHandler):
+            h.close()
+    _close_owned_stream()
     logger.handlers.clear()
     logger.propagate = False
     fmt = logging.Formatter(_FMT, datefmt=_DATEFMT)
@@ -47,9 +73,9 @@ def setup_logger(out_dir: str | None = None, process_index: int = 0) -> logging.
                 # atexit (SIGKILL/OOM) loses the whole remote log object —
                 # stderr carries the live copy, and the pod runner's stderr
                 # capture is the durable record for crashed runs.
-                stream = pathio.open_write(logfile)
-                atexit.register(stream.close)
-                fh = logging.StreamHandler(stream)
+                global _owned_stream
+                _owned_stream = pathio.open_write(logfile)
+                fh = logging.StreamHandler(_owned_stream)
             else:
                 fh = logging.FileHandler(logfile)
             fh.setFormatter(fmt)

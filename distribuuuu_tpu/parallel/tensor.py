@@ -14,11 +14,17 @@ layout over a ``model`` mesh axis:
   `psum` (the "vocab-parallel" CE from Megatron-LM, here in three psum-class
   collectives on scalars/rows, never on the logits matrix).
 
-Use inside `shard_map` over a mesh with a ``model`` axis; the kernel shard
-spec is ``P(None, "model")``. Gradients flow through the collectives, so
-``jax.grad`` of `tp_cross_entropy` ∘ `column_parallel_logits` yields exactly
-the dense gradients, sharded (equivalence-tested in tests/test_tensor_parallel.py
-and certified by dryrun phase 5).
+Use inside `shard_map(..., check_vma=False)` over a mesh with a ``model``
+axis; the kernel shard spec is ``P(None, "model")``. Differentiate INSIDE
+the shard_map body (the framework convention — the trainer's loss_fn lives
+inside the body): there, ``jax.grad`` of `tp_cross_entropy` ∘
+`column_parallel_logits` yields exactly the dense gradients, sharded
+(equivalence-tested in tests/test_tensor_parallel.py and certified by
+dryrun phase 5). Taking ``jax.grad`` of the whole shard_map from OUTSIDE is
+NOT supported: shard_map's own transpose composes with these custom VJPs to
+mis-scale one operand family in either check_vma mode (parameter grads ×1/P
+with check_vma=False, activation grad ×P with check_vma=True — pinned as a
+canary in tests/test_tensor_parallel.py).
 """
 
 from __future__ import annotations
@@ -38,8 +44,9 @@ def _psum(x, axis_name):
     cotangent — over-counting by the axis size whenever the downstream use
     is replicated (it is here: the CE loss is replicated on the model
     axis). The correct rule for a replicated consumer is identity; pinned
-    by tests/test_tensor_parallel.py against the dense oracle both inside-
-    and outside-grad.
+    by tests/test_tensor_parallel.py against the dense oracle for grads
+    taken inside the shard_map body — the only supported differentiation
+    mode (see module docstring for why outside-grad mis-scales).
     """
     return jax.lax.psum(x, axis_name)
 
@@ -110,6 +117,15 @@ def tp_cross_entropy(
     labels ``[B]`` GLOBAL class ids. Returns per-example loss ``[B]``,
     replicated on the model axis. Label smoothing matches the replicated
     trainer's formula (uniform mix over all C classes).
+
+    Gradient contract: differentiate INSIDE the ``shard_map(...,
+    check_vma=False)`` body, and consume the returned loss UNIFORMLY across
+    the model axis (e.g. ``jnp.mean`` → scalar step loss, the trainer
+    pattern). The internal collectives use a custom VJP whose backward
+    assumes a model-axis-replicated cotangent; a consumer that weights the
+    per-example losses differently per model shard gets silently wrong
+    gradients, and ``jax.grad`` taken outside the shard_map mis-scales (see
+    module docstring).
     """
     p = jax.lax.axis_size(axis_name)
     c_local = local_logits.shape[-1]

@@ -74,6 +74,8 @@ def ulysses_attention(
     w = jax.nn.softmax(s, axis=-1)
     og = jnp.einsum("bhqk,bhkd->bhqd", w, vg.astype(jnp.float32))
 
-    # inverse reshard: [B, H/P, L_global, D] -> [B, H, L_local, D]
-    out = jax.lax.all_to_all(og, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    return out.astype(q.dtype)
+    # inverse reshard: [B, H/P, L_global, D] -> [B, H, L_local, D].
+    # Cast BEFORE the shuffle: elementwise cast commutes with the permutation,
+    # and shipping bf16 instead of f32 halves the collective bytes.
+    og = og.astype(q.dtype)
+    return jax.lax.all_to_all(og, axis_name, split_axis=2, concat_axis=1, tiled=True)

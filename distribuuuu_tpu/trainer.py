@@ -405,11 +405,15 @@ def validate(loader, mesh, eval_step, state, is_primary: bool, print_freq=None, 
     t_end = time.time()
     t_window = t_end
     window_n = 0
+    vals = None  # last boundary fetch; the final iteration is always a boundary
     for it, batch in enumerate(prefetch_to_device(loader, mesh, cfg.TRAIN.PREFETCH)):
         data_time.update(time.time() - t_end)
         totals = eval_step(state, batch, totals)
         window_n += 1
-        if it % print_freq == 0 or it == len(loader) - 1:
+        # Boundary fetches exist to feed the progress display, so only the
+        # displaying rank pays them; other ranks run fetch-free (their single
+        # sync point is the final-totals fetch after the loop).
+        if is_primary and (it % print_freq == 0 or it == len(loader) - 1):
             vals = jax.device_get(totals)  # sync point
             # charge the whole window's wall time across its steps so the
             # Time average is true step time, not just print-boundary steps
@@ -428,10 +432,10 @@ def validate(loader, mesh, eval_step, state, is_primary: bool, print_freq=None, 
             top1.val = top1.avg
             topk_m.avg = float(100.0 * vals[f"correct{topk}"] / n)
             topk_m.val = topk_m.avg
-            if is_primary:
-                progress.display(it)
+            progress.display(it)
         t_end = time.time()
-    vals = jax.device_get(totals)
+    if vals is None:  # non-primary rank, or empty loader
+        vals = jax.device_get(totals)
     n = max(vals["n"], 1.0)
     acc1 = float(100.0 * vals["correct1"] / n)
     acck = float(100.0 * vals[f"correct{topk}"] / n)

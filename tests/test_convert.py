@@ -52,6 +52,143 @@ def test_micro_model_numerics():
     np.testing.assert_allclose(np.asarray(got), expect, rtol=1e-4, atol=1e-5)
 
 
+def _make_torch_resnet(block_type, layers, groups=1, width_per_group=64, num_classes=16):
+    """Faithful torch-side ResNet with torchvision-exact module naming and
+    forward math (7x7/s2/p3 stem, 3x3/s2/p1 maxpool, stride on the 3x3 conv
+    in Bottleneck = v1.5, downsample = 1x1 conv + BN). Written fresh from the
+    published architecture so converted REAL torch weights (not synthetic
+    shape-dicts) can be checked for forward agreement — the drift classes a
+    shape-only test can't see: transposed grouped convs, BN eps, stride
+    placement, downsample routing."""
+    tnn = torch.nn
+
+    class BasicBlock(tnn.Module):
+        expansion = 1
+
+        def __init__(self, inplanes, planes, stride=1, downsample=None):
+            super().__init__()
+            self.conv1 = tnn.Conv2d(inplanes, planes, 3, stride, 1, bias=False)
+            self.bn1 = tnn.BatchNorm2d(planes)
+            self.relu = tnn.ReLU(inplace=True)
+            self.conv2 = tnn.Conv2d(planes, planes, 3, 1, 1, bias=False)
+            self.bn2 = tnn.BatchNorm2d(planes)
+            self.downsample = downsample
+
+        def forward(self, x):
+            idt = x if self.downsample is None else self.downsample(x)
+            out = self.relu(self.bn1(self.conv1(x)))
+            out = self.bn2(self.conv2(out))
+            return self.relu(out + idt)
+
+    class Bottleneck(tnn.Module):
+        expansion = 4
+
+        def __init__(self, inplanes, planes, stride=1, downsample=None):
+            super().__init__()
+            width = int(planes * (width_per_group / 64.0)) * groups
+            self.conv1 = tnn.Conv2d(inplanes, width, 1, bias=False)
+            self.bn1 = tnn.BatchNorm2d(width)
+            self.conv2 = tnn.Conv2d(width, width, 3, stride, 1, groups=groups, bias=False)
+            self.bn2 = tnn.BatchNorm2d(width)
+            self.conv3 = tnn.Conv2d(width, planes * 4, 1, bias=False)
+            self.bn3 = tnn.BatchNorm2d(planes * 4)
+            self.relu = tnn.ReLU(inplace=True)
+            self.downsample = downsample
+
+        def forward(self, x):
+            idt = x if self.downsample is None else self.downsample(x)
+            out = self.relu(self.bn1(self.conv1(x)))
+            out = self.relu(self.bn2(self.conv2(out)))
+            out = self.bn3(self.conv3(out))
+            return self.relu(out + idt)
+
+    Block = BasicBlock if block_type == "basic" else Bottleneck
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.inplanes = 64
+            self.conv1 = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
+            self.bn1 = tnn.BatchNorm2d(64)
+            self.relu = tnn.ReLU(inplace=True)
+            self.maxpool = tnn.MaxPool2d(3, 2, 1)
+            self.layer1 = self._make_layer(64, layers[0], 1)
+            self.layer2 = self._make_layer(128, layers[1], 2)
+            self.layer3 = self._make_layer(256, layers[2], 2)
+            self.layer4 = self._make_layer(512, layers[3], 2)
+            self.avgpool = tnn.AdaptiveAvgPool2d(1)
+            self.fc = tnn.Linear(512 * Block.expansion, num_classes)
+
+        def _make_layer(self, planes, n, stride):
+            downsample = None
+            if stride != 1 or self.inplanes != planes * Block.expansion:
+                downsample = tnn.Sequential(
+                    tnn.Conv2d(self.inplanes, planes * Block.expansion, 1, stride, bias=False),
+                    tnn.BatchNorm2d(planes * Block.expansion),
+                )
+            blocks = [Block(self.inplanes, planes, stride, downsample)]
+            self.inplanes = planes * Block.expansion
+            blocks += [Block(self.inplanes, planes) for _ in range(1, n)]
+            return tnn.Sequential(*blocks)
+
+        def forward(self, x):
+            x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+            x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+            x = self.avgpool(x).flatten(1)
+            return self.fc(x)
+
+    return Net()
+
+
+@pytest.mark.parametrize(
+    "arch,block_type,layers,kw",
+    [
+        ("resnet18", "basic", [2, 2, 2, 2], {}),
+        ("resnet50", "bottleneck", [3, 4, 6, 3], {}),
+        ("resnext50_32x4d", "bottleneck", [3, 4, 6, 3],
+         dict(groups=32, width_per_group=4)),
+    ],
+)
+def test_full_arch_forward_agreement_real_torch(arch, block_type, layers, kw):
+    """Converted REAL torch weights reproduce the torch forward on the whole
+    architecture (closest egress-free stand-in for a torchvision golden: same
+    state_dict schema, real values, full depth — only the trained numbers
+    differ). Randomized BN affine+running stats make eps/layout/transpose
+    errors show up as logit disagreement, not just shape mismatch."""
+    from distribuuuu_tpu.models import build_model
+
+    torch.manual_seed(0)
+    tnet = _make_torch_resnet(block_type, layers, num_classes=16, **kw)
+    with torch.no_grad():
+        for mod in tnet.modules():
+            if isinstance(mod, torch.nn.BatchNorm2d):
+                mod.running_mean.uniform_(-0.5, 0.5)
+                mod.running_var.uniform_(0.5, 2.0)
+                mod.weight.uniform_(0.5, 1.5)
+                mod.bias.uniform_(-0.2, 0.2)
+    tnet.eval()
+
+    converted = convert_state_dict(tnet.state_dict(), arch)
+    verify_against_model(converted, arch, num_classes=16)
+
+    # f32 compute isolates conversion correctness: agreement is then at
+    # float-epsilon level (measured ≤5e-7 for all three archs), so the band
+    # is tight enough that any layout/eps/transpose drift fails loudly. (The
+    # production bf16 default would add ~1e-3 of benign rounding noise.)
+    model = build_model(arch, num_classes=16, dtype=jnp.float32)
+    x = np.random.default_rng(0).standard_normal((2, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        expect = tnet(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    got = np.asarray(
+        model.apply(
+            {"params": converted["params"], "batch_stats": converted["batch_stats"]},
+            jnp.asarray(x),
+            train=False,
+        )
+    )
+    np.testing.assert_allclose(got, expect, atol=5e-6)
+
+
 def _synthetic_resnet18_state_dict():
     """torchvision resnet18 state_dict keys/shapes, built from naming rules."""
     sd = {}

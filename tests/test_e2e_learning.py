@@ -106,7 +106,16 @@ def test_real_data_oracle_digits(tmp_path, fresh_cfg):
     # the rung, so stale checkpoints from a previous run are never resumed.
     epochs = 5 if FULL else 3
     band = real_data_oracle.ORACLE_MIN_ACC1 if FULL else 60.0
-    best = real_data_oracle.main(root="/tmp/dtpu_digits_testcache", epochs=epochs)
+    # Per-user cache root: a world-shared /tmp path is owned by whichever
+    # user ran first (permission failure for the second) and two concurrent
+    # first-runs could race the .complete marker.
+    import getpass
+    import tempfile
+
+    cache = os.path.join(
+        tempfile.gettempdir(), f"dtpu_digits_testcache_{getpass.getuser()}"
+    )
+    best = real_data_oracle.main(root=cache, epochs=epochs)
     assert best >= band, (
         f"oracle band broken: best val Acc@1 {best:.1f} < {band} "
         f"(epochs={epochs})"

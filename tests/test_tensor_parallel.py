@@ -89,6 +89,44 @@ def test_tp_ce_gradients_match_dense():
         np.testing.assert_allclose(np.asarray(a), np.asarray(r), rtol=1e-4, atol=1e-6)
 
 
+def test_tp_outside_grad_is_unsupported_canary():
+    """jax.grad taken OUTSIDE the shard_map is documented-unsupported
+    (tensor.py module docstring): shard_map's replicated-output transpose
+    divides the cotangent by P, which the identity-backward psum never
+    restores for the SHARDED operands — so dW/db come back exactly 1/P
+    while dx stays correct. Pin that factor: if a JAX upgrade changes
+    shard_map transpose semantics, this canary fires and the docs (or the
+    VJPs) must be revisited."""
+    mesh = create_mesh({"model": 8})
+    x, w, b, labels = _inputs(seed=3)
+
+    def tp_loss(x, w, b, labels):
+        def body(x, w, b, labels):
+            z = column_parallel_logits(x, w, b)
+            return tp_cross_entropy(z, labels, axis_name="model")
+
+        per_ex = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(None, "model"), P("model"), P()),
+            out_specs=P(),
+            check_vma=False,
+        )(x, w, b, labels)
+        return jnp.mean(per_ex)
+
+    g_tp = jax.jit(jax.grad(tp_loss, argnums=(0, 1, 2)))(x, w, b, labels)
+    g_ref = jax.grad(
+        lambda *a: jnp.mean(_dense_ce(*a[:3], labels)), argnums=(0, 1, 2)
+    )(x, w, b)
+    np.testing.assert_allclose(  # activation grad: correct even outside
+        np.asarray(g_tp[0]), np.asarray(g_ref[0]), rtol=1e-4, atol=1e-6
+    )
+    for a, r in zip(g_tp[1:], g_ref[1:]):  # param grads: exactly 1/P
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(r) / 8.0, rtol=1e-4, atol=1e-6
+        )
+
+
 def test_tp_head_trains_on_2d_mesh():
     """One SGD step of trunk+TP-head on a {data, model} mesh == the dense
     single-program step: data-parallel batch sharding composes with the
