@@ -23,6 +23,30 @@ from distribuuuu_tpu import trainer
 # values recorded in each test's docstring.
 FULL = os.environ.get("DTPU_FULL_E2E") == "1"
 
+
+def _import_oracle():
+    """Import tutorial/real_data_oracle.py (not a package; path-insert)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tutorial"))
+    try:
+        import real_data_oracle
+    finally:
+        sys.path.pop(0)
+    return real_data_oracle
+
+
+def _oracle_cache_root():
+    """Per-user digits cache: a world-shared /tmp path is owned by whichever
+    user ran first (permission failure for the second) and two concurrent
+    first-runs could race the .complete marker."""
+    import getpass
+    import tempfile
+
+    return os.path.join(
+        tempfile.gettempdir(), f"dtpu_digits_testcache_{getpass.getuser()}"
+    )
+
 COLORS = {"red": (200, 30, 30), "green": (30, 200, 30), "blue": (30, 30, 200)}
 
 
@@ -89,14 +113,7 @@ def test_real_data_oracle_digits(tmp_path, fresh_cfg):
     can't: digits need real feature learning, and the band (≥65% val Acc@1,
     observed 81.0 single-device / seed 1) fails on any gross recipe break.
     """
-    import os
-    import sys
-
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tutorial"))
-    try:
-        import real_data_oracle
-    finally:
-        sys.path.pop(0)
+    real_data_oracle = _import_oracle()
 
     # quick tier calibrated 2026-07-30: 3 epochs -> 77.3, band >=60 (chance
     # 10); full tier: the rung's own 5 epochs -> 81.0, band >=65.
@@ -106,18 +123,34 @@ def test_real_data_oracle_digits(tmp_path, fresh_cfg):
     # the rung, so stale checkpoints from a previous run are never resumed.
     epochs = 5 if FULL else 3
     band = real_data_oracle.ORACLE_MIN_ACC1 if FULL else 60.0
-    # Per-user cache root: a world-shared /tmp path is owned by whichever
-    # user ran first (permission failure for the second) and two concurrent
-    # first-runs could race the .complete marker.
-    import getpass
-    import tempfile
-
-    cache = os.path.join(
-        tempfile.gettempdir(), f"dtpu_digits_testcache_{getpass.getuser()}"
-    )
-    best = real_data_oracle.main(root=cache, epochs=epochs)
+    best = real_data_oracle.main(root=_oracle_cache_root(), epochs=epochs)
     assert best >= band, (
         f"oracle band broken: best val Acc@1 {best:.1f} < {band} "
+        f"(epochs={epochs})"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.learning
+def test_real_data_oracle_digits_lamb(tmp_path, fresh_cfg):
+    """The LAMB large-batch arm of the digits convergence oracle (VERDICT r4
+    #6: multi-epoch warmup+cosine through the production trainer for BOTH
+    advertised optimizers). Same task/recipe as the SGD oracle above but
+    OPTIM.OPTIMIZER=lamb at an adam-style LR — catches LAMB-specific recipe
+    breaks (trust-ratio scaling, weight-decay mask, LR-free chain wiring)
+    that the single-step smoke test can't. Calibration 2026-07-30 (8-dev CPU
+    mesh, seed 1): 3 epochs -> 49.3/22.0/67.7 (best 67.7, band 55); 5 epochs
+    -> 49.3/16.7/25.7/82.0/84.3 (best 84.3, band 65; transcript in
+    tutorial/real_data_oracle.py)."""
+    real_data_oracle = _import_oracle()
+
+    epochs = 5 if FULL else 3
+    band = 65.0 if FULL else 55.0
+    best = real_data_oracle.main(
+        root=_oracle_cache_root(), epochs=epochs, optimizer="lamb"
+    )
+    assert best >= band, (
+        f"LAMB oracle band broken: best val Acc@1 {best:.1f} < {band} "
         f"(epochs={epochs})"
     )
 

@@ -258,6 +258,7 @@ def test_grad_accum_bn_stats_closeness(fresh_cfg, mesh):
         np.testing.assert_allclose(got, ref, atol=5e-3)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("accum", [8, 32])
 def test_grad_accum_bn_drift_at_lamb_scale(fresh_cfg, mesh, accum):
     """Quantifies the scan-average running-stat approximation against the

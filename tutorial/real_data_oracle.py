@@ -40,6 +40,17 @@ Val accuracy runs ahead of train accuracy here: train sees aggressive
 RandomResizedCrop(0.08-1.0) crops of a 64px digit, eval sees clean center
 crops. The shape of the curve — not the exact numbers — is the regression
 oracle, exactly like the reference's CIFAR transcript.
+
+The LAMB arm (``main(optimizer="lamb")``) runs the same recipe through the
+large-batch optimizer (adam-style LR 0.008, decoupled wd 0.01). Recorded
+2026-07-30, 8-device CPU mesh, seed 1, per-epoch val Acc@1:
+
+    49.333  16.667  25.667  82.000  84.333   -> best 84.3 (band: >= 65)
+
+LAMB's trust-ratio warmup is noisier in the first epochs (the dip is real
+and reproducible) but converges past the SGD arm by epoch 4 — the curve
+shape a LAMB recipe break would destroy (tests/test_e2e_learning.py
+::test_real_data_oracle_digits_lamb).
 """
 
 import os
@@ -54,6 +65,10 @@ def main(
     root: str = "/tmp/distribuuuu_tpu_digits",
     epochs: int = 5,
     train_per_class: int | None = None,
+    optimizer: str = "sgd",
+    warmup: int = 1,
+    auto_resume: bool = False,
+    out_name: str = "out",
 ) -> float:
     import jax
 
@@ -78,12 +93,23 @@ def main(
     cfg.TRAIN.BATCH_SIZE = max(1, 64 // max(1, jax.device_count()))
     cfg.TEST.BATCH_SIZE = cfg.TRAIN.BATCH_SIZE
     cfg.OPTIM.MAX_EPOCH = epochs
-    cfg.OPTIM.BASE_LR = 0.05  # linear scaling: 0.1 per 128 global batch
-    cfg.OPTIM.WARMUP_EPOCHS = 1
+    cfg.OPTIM.OPTIMIZER = optimizer
+    if optimizer == "lamb":
+        # LAMB's trust-ratio scaling wants an adam-style LR, not the SGD
+        # linear-scaling one (published LAMB recipes sit at 2e-3..1e-2 for
+        # batch 512-32k; this task's global batch is 64)
+        cfg.OPTIM.BASE_LR = 0.008
+        cfg.OPTIM.WEIGHT_DECAY = 0.01
+    else:
+        cfg.OPTIM.BASE_LR = 0.05  # linear scaling: 0.1 per 128 global batch
+    cfg.OPTIM.WARMUP_EPOCHS = warmup
     cfg.TRAIN.PRINT_FREQ = 10
     cfg.RNG_SEED = 1
-    cfg.OUT_DIR = os.path.join(root, "out")
-    cfg.TRAIN.AUTO_RESUME = False
+    cfg.OUT_DIR = os.path.join(root, out_name)
+    # default off: a stale checkpoint from a previous oracle run must never
+    # be resumed (the run would no-op and report the old best as fresh).
+    # Long recipe-scale runs opt in (and scope out_name by their params).
+    cfg.TRAIN.AUTO_RESUME = auto_resume
     cfg.freeze()
 
     _, best = trainer.train_model()
