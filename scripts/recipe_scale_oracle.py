@@ -69,5 +69,8 @@ def main(epochs: int = 100, warmup: int = 5) -> float:
 
 
 if __name__ == "__main__":
-    acc = main(epochs=int(sys.argv[1]) if len(sys.argv) > 1 else 100)
+    acc = main(
+        epochs=int(sys.argv[1]) if len(sys.argv) > 1 else 100,
+        warmup=int(sys.argv[2]) if len(sys.argv) > 2 else 5,
+    )
     sys.exit(0 if acc >= RECIPE_MIN_ACC1 else 1)

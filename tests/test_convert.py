@@ -147,6 +147,8 @@ def _make_torch_resnet(block_type, layers, groups=1, width_per_group=64, num_cla
         ("resnet50", "bottleneck", [3, 4, 6, 3], {}),
         ("resnext50_32x4d", "bottleneck", [3, 4, 6, 3],
          dict(groups=32, width_per_group=4)),
+        ("wide_resnet50_2", "bottleneck", [3, 4, 6, 3],
+         dict(width_per_group=128)),
     ],
 )
 def test_full_arch_forward_agreement_real_torch(arch, block_type, layers, kw):
@@ -176,6 +178,344 @@ def test_full_arch_forward_agreement_real_torch(arch, block_type, layers, kw):
     # is tight enough that any layout/eps/transpose drift fails loudly. (The
     # production bf16 default would add ~1e-3 of benign rounding noise.)
     model = build_model(arch, num_classes=16, dtype=jnp.float32)
+    x = np.random.default_rng(0).standard_normal((2, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        expect = tnet(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    got = np.asarray(
+        model.apply(
+            {"params": converted["params"], "batch_stats": converted["batch_stats"]},
+            jnp.asarray(x),
+            train=False,
+        )
+    )
+    np.testing.assert_allclose(got, expect, atol=5e-6)
+
+
+def _make_torch_densenet121(num_classes=16):
+    """Faithful torch-side DenseNet-BC-121 with torchvision-exact naming
+    (features.denseblock{b}.denselayer{l}.{norm1,conv1,norm2,conv2},
+    features.transition{b}.{norm,conv}) and forward math (BN→ReLU→1×1
+    bn_size·k → BN→ReLU→3×3 k, channel concat, transitions halve + avgpool).
+    Exercises the concat-ordering drift class the ResNet tests can't."""
+    tnn = torch.nn
+    growth, bn_size = 32, 4
+
+    class DenseLayer(tnn.Module):
+        def __init__(self, in_feats):
+            super().__init__()
+            self.norm1 = tnn.BatchNorm2d(in_feats)
+            self.conv1 = tnn.Conv2d(in_feats, bn_size * growth, 1, bias=False)
+            self.norm2 = tnn.BatchNorm2d(bn_size * growth)
+            self.conv2 = tnn.Conv2d(bn_size * growth, growth, 3, padding=1, bias=False)
+
+        def forward(self, x):
+            h = self.conv1(torch.relu(self.norm1(x)))
+            return self.conv2(torch.relu(self.norm2(h)))
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            f = tnn.Sequential()
+            f.add_module("conv0", tnn.Conv2d(3, 64, 7, 2, 3, bias=False))
+            f.add_module("norm0", tnn.BatchNorm2d(64))
+            f.add_module("relu0", tnn.ReLU(inplace=True))
+            f.add_module("pool0", tnn.MaxPool2d(3, 2, 1))
+            feats = 64
+            for b, n_layers in enumerate([6, 12, 24, 16], start=1):
+                block = tnn.Module()
+                for l in range(1, n_layers + 1):
+                    block.add_module(
+                        f"denselayer{l}", DenseLayer(feats + (l - 1) * growth)
+                    )
+                f.add_module(f"denseblock{b}", block)
+                feats += n_layers * growth
+                if b != 4:
+                    trans = tnn.Module()
+                    trans.add_module("norm", tnn.BatchNorm2d(feats))
+                    trans.add_module("conv", tnn.Conv2d(feats, feats // 2, 1, bias=False))
+                    f.add_module(f"transition{b}", trans)
+                    feats //= 2
+            f.add_module("norm5", tnn.BatchNorm2d(feats))
+            self.features = f
+            self.classifier = tnn.Linear(feats, num_classes)
+
+        def forward(self, x):
+            x = self.features.pool0(
+                self.features.relu0(self.features.norm0(self.features.conv0(x)))
+            )
+            for b in range(1, 5):
+                block = getattr(self.features, f"denseblock{b}")
+                for name, layer in block.named_children():
+                    x = torch.cat([x, layer(x)], dim=1)
+                if b != 4:
+                    trans = getattr(self.features, f"transition{b}")
+                    x = torch.nn.functional.avg_pool2d(
+                        trans.conv(torch.relu(trans.norm(x))), 2
+                    )
+            x = torch.relu(self.features.norm5(x))
+            x = torch.nn.functional.adaptive_avg_pool2d(x, 1).flatten(1)
+            return self.classifier(x)
+
+    return Net()
+
+
+def test_densenet121_forward_agreement_real_torch():
+    """Same real-weight forward-agreement contract as the ResNet matrix, for
+    the concat-growth family: converted real torch DenseNet-121 weights
+    reproduce the torch forward at float-epsilon in f32."""
+    from distribuuuu_tpu.models import build_model
+
+    torch.manual_seed(0)
+    tnet = _make_torch_densenet121(num_classes=16)
+    with torch.no_grad():
+        for mod in tnet.modules():
+            if isinstance(mod, torch.nn.BatchNorm2d):
+                mod.running_mean.uniform_(-0.5, 0.5)
+                mod.running_var.uniform_(0.5, 2.0)
+                mod.weight.uniform_(0.5, 1.5)
+                mod.bias.uniform_(-0.2, 0.2)
+    tnet.eval()
+
+    converted = convert_state_dict(tnet.state_dict(), "densenet121")
+    verify_against_model(converted, "densenet121", num_classes=16)
+
+    model = build_model("densenet121", num_classes=16, dtype=jnp.float32)
+    x = np.random.default_rng(0).standard_normal((2, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        expect = tnet(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    got = np.asarray(
+        model.apply(
+            {"params": converted["params"], "batch_stats": converted["batch_stats"]},
+            jnp.asarray(x),
+            train=False,
+        )
+    )
+    np.testing.assert_allclose(got, expect, atol=5e-6)
+
+
+def _make_torch_efficientnet_b0(num_classes=16):
+    """Faithful torch-side EfficientNet-B0 with timm-exact module naming
+    (conv_stem/bn1, blocks.{s}.{b}.{conv_pw,bn1,conv_dw,bn2,se,conv_pwl,bn3},
+    conv_head/bn2, classifier) and forward math (SiLU, SE sized from block
+    input channels, static symmetric padding — timm's non-tf variant, the
+    one the reference's `timm.create_model('efficientnet_b0')` returns).
+    Exercises the depthwise-kernel and SE-conv layouts the ResNet/DenseNet
+    tests can't."""
+    tnn = torch.nn
+
+    class SE(tnn.Module):
+        def __init__(self, ch, rd):
+            super().__init__()
+            self.conv_reduce = tnn.Conv2d(ch, rd, 1)
+            self.conv_expand = tnn.Conv2d(rd, ch, 1)
+
+        def forward(self, x):
+            s = x.mean((2, 3), keepdim=True)
+            s = self.conv_expand(torch.nn.functional.silu(self.conv_reduce(s)))
+            return x * torch.sigmoid(s)
+
+    class DSBlock(tnn.Module):  # timm DepthwiseSeparableConv (stage 0)
+        def __init__(self, in_ch, out_ch, k):
+            super().__init__()
+            self.conv_dw = tnn.Conv2d(in_ch, in_ch, k, 1, k // 2, groups=in_ch, bias=False)
+            self.bn1 = tnn.BatchNorm2d(in_ch)
+            self.se = SE(in_ch, max(1, in_ch // 4))
+            self.conv_pw = tnn.Conv2d(in_ch, out_ch, 1, bias=False)
+            self.bn2 = tnn.BatchNorm2d(out_ch)
+
+        def forward(self, x):
+            h = torch.nn.functional.silu(self.bn1(self.conv_dw(x)))
+            return self.bn2(self.conv_pw(self.se(h)))
+
+    class IRBlock(tnn.Module):  # timm InvertedResidual
+        def __init__(self, in_ch, out_ch, k, stride, expand=6):
+            super().__init__()
+            mid = in_ch * expand
+            self.conv_pw = tnn.Conv2d(in_ch, mid, 1, bias=False)
+            self.bn1 = tnn.BatchNorm2d(mid)
+            self.conv_dw = tnn.Conv2d(mid, mid, k, stride, k // 2, groups=mid, bias=False)
+            self.bn2 = tnn.BatchNorm2d(mid)
+            self.se = SE(mid, max(1, in_ch // 4))
+            self.conv_pwl = tnn.Conv2d(mid, out_ch, 1, bias=False)
+            self.bn3 = tnn.BatchNorm2d(out_ch)
+            self.residual = stride == 1 and in_ch == out_ch
+
+        def forward(self, x):
+            h = torch.nn.functional.silu(self.bn1(self.conv_pw(x)))
+            h = torch.nn.functional.silu(self.bn2(self.conv_dw(h)))
+            h = self.bn3(self.conv_pwl(self.se(h)))
+            return h + x if self.residual else h
+
+    stages_cfg = [  # (expand, k, stride, out, repeats) — B0
+        (1, 3, 1, 16, 1), (6, 3, 2, 24, 2), (6, 5, 2, 40, 2), (6, 3, 2, 80, 3),
+        (6, 5, 1, 112, 3), (6, 5, 2, 192, 4), (6, 3, 1, 320, 1),
+    ]
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv_stem = tnn.Conv2d(3, 32, 3, 2, 1, bias=False)
+            self.bn1 = tnn.BatchNorm2d(32)
+            blocks = []
+            in_ch = 32
+            for e, k, s, c, r in stages_cfg:
+                stage = []
+                for i in range(r):
+                    if e == 1:
+                        stage.append(DSBlock(in_ch, c, k))
+                    else:
+                        stage.append(IRBlock(in_ch, c, k, s if i == 0 else 1, e))
+                    in_ch = c
+                blocks.append(tnn.Sequential(*stage))
+            self.blocks = tnn.Sequential(*blocks)
+            self.conv_head = tnn.Conv2d(in_ch, 1280, 1, bias=False)
+            self.bn2 = tnn.BatchNorm2d(1280)
+            self.classifier = tnn.Linear(1280, num_classes)
+
+        def forward(self, x):
+            x = torch.nn.functional.silu(self.bn1(self.conv_stem(x)))
+            x = self.blocks(x)
+            x = torch.nn.functional.silu(self.bn2(self.conv_head(x)))
+            x = x.mean((2, 3))
+            return self.classifier(x)
+
+    return Net()
+
+
+def test_efficientnet_b0_forward_agreement_real_torch():
+    """Converted real torch weights in timm's efficientnet layout reproduce
+    the torch forward — validates the timm-naming converter numerically
+    (depthwise kernels, SE 1x1s with bias, expand/project routing), not just
+    structurally."""
+    from distribuuuu_tpu.models import build_model
+
+    torch.manual_seed(0)
+    tnet = _make_torch_efficientnet_b0(num_classes=16)
+    with torch.no_grad():
+        for mod in tnet.modules():
+            if isinstance(mod, torch.nn.BatchNorm2d):
+                mod.running_mean.uniform_(-0.5, 0.5)
+                mod.running_var.uniform_(0.5, 2.0)
+                mod.weight.uniform_(0.5, 1.5)
+                mod.bias.uniform_(-0.2, 0.2)
+    tnet.eval()
+
+    converted = convert_state_dict(tnet.state_dict(), "efficientnet_b0")
+    verify_against_model(converted, "efficientnet_b0", num_classes=16)
+
+    model = build_model("efficientnet_b0", num_classes=16, dtype=jnp.float32)
+    x = np.random.default_rng(0).standard_normal((2, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        expect = tnet(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    got = np.asarray(
+        model.apply(
+            {"params": converted["params"], "batch_stats": converted["batch_stats"]},
+            jnp.asarray(x),
+            train=False,
+        )
+    )
+    np.testing.assert_allclose(got, expect, atol=5e-6)
+
+
+def _make_torch_regnety_040(num_classes=16):
+    """Faithful torch-side RegNetY-4GF with timm-exact naming (stem.conv/bn,
+    s{k}.b{j}.conv{1,2,3}.{conv,bn}, se.fc1/fc2, downsample.{conv,bn},
+    head.fc). Stage widths/depths/groups come from the same quantized-linear
+    rule as the flax model (shared arch definition, not shared code).
+    Covers the regnet converter numerically: ReLU-SE, group-width convs,
+    the downsample shortcut."""
+    tnn = torch.nn
+    from distribuuuu_tpu.models.regnet import (
+        adjust_widths_groups,
+        generate_regnet_widths,
+    )
+
+    widths, depths = generate_regnet_widths(31.41, 96, 2.24, 22)
+    widths, groups = adjust_widths_groups(widths, 64)
+
+    class ConvBn(tnn.Module):
+        def __init__(self, i, o, k, s=1, g=1):
+            super().__init__()
+            self.conv = tnn.Conv2d(i, o, k, s, k // 2, groups=g, bias=False)
+            self.bn = tnn.BatchNorm2d(o)
+
+        def forward(self, x):
+            return self.bn(self.conv(x))
+
+    class SE(tnn.Module):
+        def __init__(self, ch, rd):
+            super().__init__()
+            self.fc1 = tnn.Conv2d(ch, rd, 1)
+            self.fc2 = tnn.Conv2d(rd, ch, 1)
+
+        def forward(self, x):
+            s = x.mean((2, 3), keepdim=True)
+            return x * torch.sigmoid(self.fc2(torch.relu(self.fc1(s))))
+
+    class Block(tnn.Module):
+        def __init__(self, w_in, w, g, stride):
+            super().__init__()
+            self.conv1 = ConvBn(w_in, w, 1)
+            self.conv2 = ConvBn(w, w, 3, stride, w // g)
+            self.se = SE(w, max(1, int(round(w_in * 0.25))))
+            self.conv3 = ConvBn(w, w, 1)
+            self.downsample = (
+                ConvBn(w_in, w, 1, stride) if (stride != 1 or w_in != w) else None
+            )
+
+        def forward(self, x):
+            h = torch.relu(self.conv1(x))
+            h = torch.relu(self.conv2(h))
+            h = self.conv3(self.se(h))
+            sc = x if self.downsample is None else self.downsample(x)
+            return torch.relu(h + sc)
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.stem = ConvBn(3, 32, 3, 2)
+            w_in = 32
+            for si, (w, d, g) in enumerate(zip(widths, depths, groups), start=1):
+                stage = tnn.Module()
+                for j in range(1, d + 1):
+                    stage.add_module(f"b{j}", Block(w_in, w, g, 2 if j == 1 else 1))
+                    w_in = w
+                setattr(self, f"s{si}", stage)
+            self.head = tnn.Module()
+            self.head.fc = tnn.Linear(w_in, num_classes)
+            self._n_stages = len(widths)
+
+        def forward(self, x):
+            x = torch.relu(self.stem(x))
+            for si in range(1, self._n_stages + 1):
+                for blk in getattr(self, f"s{si}").children():
+                    x = blk(x)
+            x = x.mean((2, 3))
+            return self.head.fc(x)
+
+    return Net()
+
+
+def test_regnety_040_forward_agreement_real_torch():
+    """Converted real torch weights in timm's regnet layout reproduce the
+    torch forward at float-epsilon in f32."""
+    from distribuuuu_tpu.models import build_model
+
+    torch.manual_seed(0)
+    tnet = _make_torch_regnety_040(num_classes=16)
+    with torch.no_grad():
+        for mod in tnet.modules():
+            if isinstance(mod, torch.nn.BatchNorm2d):
+                mod.running_mean.uniform_(-0.5, 0.5)
+                mod.running_var.uniform_(0.5, 2.0)
+                mod.weight.uniform_(0.5, 1.5)
+                mod.bias.uniform_(-0.2, 0.2)
+    tnet.eval()
+
+    converted = convert_state_dict(tnet.state_dict(), "regnety_040")
+    verify_against_model(converted, "regnety_040", num_classes=16)
+
+    model = build_model("regnety_040", num_classes=16, dtype=jnp.float32)
     x = np.random.default_rng(0).standard_normal((2, 64, 64, 3)).astype(np.float32)
     with torch.no_grad():
         expect = tnet(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
