@@ -13,10 +13,28 @@ step — and give a reproducible accuracy oracle (tutorial rung 8,
 
 from __future__ import annotations
 
+import contextlib
+import fcntl
 import os
 
 import numpy as np
 from PIL import Image
+
+
+@contextlib.contextmanager
+def _provision_lock(root: str):
+    """Exclusive flock for dataset materialization: two processes provisioning
+    the same ``root`` concurrently (e.g. test tiers launched in parallel on a
+    cold cache) would interleave in-place JPEG writes, and the first to finish
+    could start reading files the other is still rewriting."""
+    os.makedirs(os.path.dirname(root) or ".", exist_ok=True)
+    lock_path = root.rstrip("/") + ".lock"
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
 
 
 def digits_imagefolder(
@@ -39,14 +57,30 @@ def digits_imagefolder(
         f" train_per_class={train_per_class}\n"
     )
     marker = os.path.join(root, ".complete")
-    if os.path.exists(marker):
-        with open(marker) as f:
-            if f.read() == stamp:
-                return root
-        # parameters changed: rebuild from scratch rather than serve stale data
-        import shutil
 
-        shutil.rmtree(root)
+    def _is_complete() -> bool:
+        if not os.path.exists(marker):
+            return False
+        with open(marker) as f:
+            return f.read() == stamp
+
+    if _is_complete():  # fast path: no lock once materialized
+        return root
+    with _provision_lock(root):
+        if _is_complete():  # another process provisioned while we waited
+            return root
+        if os.path.exists(root):
+            # stale-marker (parameters changed) or partial (crashed
+            # mid-write, no marker) tree: rebuild from scratch rather than
+            # serve stale data
+            import shutil
+
+            shutil.rmtree(root)
+        _materialize(root, marker, stamp, im_size, val_per_class, train_per_class)
+    return root
+
+
+def _materialize(root, marker, stamp, im_size, val_per_class, train_per_class):
     from sklearn.datasets import load_digits
 
     digits = load_digits()

@@ -1,0 +1,88 @@
+#!/bin/bash
+# Remainder ladder for short healthy windows (round 5).
+#
+# The 2026-07-31 03:44 window captured the headline/A-B/eval/whole-loop/
+# real-data rungs, then the chip wedged (~19 min of health). This script
+# runs ONLY what that session did not capture, most-valuable-first, in
+# small per-stage invocations so partial results land incrementally in the
+# log. A failed rung triggers a probe: wedge -> stop (a new wedge costs one
+# rung timeout, 960 s max, plus one probe); healthy-but-failed (e.g. an OOM
+# batch arm) -> keep going. The rungs to land:
+#
+#   1. per-stage conv roofline, one invocation per stage (VERDICT r4 #2)
+#   2. fused-attention soak + botnet50 XLA-vs-fused A/B (VERDICT r4 #5)
+#   3. larger-batch bench arms (batch 768/1024, MFU lever candidates)
+#   4. XLA flag sweep (self-guarded per arm)
+#   5. perf sweep --quick
+#
+# Usage: bash scripts/tpu_session_remainder.sh   (run when a probe passes;
+# pair with wait_for_chip.sh — see docs/TROUBLESHOOTING.md runbook #5)
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+TS=$(date -u +%Y%m%d_%H%M%S)
+LOG="docs/tpu_session_${TS}.log"
+
+say() { echo "=== $* ===" | tee -a "$LOG"; }
+
+probe_or_die() {
+    if ! timeout -k 10 240 python scripts/probe_chip.py >> "$LOG" 2>&1; then
+        say "CHIP WEDGED at $(date -u '+%H:%M:%S') — stopping (partial results above stand)"
+        exit 1
+    fi
+}
+
+# A failed rung is only fatal if the chip is actually wedged: probe, and
+# stop on a dead device (everything already logged stands) but continue past
+# a healthy-chip failure (an OOM batch arm is data, not a wedge).
+rung() {
+    local name="$1"; shift
+    say "$name"
+    if ! "$@" 2>>"$LOG" | tee -a "$LOG"; then
+        say "$name FAILED — probing to distinguish wedge from rung error"
+        probe_or_die
+        say "$name failed but chip is healthy — continuing with next rung"
+    fi
+}
+
+say "remainder ladder start $(date -u '+%Y-%m-%d %H:%M:%S')"
+probe_or_die
+
+# 1. Roofline, incrementally: ceiling + whole-step first (the attribution
+# anchors), then stages in descending FLOPs share. Per-stage watchdog kept
+# tight so one stage can't eat the window.
+for st in mm step s2 s3 s1 s4 strided stem; do
+    rung "roofline --stage $st" \
+        env DTPU_ROOFLINE_WATCHDOG=900 timeout -k 10 960 python scripts/stage_roofline.py --stage "$st"
+done
+
+# 2. Fused attention: soak, then same-session A/B (VERDICT r4 #5).
+say "fused-attention soak"
+timeout -k 10 900 python scripts/soak_fused_attn.py >> "$LOG" 2>&1
+soak_rc=$?
+if [ $soak_rc -eq 124 ]; then
+    say "soak TIMED OUT — chip likely wedged, stopping"
+    exit 1
+elif [ $soak_rc -ne 0 ]; then
+    say "soak FAILED numerically (rc=$soak_rc) — fused attn stays off; continuing"
+else
+    say "soak OK"
+    rung "botnet50 baseline bench (xla attention)" \
+        env DTPU_BENCH_ARCH=botnet50 DTPU_BENCH_BATCH=256 timeout -k 10 600 python bench.py
+    rung "botnet50 fused-attention bench" \
+        env DTPU_FUSED_ATTN=1 DTPU_BENCH_ARCH=botnet50 DTPU_BENCH_BATCH=256 timeout -k 10 600 python bench.py
+fi
+
+# 3. Larger per-chip batch arms — cheapest possible MFU lever to test.
+rung "bench.py batch 768" env DTPU_BENCH_BATCH=768 timeout -k 10 600 python bench.py
+rung "bench.py batch 1024" env DTPU_BENCH_BATCH=1024 timeout -k 10 600 python bench.py
+
+# 4. XLA flag sweep (bench.py probe guards every arm).
+rung "XLA flag sweep" timeout -k 10 3000 python scripts/xla_flag_sweep.py
+
+# 5. Perf sweep, quick form.
+rung "perf sweep (quick)" timeout -k 10 1200 python scripts/perf_sweep.py --quick
+
+say "end-of-session probe"
+probe_or_die
+say "device healthy at session end; done — full log at $LOG"

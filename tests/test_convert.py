@@ -140,6 +140,44 @@ def _make_torch_resnet(block_type, layers, groups=1, width_per_group=64, num_cla
     return Net()
 
 
+def _assert_forward_agreement(tnet, arch, num_classes=16):
+    """Shared harness for every real-torch forward-agreement test: randomize
+    BN affine+running stats (so eps/layout/transpose errors show up as logit
+    disagreement, not just shape mismatch), convert, verify structurally,
+    then compare torch vs flax logits.
+
+    f32 compute isolates conversion correctness: agreement is then at
+    float-epsilon level (measured ≤5e-7 across all families), so the band is
+    tight enough that any layout/eps/transpose drift fails loudly. (The
+    production bf16 default would add ~1e-3 of benign rounding noise.)"""
+    from distribuuuu_tpu.models import build_model
+
+    with torch.no_grad():
+        for mod in tnet.modules():
+            if isinstance(mod, torch.nn.BatchNorm2d):
+                mod.running_mean.uniform_(-0.5, 0.5)
+                mod.running_var.uniform_(0.5, 2.0)
+                mod.weight.uniform_(0.5, 1.5)
+                mod.bias.uniform_(-0.2, 0.2)
+    tnet.eval()
+
+    converted = convert_state_dict(tnet.state_dict(), arch)
+    verify_against_model(converted, arch, num_classes=num_classes)
+
+    model = build_model(arch, num_classes=num_classes, dtype=jnp.float32)
+    x = np.random.default_rng(0).standard_normal((2, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        expect = tnet(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    got = np.asarray(
+        model.apply(
+            {"params": converted["params"], "batch_stats": converted["batch_stats"]},
+            jnp.asarray(x),
+            train=False,
+        )
+    )
+    np.testing.assert_allclose(got, expect, atol=5e-6)
+
+
 @pytest.mark.parametrize(
     "arch,block_type,layers,kw",
     [
@@ -155,40 +193,9 @@ def test_full_arch_forward_agreement_real_torch(arch, block_type, layers, kw):
     """Converted REAL torch weights reproduce the torch forward on the whole
     architecture (closest egress-free stand-in for a torchvision golden: same
     state_dict schema, real values, full depth — only the trained numbers
-    differ). Randomized BN affine+running stats make eps/layout/transpose
-    errors show up as logit disagreement, not just shape mismatch."""
-    from distribuuuu_tpu.models import build_model
-
+    differ)."""
     torch.manual_seed(0)
-    tnet = _make_torch_resnet(block_type, layers, num_classes=16, **kw)
-    with torch.no_grad():
-        for mod in tnet.modules():
-            if isinstance(mod, torch.nn.BatchNorm2d):
-                mod.running_mean.uniform_(-0.5, 0.5)
-                mod.running_var.uniform_(0.5, 2.0)
-                mod.weight.uniform_(0.5, 1.5)
-                mod.bias.uniform_(-0.2, 0.2)
-    tnet.eval()
-
-    converted = convert_state_dict(tnet.state_dict(), arch)
-    verify_against_model(converted, arch, num_classes=16)
-
-    # f32 compute isolates conversion correctness: agreement is then at
-    # float-epsilon level (measured ≤5e-7 for all three archs), so the band
-    # is tight enough that any layout/eps/transpose drift fails loudly. (The
-    # production bf16 default would add ~1e-3 of benign rounding noise.)
-    model = build_model(arch, num_classes=16, dtype=jnp.float32)
-    x = np.random.default_rng(0).standard_normal((2, 64, 64, 3)).astype(np.float32)
-    with torch.no_grad():
-        expect = tnet(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
-    got = np.asarray(
-        model.apply(
-            {"params": converted["params"], "batch_stats": converted["batch_stats"]},
-            jnp.asarray(x),
-            train=False,
-        )
-    )
-    np.testing.assert_allclose(got, expect, atol=5e-6)
+    _assert_forward_agreement(_make_torch_resnet(block_type, layers, num_classes=16, **kw), arch)
 
 
 def _make_torch_densenet121(num_classes=16):
@@ -263,34 +270,8 @@ def test_densenet121_forward_agreement_real_torch():
     """Same real-weight forward-agreement contract as the ResNet matrix, for
     the concat-growth family: converted real torch DenseNet-121 weights
     reproduce the torch forward at float-epsilon in f32."""
-    from distribuuuu_tpu.models import build_model
-
     torch.manual_seed(0)
-    tnet = _make_torch_densenet121(num_classes=16)
-    with torch.no_grad():
-        for mod in tnet.modules():
-            if isinstance(mod, torch.nn.BatchNorm2d):
-                mod.running_mean.uniform_(-0.5, 0.5)
-                mod.running_var.uniform_(0.5, 2.0)
-                mod.weight.uniform_(0.5, 1.5)
-                mod.bias.uniform_(-0.2, 0.2)
-    tnet.eval()
-
-    converted = convert_state_dict(tnet.state_dict(), "densenet121")
-    verify_against_model(converted, "densenet121", num_classes=16)
-
-    model = build_model("densenet121", num_classes=16, dtype=jnp.float32)
-    x = np.random.default_rng(0).standard_normal((2, 64, 64, 3)).astype(np.float32)
-    with torch.no_grad():
-        expect = tnet(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
-    got = np.asarray(
-        model.apply(
-            {"params": converted["params"], "batch_stats": converted["batch_stats"]},
-            jnp.asarray(x),
-            train=False,
-        )
-    )
-    np.testing.assert_allclose(got, expect, atol=5e-6)
+    _assert_forward_agreement(_make_torch_densenet121(num_classes=16), "densenet121")
 
 
 def _make_torch_efficientnet_b0(num_classes=16):
@@ -387,34 +368,8 @@ def test_efficientnet_b0_forward_agreement_real_torch():
     the torch forward — validates the timm-naming converter numerically
     (depthwise kernels, SE 1x1s with bias, expand/project routing), not just
     structurally."""
-    from distribuuuu_tpu.models import build_model
-
     torch.manual_seed(0)
-    tnet = _make_torch_efficientnet_b0(num_classes=16)
-    with torch.no_grad():
-        for mod in tnet.modules():
-            if isinstance(mod, torch.nn.BatchNorm2d):
-                mod.running_mean.uniform_(-0.5, 0.5)
-                mod.running_var.uniform_(0.5, 2.0)
-                mod.weight.uniform_(0.5, 1.5)
-                mod.bias.uniform_(-0.2, 0.2)
-    tnet.eval()
-
-    converted = convert_state_dict(tnet.state_dict(), "efficientnet_b0")
-    verify_against_model(converted, "efficientnet_b0", num_classes=16)
-
-    model = build_model("efficientnet_b0", num_classes=16, dtype=jnp.float32)
-    x = np.random.default_rng(0).standard_normal((2, 64, 64, 3)).astype(np.float32)
-    with torch.no_grad():
-        expect = tnet(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
-    got = np.asarray(
-        model.apply(
-            {"params": converted["params"], "batch_stats": converted["batch_stats"]},
-            jnp.asarray(x),
-            train=False,
-        )
-    )
-    np.testing.assert_allclose(got, expect, atol=5e-6)
+    _assert_forward_agreement(_make_torch_efficientnet_b0(num_classes=16), "efficientnet_b0")
 
 
 def _make_torch_regnety_040(num_classes=16):
@@ -499,34 +454,8 @@ def _make_torch_regnety_040(num_classes=16):
 def test_regnety_040_forward_agreement_real_torch():
     """Converted real torch weights in timm's regnet layout reproduce the
     torch forward at float-epsilon in f32."""
-    from distribuuuu_tpu.models import build_model
-
     torch.manual_seed(0)
-    tnet = _make_torch_regnety_040(num_classes=16)
-    with torch.no_grad():
-        for mod in tnet.modules():
-            if isinstance(mod, torch.nn.BatchNorm2d):
-                mod.running_mean.uniform_(-0.5, 0.5)
-                mod.running_var.uniform_(0.5, 2.0)
-                mod.weight.uniform_(0.5, 1.5)
-                mod.bias.uniform_(-0.2, 0.2)
-    tnet.eval()
-
-    converted = convert_state_dict(tnet.state_dict(), "regnety_040")
-    verify_against_model(converted, "regnety_040", num_classes=16)
-
-    model = build_model("regnety_040", num_classes=16, dtype=jnp.float32)
-    x = np.random.default_rng(0).standard_normal((2, 64, 64, 3)).astype(np.float32)
-    with torch.no_grad():
-        expect = tnet(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
-    got = np.asarray(
-        model.apply(
-            {"params": converted["params"], "batch_stats": converted["batch_stats"]},
-            jnp.asarray(x),
-            train=False,
-        )
-    )
-    np.testing.assert_allclose(got, expect, atol=5e-6)
+    _assert_forward_agreement(_make_torch_regnety_040(num_classes=16), "regnety_040")
 
 
 def _synthetic_resnet18_state_dict():
