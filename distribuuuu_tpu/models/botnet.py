@@ -139,9 +139,13 @@ class MHSA(nn.Module):
         )
         fuse = self.fuse
         if fuse is None:
-            # opt-in while the kernel soaks: auto-enables on TPU only when
-            # DTPU_FUSED_ATTN=1 (numerics are verified; flipping the default
-            # waits on on-chip soak time)
+            # Opt-in only: the 2026-07-31 on-chip A/B measured the Pallas
+            # kernel LOSING to XLA's fused attention at BoTNet shapes —
+            # abs-fused 0.77x in the soak, botnet50 end-to-end 1545 vs
+            # 1834 img/s (docs/BENCH_NOTES.md round-5 session #2). XLA's
+            # emitter handles L~196 tiles better than the hand kernel;
+            # DTPU_FUSED_ATTN=1 remains available for re-evaluation on
+            # other topologies/shapes.
             import os
 
             fuse = (
