@@ -351,11 +351,83 @@ def _convert_regnet(sd: Dict[str, np.ndarray]) -> dict:
     return {"params": params, "batch_stats": batch_stats}
 
 
+def _convert_vit(sd: Dict[str, np.ndarray]) -> dict:
+    """ViT (beyond-ref family, `models/vit.py`). Handles both public schemas:
+
+    - torchvision ``vit_b_16``: ``conv_proj``, ``class_token``,
+      ``encoder.pos_embedding``,
+      ``encoder.layers.encoder_layer_{i}.{ln_1,self_attention,ln_2,mlp.linear_{1,2}}``
+      (older releases name the MLP ``mlp.{0,3}``), ``encoder.ln``,
+      ``heads.head``;
+    - timm ``vit_*_patch16_224``: ``patch_embed.proj``, ``cls_token``,
+      ``pos_embed``, ``blocks.{i}.{norm1,attn.{qkv,proj},norm2,mlp.fc{1,2}}``,
+      ``norm``, ``head``.
+
+    torch MHA packs in_proj as [3D, D] q/k/v-major then head-major — exactly
+    the packing ``MultiHeadSelfAttention``'s reshape (b, l, 3, H, hd) reads,
+    so the kernel is a plain transpose. timm's separate ``attn.qkv`` Linear
+    uses the same packing.
+    """
+    params: dict = {}
+
+    def ln(path, name, value):
+        _set(params, path + ["scale" if name == "weight" else "bias"], value)
+
+    def linear(path, name, value):
+        _set(params, path + ["kernel" if name == "weight" else "bias"],
+             value.T if name == "weight" else value)
+
+    for key, value in sd.items():
+        parts = key.split(".")
+        name = parts[-1]
+        top = parts[0]
+        if top == "conv_proj" or (top == "patch_embed" and parts[1] == "proj"):
+            if name == "weight":
+                _set(params, ["patch_embed", "kernel"], _conv_kernel(value))
+            else:
+                _set(params, ["patch_embed", "bias"], value)
+        elif key in ("class_token", "cls_token"):
+            _set(params, ["cls_token"], value)
+        elif key in ("encoder.pos_embedding", "pos_embed"):
+            _set(params, ["pos_embed"], value)
+        elif key.startswith("encoder.ln.") or (top == "norm" and len(parts) == 2):
+            ln(["ln_f"], name, value)
+        elif key.startswith("heads.head.") or (top == "head" and len(parts) == 2):
+            linear(["head"], name, value)
+        elif top == "encoder" and parts[1] == "layers":
+            i = int(parts[2].removeprefix("encoder_layer_"))
+            block, mod = [f"block{i}"], parts[3]
+            if mod in ("ln_1", "ln_2"):
+                ln(block + ["ln" + mod[-1]], name, value)
+            elif mod == "self_attention":
+                if name in ("in_proj_weight", "in_proj_bias"):
+                    linear(block + ["attn", "qkv"],
+                           "weight" if name.endswith("weight") else "bias", value)
+                else:  # out_proj.{weight,bias}
+                    linear(block + ["attn", "proj"], name, value)
+            elif mod == "mlp":
+                fc = {"linear_1": "fc1", "linear_2": "fc2", "0": "fc1", "3": "fc2"}[parts[4]]
+                linear(block + [fc], name, value)
+        elif top == "blocks":
+            i = int(parts[1])
+            block, mod = [f"block{i}"], parts[2]
+            if mod in ("norm1", "norm2"):
+                ln(block + ["ln" + mod[-1]], name, value)
+            elif mod == "attn":
+                linear(block + ["attn", {"qkv": "qkv", "proj": "proj"}[parts[3]]],
+                       name, value)
+            elif mod == "mlp":
+                linear(block + [parts[3]], name, value)
+    return {"params": params, "batch_stats": {}}
+
+
 def convert_state_dict(state_dict: Mapping[str, Any], arch: str) -> dict:
     """torch state_dict → ``{"params": ..., "batch_stats": ...}`` numpy trees."""
     sd = _unwrap(state_dict)
     if arch == "botnet50":
         return _convert_botnet50(sd)
+    if arch.startswith("vit"):
+        return _convert_vit(sd)
     if arch.startswith("efficientnet"):
         return _convert_efficientnet(sd)
     if arch.startswith("regnet"):

@@ -4,6 +4,9 @@ checkpoint) to an Orbax weights directory loadable via ``MODEL.WEIGHTS``.
 Usage:
     python scripts/convert_torch.py --arch resnet50 --src resnet50.pth --dst ./converted_resnet50
     python test_net.py --cfg config/resnet50.yaml MODEL.WEIGHTS ./converted_resnet50
+
+To PROVE forward parity of a conversion against the live torch model (one
+command on any networked box), use scripts/validate_pretrained.py.
 """
 
 import argparse

@@ -1,9 +1,10 @@
 """On-chip soak for the fused attention kernel (run when a TPU is healthy).
 
 Validates ops/attention.py against the XLA path on real hardware at BoTNet
-shapes (fwd values, gradients, and speed), then prints the verdict. If all
-checks pass, flip the default by setting DTPU_FUSED_ATTN=1 in the launch
-environment (or change the auto-gate in models/botnet.py).
+shapes (fwd values, gradients, and speed), then prints the verdict. PASS
+means the numerics hold; the speedup line is the flip/keep signal for
+DTPU_FUSED_ATTN. 2026-07-31 measured verdict: 0.771x — XLA wins at these
+shapes, default stays off (docs/BENCH_NOTES.md round-5 session #2).
 
     python scripts/soak_fused_attn.py
 """
@@ -99,7 +100,13 @@ def main():
     )
 
     ok = fwd_diff < 0.1 and grad_diff < 1.0 and abs_fwd_rel < 0.02 and abs_grad_diff < 1.0
-    print("SOAK", "PASS — consider enabling DTPU_FUSED_ATTN=1" if ok else "FAIL", flush=True)
+    print(
+        "SOAK",
+        "PASS (numerics hold; see the speedup line for the flip/keep verdict)"
+        if ok
+        else "FAIL",
+        flush=True,
+    )
     sys.exit(0 if ok else 1)
 
 

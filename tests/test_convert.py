@@ -569,3 +569,165 @@ def test_densenet121_legacy_keys_full_conversion():
     sd = _synthetic_densenet121_state_dict(legacy_block1=True)
     converted = convert_state_dict(sd, "densenet121")
     verify_against_model(converted, "densenet121")
+
+
+def test_validate_pretrained_script_contract():
+    """The real-weight validator (scripts/validate_pretrained.py) stays in
+    sync with the converter: every arch in its URL table must build and
+    convert (synthetic weights stand in for the download this box can't
+    make). Guards the script the first networked machine will run."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "validate_pretrained",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "validate_pretrained.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    from distribuuuu_tpu.models import build_model
+
+    for arch in mod.TORCHVISION_URLS:
+        build_model(arch, num_classes=1000)  # raises on unknown arch
+        assert mod.TORCHVISION_URLS[arch].startswith(
+            "https://download.pytorch.org/models/"
+        )
+    x = mod.fixed_inputs(n=2, size=32)
+    assert x.shape == (2, 32, 32, 3) and x.dtype.name == "float32"
+
+
+def _make_torch_vit(patch=16, dim=64, depth=2, heads=4, mlp=128, num_classes=8):
+    """Torch-side mini-ViT with torchvision-exact naming (`vit_b_16` schema:
+    conv_proj / class_token / encoder.pos_embedding /
+    encoder.layers.encoder_layer_{i}.{ln_1,self_attention,ln_2,mlp.linear_{1,2}}
+    / encoder.ln / heads.head) and forward math (pre-LN blocks, erf-GELU).
+    Real MHA weights exercise the qkv packing the converter transposes."""
+    tnn = torch.nn
+
+    class Layer(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.ln_1 = tnn.LayerNorm(dim, eps=1e-6)
+            self.self_attention = tnn.MultiheadAttention(dim, heads, batch_first=True)
+            self.ln_2 = tnn.LayerNorm(dim, eps=1e-6)
+            self.mlp = tnn.Module()
+            self.mlp.linear_1 = tnn.Linear(dim, mlp)
+            self.mlp.linear_2 = tnn.Linear(mlp, dim)
+
+        def forward(self, x):
+            h = self.ln_1(x)
+            x = x + self.self_attention(h, h, h, need_weights=False)[0]
+            h = self.ln_2(x)
+            return x + self.mlp.linear_2(
+                torch.nn.functional.gelu(self.mlp.linear_1(h))
+            )
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv_proj = tnn.Conv2d(3, dim, patch, patch)
+            self.class_token = tnn.Parameter(torch.randn(1, 1, dim) * 0.02)
+            self.encoder = tnn.Module()
+            self.encoder.layers = tnn.Module()
+            for i in range(depth):
+                self.encoder.layers.add_module(f"encoder_layer_{i}", Layer())
+            self.encoder.ln = tnn.LayerNorm(dim, eps=1e-6)
+            self.heads = tnn.Module()
+            self.heads.head = tnn.Linear(dim, num_classes)
+
+        def forward(self, x):
+            x = self.conv_proj(x).flatten(2).transpose(1, 2)
+            x = torch.cat([self.class_token.expand(x.shape[0], -1, -1), x], dim=1)
+            # pos_embedding registered lazily below (needs token count)
+            x = x + self.encoder.pos_embedding
+            for _, layer in self.encoder.layers.named_children():
+                x = layer(x)
+            return self.heads.head(self.encoder.ln(x)[:, 0])
+
+    net = Net()
+    tokens = (64 // patch) ** 2 + 1  # agreement test runs at 64x64
+    net.encoder.pos_embedding = torch.nn.Parameter(torch.randn(1, tokens, dim) * 0.02)
+    return net
+
+
+def test_vit_forward_agreement_real_torch():
+    from distribuuuu_tpu.models.vit import ViT
+
+    torch.manual_seed(3)
+    tnet = _make_torch_vit().eval()
+    converted = convert_state_dict(tnet.state_dict(), "vit_s16")
+
+    model = ViT(patch=16, dim=64, depth=2, num_heads=4, mlp_dim=128,
+                num_classes=8, dtype=jnp.float32)
+    x = np.random.default_rng(0).standard_normal((2, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        expect = tnet(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    got = np.asarray(
+        model.apply({"params": converted["params"]}, jnp.asarray(x), train=False)
+    )
+    np.testing.assert_allclose(got, expect, atol=5e-6)
+
+
+def _synthetic_vit_b16_torchvision():
+    d, mlp, layers, tokens = 768, 3072, 12, 197
+    sd = {
+        "conv_proj.weight": np.zeros((d, 3, 16, 16), np.float32),
+        "conv_proj.bias": np.zeros(d, np.float32),
+        "class_token": np.zeros((1, 1, d), np.float32),
+        "encoder.pos_embedding": np.zeros((1, tokens, d), np.float32),
+        "encoder.ln.weight": np.zeros(d, np.float32),
+        "encoder.ln.bias": np.zeros(d, np.float32),
+        "heads.head.weight": np.zeros((1000, d), np.float32),
+        "heads.head.bias": np.zeros(1000, np.float32),
+    }
+    for i in range(layers):
+        p = f"encoder.layers.encoder_layer_{i}"
+        sd[f"{p}.ln_1.weight"] = np.zeros(d, np.float32)
+        sd[f"{p}.ln_1.bias"] = np.zeros(d, np.float32)
+        sd[f"{p}.self_attention.in_proj_weight"] = np.zeros((3 * d, d), np.float32)
+        sd[f"{p}.self_attention.in_proj_bias"] = np.zeros(3 * d, np.float32)
+        sd[f"{p}.self_attention.out_proj.weight"] = np.zeros((d, d), np.float32)
+        sd[f"{p}.self_attention.out_proj.bias"] = np.zeros(d, np.float32)
+        sd[f"{p}.ln_2.weight"] = np.zeros(d, np.float32)
+        sd[f"{p}.ln_2.bias"] = np.zeros(d, np.float32)
+        sd[f"{p}.mlp.linear_1.weight"] = np.zeros((mlp, d), np.float32)
+        sd[f"{p}.mlp.linear_1.bias"] = np.zeros(mlp, np.float32)
+        sd[f"{p}.mlp.linear_2.weight"] = np.zeros((d, mlp), np.float32)
+        sd[f"{p}.mlp.linear_2.bias"] = np.zeros(d, np.float32)
+    return sd
+
+
+def test_vit_b16_full_tree_structure():
+    converted = convert_state_dict(_synthetic_vit_b16_torchvision(), "vit_b16")
+    verify_against_model(converted, "vit_b16")
+
+
+def test_vit_b16_timm_schema_full_tree_structure():
+    """Same weights under timm naming convert to the same tree."""
+    remap = {
+        "conv_proj.weight": "patch_embed.proj.weight",
+        "conv_proj.bias": "patch_embed.proj.bias",
+        "class_token": "cls_token",
+        "encoder.pos_embedding": "pos_embed",
+        "encoder.ln.weight": "norm.weight",
+        "encoder.ln.bias": "norm.bias",
+        "heads.head.weight": "head.weight",
+        "heads.head.bias": "head.bias",
+    }
+
+    import re
+
+    def timm_key(k):
+        if k in remap:
+            return remap[k]
+        k = re.sub(r"^encoder\.layers\.encoder_layer_(\d+)", r"blocks.\1", k)
+        k = k.replace(".ln_1.", ".norm1.").replace(".ln_2.", ".norm2.")
+        k = k.replace(".self_attention.in_proj_", ".attn.qkv.")
+        k = k.replace(".self_attention.out_proj.", ".attn.proj.")
+        k = k.replace(".mlp.linear_1.", ".mlp.fc1.").replace(".mlp.linear_2.", ".mlp.fc2.")
+        return k
+
+    sd = {timm_key(k): v for k, v in _synthetic_vit_b16_torchvision().items()}
+    converted = convert_state_dict(sd, "vit_b16")
+    verify_against_model(converted, "vit_b16")
