@@ -193,8 +193,62 @@ def _model_tree(arch):
     )
 
 
+def _synthesize_vit(tree):
+    """ViT inverse mapping (torchvision vit_b_16 schema): the qkv/out_proj
+    leaves need whole-key renames (in_proj_weight / out_proj.weight), so the
+    prefix-join scheme of the CNN families doesn't apply."""
+    sd = {}
+    expected = {"params": {}, "batch_stats": {}}
+    idx = 0
+    for path, leaf in _flatten(tree.get("params", {})):
+        shape = tuple(leaf.shape)
+        val = (np.arange(int(np.prod(shape)), dtype=np.float32) + idx * 7.0).reshape(shape)
+        idx += 1
+        node = expected["params"]
+        for p in path[:-1]:
+            node = node.setdefault(p, {})
+        node[path[-1]] = val
+
+        mod, leaf_name = list(path[:-1]), path[-1]
+        if not mod:
+            sd["class_token" if leaf_name == "cls_token" else "encoder.pos_embedding"] = val
+            continue
+        if mod[0] == "patch_embed":
+            sd[f"conv_proj.{'weight' if leaf_name == 'kernel' else 'bias'}"] = (
+                np.transpose(val, (3, 2, 0, 1)) if leaf_name == "kernel" else val
+            )
+            continue
+        if mod[0] == "ln_f":
+            sd[f"encoder.ln.{'weight' if leaf_name == 'scale' else 'bias'}"] = val
+            continue
+        if mod[0] == "head":
+            sd[f"heads.head.{'weight' if leaf_name == 'kernel' else 'bias'}"] = (
+                val.T if leaf_name == "kernel" else val
+            )
+            continue
+        i = int(mod[0].removeprefix("block"))
+        p = f"encoder.layers.encoder_layer_{i}"
+        if mod[1] in ("ln1", "ln2"):
+            sd[f"{p}.ln_{mod[1][-1]}.{'weight' if leaf_name == 'scale' else 'bias'}"] = val
+        elif mod[1] == "attn" and mod[2] == "qkv":
+            sd[f"{p}.self_attention.in_proj_{'weight' if leaf_name == 'kernel' else 'bias'}"] = (
+                val.T if leaf_name == "kernel" else val
+            )
+        elif mod[1] == "attn":
+            sd[f"{p}.self_attention.out_proj.{'weight' if leaf_name == 'kernel' else 'bias'}"] = (
+                val.T if leaf_name == "kernel" else val
+            )
+        else:  # fc1 / fc2
+            sd[f"{p}.mlp.linear_{mod[1][-1]}.{'weight' if leaf_name == 'kernel' else 'bias'}"] = (
+                val.T if leaf_name == "kernel" else val
+            )
+    return sd, expected
+
+
 def _synthesize(arch, tree):
     """Returns (torch_sd, expected_flax_tree) with arange-valued leaves."""
+    if arch.startswith("vit"):
+        return _synthesize_vit(tree)
     mod_inv = _family_inverse(arch)
     sd = {}
     expected = {"params": {}, "batch_stats": {}}
