@@ -1,0 +1,196 @@
+"""Tutorial 10 — sequence-parallel ViT: reshard between embed and encoder.
+
+Rung 7 taught the two sequence-parallel attention layouts (ring /
+all-to-all) on a hand-rolled causal LM. This rung shows the pattern a real
+vision transformer needs on top: the *embedding stage is data-parallel*
+(positions must be added to a token while you still know its global index)
+and the *encoder stage is sequence-parallel* (that's where the activation
+memory lives). The handoff between the two regimes is the lesson:
+
+- mesh ``{"data": 2, "seq": 4}``; images sharded over ``data`` and
+  replicated over ``seq`` (spec ``P("data", None, ...)`` — nothing to
+  shard on the seq axis yet)
+- each device embeds the full 64-token sequence (redundant across its seq
+  row — patch embed is <1% of encoder FLOPs, cheaper than a collective)
+  and then keeps only its own L/P slice, indexed by
+  ``lax.axis_index("seq")`` — resharding by *slicing*, no communication
+- the production encoder (`models/vit.py:ViTEncoder`, the module behind
+  vit_s16/b16/l16) runs with ``seq_axis="seq"``: LayerNorms and MLPs are
+  purely local, only the attention contraction crosses shards (ring
+  ppermute — set ``seq_impl="ulysses"`` for the all-to-all layout)
+- global-average-pool = local mean + ``lax.pmean`` over ``seq``; the head
+  and loss are then replicated per data row; grads ``psum`` over both axes
+
+Task: classify which quadrant of a 32×32 image holds a bright patch —
+positional by construction, so it fails (25%) unless position embeddings
+survive the reshard. Run on the fake 8-chip CPU mesh:
+
+    python ../scripts/cpu_mesh_run.py vit_seq_parallel.py
+
+Expected output (CPU mesh, 2×4 data×seq, seeded; recorded 2026-07-31):
+
+    mesh: data=2 seq=4 | encoder: depth 2, dim 64, heads 4 | 16 tokens/shard
+    step   0  loss 1.4342  acc 0.188
+    step  40  loss 1.3185  acc 0.312
+    step  80  loss 0.5987  acc 0.750
+    step 120  loss 0.1524  acc 0.969
+    step 160  loss 0.1016  acc 0.906
+    step 200  loss 0.0199  acc 1.000
+    step 240  loss 0.0101  acc 1.000
+    final acc 1.000 (> 0.95: positions survived the reshard)
+    seq-parallel encoder == dense encoder: max|diff| = 1.9e-06
+
+(Optimizer is plain Adam, host-side: transformers barely move under raw
+SGD — the adaptive scaling the production LAMB/Adam recipes provide is
+load-bearing even at this toy scale.)
+
+The closing check replays the trained parameters through the SAME encoder
+module with ``seq_axis=None`` on the full sequence — the sharded program is
+the dense program, redistributed.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from distribuuuu_tpu.models.vit import ViTEncoder  # noqa: E402
+from distribuuuu_tpu.runtime import create_mesh  # noqa: E402
+
+IMG, PATCH, DIM, HEADS, DEPTH, MLP = 32, 4, 64, 4, 2, 128
+CLASSES, BATCH, STEPS, LR = 4, 32, 241, 1e-3
+GRID = IMG // PATCH                      # 8x8 patches
+TOKENS = GRID * GRID                     # 64
+SEQ_IMPL = os.environ.get("DTPU_SEQ_LAYOUT", "ring")  # ring | ulysses
+
+
+def make_batch(rng, n):
+    """Bright 8x8 patch in one quadrant of a noisy image; label = quadrant."""
+    x = rng.normal(0.0, 0.3, (n, IMG, IMG, 3)).astype(np.float32)
+    y = rng.integers(0, CLASSES, n)
+    for i, q in enumerate(y):
+        r, c = divmod(int(q), 2)
+        rr = rng.integers(0, IMG // 2 - 8 + 1) + r * IMG // 2
+        cc = rng.integers(0, IMG // 2 - 8 + 1) + c * IMG // 2
+        x[i, rr : rr + 8, cc : cc + 8] += 2.0
+    return jnp.asarray(x), jnp.asarray(y, jnp.int32)
+
+
+def patches(x):
+    """[B, 32, 32, 3] -> [B, 64, 48]: pure reshape — the conv patch embed's
+    im2col, written out so the rung has no hidden machinery."""
+    b = x.shape[0]
+    x = x.reshape(b, GRID, PATCH, GRID, PATCH, 3).transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, TOKENS, PATCH * PATCH * 3)
+
+
+encoder = ViTEncoder(
+    depth=DEPTH, num_heads=HEADS, mlp_dim=MLP, dtype=jnp.float32,
+    seq_axis="seq", seq_impl=SEQ_IMPL,
+)
+dense_encoder = ViTEncoder(depth=DEPTH, num_heads=HEADS, mlp_dim=MLP, dtype=jnp.float32)
+
+
+def init_params(key):
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    enc = dense_encoder.init(k1, jnp.zeros((1, TOKENS, DIM), jnp.float32))["params"]
+    return {
+        "embed": 0.05 * jax.random.normal(k2, (PATCH * PATCH * 3, DIM)),
+        "pos": 0.02 * jax.random.normal(k3, (TOKENS, DIM)),
+        "enc": enc,
+        "head_w": 0.05 * jax.random.normal(k4, (DIM, CLASSES)),
+        "head_b": jnp.zeros((CLASSES,)),
+    }
+
+
+def step(params, x, y):
+    """One shard_mapped fwd+bwd: data-parallel embed, slice-reshard,
+    seq-parallel encode, pmean-pool, replicated head. Returns replicated
+    (loss, acc, grads); the Adam update happens host-side."""
+    seq_p = jax.lax.axis_size("seq")
+    my = jax.lax.axis_index("seq")
+    l_local = TOKENS // seq_p
+
+    def loss_fn(p):
+        tok = patches(x) @ p["embed"] + p["pos"]           # full sequence, per device
+        tok = jax.lax.dynamic_slice_in_dim(tok, my * l_local, l_local, axis=1)
+        tok = encoder.apply({"params": p["enc"]}, tok)      # seq-parallel region
+        rep = jax.lax.pmean(jnp.mean(tok, axis=1), "seq")   # global average pool
+        logits = rep @ p["head_w"] + p["head_b"]
+        ll = jax.nn.log_softmax(logits)
+        ce = -jnp.take_along_axis(ll, y[:, None], axis=-1).mean()
+        acc = (logits.argmax(-1) == y).mean()
+        return ce, acc
+
+    (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    loss, acc = (jax.lax.pmean(v, "data") for v in (loss, acc))
+    grads = jax.tree.map(lambda g: jax.lax.pmean(jax.lax.pmean(g, "seq"), "data"), grads)
+    return loss, acc, grads
+
+
+def adam_update(params, grads, m, v, t):
+    """Plain Adam — transformers barely train under raw SGD (curvature varies
+    wildly across LN/attention/MLP params; adaptive scaling is what the
+    production LAMB/Adam recipes provide, `distribuuuu_tpu/optim.py`)."""
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    m = jax.tree.map(lambda a, g: b1 * a + (1 - b1) * g, m, grads)
+    v = jax.tree.map(lambda a, g: b2 * a + (1 - b2) * g * g, v, grads)
+    scale = LR * jnp.sqrt(1 - b2**t) / (1 - b1**t)
+    params = jax.tree.map(
+        lambda w, mm, vv: w - scale * mm / (jnp.sqrt(vv) + eps), params, m, v
+    )
+    return params, m, v
+
+
+def main():
+    mesh = create_mesh({"data": 2, "seq": jax.device_count() // 2})
+    print(
+        f"mesh: data=2 seq={jax.device_count() // 2} | encoder: depth {DEPTH}, "
+        f"dim {DIM}, heads {HEADS} | {TOKENS // (jax.device_count() // 2)} tokens/shard"
+    )
+    sharded = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(P(), P("data"), P("data")),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+    )
+    rng = np.random.default_rng(0)
+    params = init_params(jax.random.PRNGKey(0))
+    m = jax.tree.map(jnp.zeros_like, params)
+    v = jax.tree.map(jnp.zeros_like, params)
+    for i in range(STEPS):
+        x, y = make_batch(rng, BATCH)
+        loss, acc, grads = sharded(params, x, y)
+        params, m, v = adam_update(params, grads, m, v, i + 1)
+        if i % 40 == 0:
+            print(f"step {i:3d}  loss {float(loss):.4f}  acc {float(acc):.3f}")
+    final_acc = float(acc)
+    print(f"final acc {final_acc:.3f} (> 0.95: positions survived the reshard)")
+
+    # the sharded program IS the dense program: replay through seq_axis=None
+    x, y = make_batch(np.random.default_rng(7), BATCH)
+    tok = patches(x) @ params["embed"] + params["pos"]
+    dense_out = dense_encoder.apply({"params": params["enc"]}, tok)
+    gathered = jax.jit(
+        jax.shard_map(
+            lambda p, t: encoder.apply({"params": p}, t),
+            mesh=mesh,
+            in_specs=(P(), P(None, "seq", None)),
+            out_specs=P(None, "seq", None),
+            check_vma=False,
+        )
+    )(params["enc"], tok)
+    diff = float(jnp.max(jnp.abs(gathered - dense_out)))
+    print(f"seq-parallel encoder == dense encoder: max|diff| = {diff:.1e}")
+    assert final_acc > 0.95 and diff < 1e-4
+    return final_acc
+
+
+if __name__ == "__main__":
+    main()
