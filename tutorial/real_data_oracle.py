@@ -51,6 +51,21 @@ LAMB's trust-ratio warmup is noisier in the first epochs (the dip is real
 and reproducible) but converges past the SGD arm by epoch 4 — the curve
 shape a LAMB recipe break would destroy (tests/test_e2e_learning.py
 ::test_real_data_oracle_digits_lamb).
+
+The ViT arm (``main(arch="vit_s16", optimizer="lamb", base_lr=0.002,
+warmup=2, epochs=15)``) trains the transformer family through the same
+production path. Recorded 2026-07-31 on one TPU v5e chip, seed 1, per-epoch
+val Acc@1:
+
+    39.7 34.7 43.3 40.3 39.7 56.3 65.0 68.3 63.3 66.7 70.0 73.3 72.0 76.0 76.0
+    -> best 76.0 (clears the 65 band by 11 points)
+
+Two honest negative results from the same session, kept for the record:
+LAMB at the CNN arm's LR 0.008 plateaus at 42.3 (transformer curvature
+wants the gentler LR + longer warmup), and IM_SIZE 64 at LR 0.008
+collapses to ~10 — at patch 16 the hyperparameters, not the token count,
+are the binding constraint on this 1.4k-image task. Transformers remain
+data-hungry: the CNN arms clear 80 in 5 epochs; ViT needs 15 to reach 76.
 """
 
 import os
@@ -69,6 +84,8 @@ def main(
     warmup: int = 1,
     auto_resume: bool = False,
     out_name: str = "out",
+    arch: str = "resnet18",
+    base_lr: float | None = None,
 ) -> float:
     import jax
 
@@ -78,7 +95,7 @@ def main(
 
     digits_imagefolder(root, train_per_class=train_per_class)
     reset_cfg()
-    cfg.MODEL.ARCH = "resnet18"
+    cfg.MODEL.ARCH = arch
     cfg.MODEL.NUM_CLASSES = 10
     # SyncBN → batch stats over the *global* batch: the oracle numbers hold
     # whether this runs on 1 chip or a mesh (per-device batch shrinks with N)
@@ -102,6 +119,8 @@ def main(
         cfg.OPTIM.WEIGHT_DECAY = 0.01
     else:
         cfg.OPTIM.BASE_LR = 0.05  # linear scaling: 0.1 per 128 global batch
+    if base_lr is not None:
+        cfg.OPTIM.BASE_LR = base_lr
     cfg.OPTIM.WARMUP_EPOCHS = warmup
     cfg.TRAIN.PRINT_FREQ = 10
     cfg.RNG_SEED = 1
