@@ -1,0 +1,81 @@
+"""Export a dtpu checkpoint to a torch state_dict — migration is two-way.
+
+The inverse of scripts/convert_torch.py: reference/torch users can take
+weights trained here back to their stack (the reference's own checkpoints
+are torch state_dicts, `/root/reference/distribuuuu/utils.py:374-380`; the
+emitted naming is exactly what its loaders and torchvision/timm
+``load_state_dict`` accept).
+
+    python scripts/export_torch.py --arch resnet50 \
+        --src ./resnet50/checkpoints/best --dst resnet50_dtpu.pth
+    # then, on the torch side:
+    #   model = torchvision.models.resnet50()
+    #   model.load_state_dict(torch.load("resnet50_dtpu.pth"), strict=False)
+    #   (strict=False only forgives the absent num_batches_tracked counters)
+
+``--src`` accepts any checkpoint this framework writes: per-epoch
+(``ckpt_ep_*``) or weights-only ``best`` directories.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# export is pure host work — never touch (or wait on) an accelerator
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--arch", required=True)
+    ap.add_argument("--src", required=True, help="Orbax checkpoint dir (ckpt_ep_* or best)")
+    ap.add_argument("--dst", required=True, help="output .pth path")
+    args = ap.parse_args()
+
+    import orbax.checkpoint as ocp
+    import torch
+
+    from distribuuuu_tpu.convert import export_state_dict
+
+    ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+    src = os.path.abspath(args.src)
+    # Restore ONLY what the export needs: a per-epoch checkpoint also holds
+    # the optimizer moment trees (~2x the parameter bytes under LAMB/Adam) —
+    # build a params/batch_stats template from metadata instead of
+    # materializing everything (same pattern as checkpoint.load_checkpoint).
+    meta = ckptr.metadata(src)
+    tree = meta.item_metadata.tree if hasattr(meta, "item_metadata") else meta.tree
+    import numpy as np
+
+    template = {
+        k: jax.tree.map(lambda m: jax.ShapeDtypeStruct(tuple(m.shape), np.dtype(m.dtype)), tree[k])
+        for k in ("params", "batch_stats")
+        if k in tree
+    }
+    for scalar, dtype in (("epoch", np.int32), ("best_acc1", np.float32)):
+        if scalar in tree:
+            template[scalar] = dtype(0)
+    restored = ckptr.restore(src, args=ocp.args.PyTreeRestore(item=template))
+    variables = {
+        "params": restored["params"],
+        "batch_stats": restored.get("batch_stats", {}),
+    }
+    sd = {
+        k: torch.from_numpy(v.copy())
+        for k, v in export_state_dict(variables, args.arch).items()
+    }
+    torch.save(sd, args.dst)
+    extra = (
+        f" (from epoch {int(restored['epoch'])}, best Acc@1 {float(restored['best_acc1']):.3f})"
+        if "epoch" in restored
+        else ""
+    )
+    print(f"exported {args.src} ({args.arch}) -> {args.dst}, {len(sd)} tensors{extra}")
+
+
+if __name__ == "__main__":
+    main()
