@@ -804,3 +804,27 @@ def test_export_densenet121_loads_and_agrees_real_torch():
     # other arms use. Exact key routing is already pinned by the leaf-exact
     # round-trip; this asserts the loaded torch net computes the same function.
     np.testing.assert_allclose(got, expect, rtol=3e-5, atol=1e-3)
+
+
+@pytest.mark.parametrize(
+    "arch,make_tnet",
+    [
+        ("efficientnet_b0", _make_torch_efficientnet_b0),
+        ("regnety_040", _make_torch_regnety_040),
+    ],
+)
+def test_export_timm_families_load_and_agree_real_torch(arch, make_tnet):
+    """Export direction for the timm-naming families: exported keys strict-load
+    into the hand-built timm-schema torch nets and reproduce the flax forward."""
+    from distribuuuu_tpu.models import build_model
+
+    model = build_model(arch, num_classes=16, dtype=jnp.float32)
+    variables = model.init(
+        jax.random.PRNGKey(6), jnp.zeros((1, 64, 64, 3), jnp.float32), train=False
+    )
+    tnet = _export_and_load(make_tnet(num_classes=16), arch, variables)
+    x = np.random.default_rng(7).standard_normal((2, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        expect = tnet(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    got = np.asarray(model.apply(variables, jnp.asarray(x), train=False))
+    np.testing.assert_allclose(got, expect, rtol=3e-5, atol=1e-4)
