@@ -203,6 +203,8 @@ def main():
     arch, stem_s2d, bn_f32 = bench_arms()
     set_bn_compute_dtype(jnp.float32 if bn_f32 else jnp.bfloat16)
     kw = {"stem_s2d": True} if stem_s2d else {}
+    if os.environ.get("DTPU_BENCH_REMAT", "0") == "1":
+        kw["remat"] = True  # A/B arm: cost of per-block jax.checkpoint
     model = build_model(arch, num_classes=1000, **kw)  # bf16 trunk by default
     state, tx = create_train_state(model, jax.random.PRNGKey(0), mesh, im_size)
     train_step = make_train_step(model, tx, mesh, topk=5)
