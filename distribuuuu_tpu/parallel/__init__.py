@@ -14,6 +14,9 @@ building blocks that extend the same mesh design to other axes:
 - `tensor`: class-parallel classifier head (column-sharded kernel +
   vocab-parallel cross-entropy) for label spaces too big to replicate
   (ImageNet-21k-scale heads).
+- `pipeline`: GPipe microbatch pipeline over a ``stage`` mesh axis — the
+  whole schedule is one differentiable `lax.scan` of compute+`ppermute`
+  ticks; the reverse schedule is just `jax.grad` of it.
 """
 
 from distribuuuu_tpu.parallel.collectives import (
@@ -21,6 +24,7 @@ from distribuuuu_tpu.parallel.collectives import (
     pmean_tree,
     scaled_all_reduce,
 )
+from distribuuuu_tpu.parallel.pipeline import pipeline_apply
 from distribuuuu_tpu.parallel.ring_attention import ring_attention
 from distribuuuu_tpu.parallel.tensor import column_parallel_logits, tp_cross_entropy
 from distribuuuu_tpu.parallel.ulysses import ulysses_attention
@@ -29,6 +33,7 @@ __all__ = [
     "barrier",
     "pmean_tree",
     "scaled_all_reduce",
+    "pipeline_apply",
     "ring_attention",
     "ulysses_attention",
     "column_parallel_logits",

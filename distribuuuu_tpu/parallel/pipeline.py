@@ -1,0 +1,137 @@
+"""Pipeline parallelism — GPipe microbatch schedule over a mesh axis.
+
+Beyond the reference (its epoch-driven CNNs never outgrow one device's
+memory, SURVEY §2b marks PP n/a), but models deep enough for sequence
+parallelism eventually need their *layers* sharded too. This is the
+TPU-native version of the GPipe schedule (Huang et al. 2019,
+arxiv 1811.06965): stage s of the network lives on mesh-axis position s,
+microbatches flow stage-to-stage over `lax.ppermute` (neighbor hops ride
+the ICI torus), and the whole schedule is a `lax.scan` — one compiled
+program, no host choreography, differentiable end to end.
+
+Schedule shape: with P stages and M microbatches the scan runs M + P − 1
+ticks; stage s computes microbatch m at tick t = s + m, so every stage is
+busy except the P − 1 bubble ticks at either end (utilization
+M / (M + P − 1) — pick M ≥ 4·P to keep the bubble under 20%).
+
+The backward pass needs no separate schedule: `jax.grad` of the scan
+replays the ticks in reverse, which IS the reverse pipeline (cotangents
+hop backward through the transposed ppermute). Activation stashing falls
+out of scan's saved carries — the GPipe memory profile (one in-flight
+activation per stage per tick) without hand-managed buffers; wrap
+``stage_fn`` in `jax.checkpoint` to trade the stash for recompute.
+
+Constraints (by design, to stay one fused program):
+- uniform activation shape across stage boundaries (true of transformer
+  blocks and any residual trunk — the regimes PP is for);
+- every stage runs every tick (inactive ticks compute on garbage and mask
+  the result — on TPU a predictable dense loop beats divergent control
+  flow; the bubble cost is inherent to GPipe, not to this choice).
+
+Use inside `shard_map` over a mesh with a ``stage`` axis; combine with a
+``data`` axis by pmean-ing gradients over ``data`` only — stage params
+are distinct per stage position, not replicas (see
+tests/test_pipeline.py for the full pattern).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _replicated_output(x, axis_name):
+    """Identity on a stage-replicated value that fixes gradient seeding.
+
+    The caller computes the loss identically on every stage row (the
+    output is replicated), so under `jax.grad`-inside-`shard_map` each of
+    the P rows seeds one unit of cotangent and the broadcast-psum's
+    transpose would sum them — every stage gradient P× too large. The
+    backward here divides by P, so exactly one net unit of cotangent
+    enters the pipeline tail regardless of how the (replicated) loss is
+    reduced.
+    """
+    return x
+
+
+def _replicated_output_fwd(x, axis_name):
+    return x, None
+
+
+def _replicated_output_bwd(axis_name, _, ct):
+    return (ct / lax.axis_size(axis_name),)
+
+
+_replicated_output.defvjp(_replicated_output_fwd, _replicated_output_bwd)
+
+
+def pipeline_apply(
+    stage_params: Any,
+    x: jnp.ndarray,
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    *,
+    num_microbatches: int,
+    axis_name: str = "stage",
+) -> jnp.ndarray:
+    """Run ``stage_fn`` as a P-stage GPipe pipeline over ``axis_name``.
+
+    Args:
+      stage_params: THIS device's stage parameters (each mesh position
+        holds different values — shard the stacked-stages tree with
+        ``P("stage")`` in `shard_map`'s in_specs).
+      x: the full local batch ``[B, ...]`` (replicated over the stage
+        axis; only position 0 reads it). B must divide by
+        ``num_microbatches``.
+      stage_fn: ``(params, activation [b, ...]) -> activation [b, ...]``,
+        shape-preserving.
+      num_microbatches: M; utilization M/(M+P−1).
+
+    Returns the pipeline output ``[B, ...]`` replicated across the stage
+    axis (an end-of-pipe psum broadcast behind a seeding-correcting
+    identity — see :func:`_replicated_output`).
+
+    Gradient contract: compute the training loss from this output the
+    ordinary way (any reduction that is identical on every stage row —
+    which it is, since the output and targets are replicated). Per-stage
+    parameter gradients come out unscaled; pinned against a dense oracle,
+    fwd AND grad, in tests/test_pipeline.py.
+    """
+    p = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by num_microbatches {num_microbatches}"
+        )
+    micro = x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+    fwd_perm = [(i, i + 1) for i in range(p - 1)]
+
+    def tick(buf, t):
+        # stage `idx` works on microbatch m = t - idx this tick
+        m = t - idx
+        active = (m >= 0) & (m < num_microbatches)
+        m_safe = jnp.clip(m, 0, num_microbatches - 1)
+        my_input = jnp.where(
+            idx == 0, lax.dynamic_index_in_dim(micro, m_safe, keepdims=False), buf
+        )
+        out = stage_fn(stage_params, my_input)
+        out = jnp.where(active, out, buf)
+        # collect the last stage's finished microbatch before handing off
+        finished = jnp.where((idx == p - 1) & active, out, jnp.zeros_like(out))
+        nxt = lax.ppermute(out, axis_name, fwd_perm)
+        return nxt, finished
+
+    buf0 = jnp.zeros_like(micro[0])
+    _, finished = lax.scan(tick, buf0, jnp.arange(num_microbatches + p - 1))
+    # on the last stage, microbatch m finished at tick m + p - 1: slice the
+    # tail M ticks. Other stages contributed zeros — psum broadcasts the
+    # result everywhere (each stage row then computes the same loss, so the
+    # backward enters the pipeline identically from every position).
+    tail = finished[p - 1 :]
+    out = _replicated_output(lax.psum(tail, axis_name), axis_name)
+    return out.reshape(b, *x.shape[1:])
