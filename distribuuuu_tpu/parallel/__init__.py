@@ -17,6 +17,9 @@ building blocks that extend the same mesh design to other axes:
 - `pipeline`: GPipe microbatch pipeline over a ``stage`` mesh axis — the
   whole schedule is one differentiable `lax.scan` of compute+`ppermute`
   ticks; the reverse schedule is just `jax.grad` of it.
+- `moe`: switch-style top-1 mixture-of-experts over an ``expert`` axis —
+  one-hot einsum dispatch/combine (dense MXU contractions, static shapes)
+  around a single `all_to_all` each way.
 """
 
 from distribuuuu_tpu.parallel.collectives import (
@@ -24,6 +27,7 @@ from distribuuuu_tpu.parallel.collectives import (
     pmean_tree,
     scaled_all_reduce,
 )
+from distribuuuu_tpu.parallel.moe import switch_moe
 from distribuuuu_tpu.parallel.pipeline import pipeline_apply
 from distribuuuu_tpu.parallel.ring_attention import ring_attention
 from distribuuuu_tpu.parallel.tensor import column_parallel_logits, tp_cross_entropy
@@ -34,6 +38,7 @@ __all__ = [
     "pmean_tree",
     "scaled_all_reduce",
     "pipeline_apply",
+    "switch_moe",
     "ring_attention",
     "ulysses_attention",
     "column_parallel_logits",
