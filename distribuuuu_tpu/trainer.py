@@ -93,8 +93,10 @@ def make_train_step(model, tx, mesh: Mesh, topk: int, accum_steps: int = 1):
     *count* sums (`psum`) so averaging is exact regardless of shard sizes.
 
     ``accum_steps > 1``: the local batch is split into that many micro-batches
-    and grads/BN-stats/metrics are averaged over a `lax.scan` before the single
+    and grads/metrics are averaged over a `lax.scan` before the single
     optimizer update — same effective batch as more chips, constant memory.
+    BN running stats thread through the scan carry and EMA sequentially per
+    micro-batch (torch-exact semantics).
     """
 
     def grads_one(params, batch_stats, micro, rng):
@@ -121,26 +123,27 @@ def make_train_step(model, tx, mesh: Mesh, topk: int, accum_steps: int = 1):
             )
 
             def body(carry, xs):
-                acc_grads, acc_loss = carry
+                acc_grads, acc_loss, run_stats = carry
                 mb, mb_rng = xs
                 loss, logits, new_stats, grads = grads_one(
-                    state.params, state.batch_stats, mb, mb_rng
+                    state.params, run_stats, mb, mb_rng
                 )
                 acc_grads = jax.tree.map(jnp.add, acc_grads, grads)
-                return (acc_grads, acc_loss + loss), (logits, new_stats)
+                return (acc_grads, acc_loss + loss, new_stats), logits
 
             zero_grads = jax.tree.map(jnp.zeros_like, state.params)
             rngs = jax.random.split(rng, accum_steps)
-            (sum_grads, sum_loss), (logits_all, stats_all) = jax.lax.scan(
-                body, (zero_grads, jnp.float32(0.0)), (micro, rngs)
+            (sum_grads, sum_loss, new_stats), logits_all = jax.lax.scan(
+                body, (zero_grads, jnp.float32(0.0), state.batch_stats), (micro, rngs)
             )
             grads = jax.tree.map(lambda g: g / accum_steps, sum_grads)
             loss = sum_loss / accum_steps
             logits = logits_all.reshape(-1, logits_all.shape[-1])
-            # running stats: use the scan-average (order-insensitive approx of
-            # sequential EMA over micro-batches; exact for the normalization
-            # itself, which is per-micro-batch either way)
-            new_stats = jax.tree.map(lambda s: jnp.mean(s, axis=0), stats_all)
+            # Running stats thread through the scan carry, so each micro-batch
+            # EMAs them IN ORDER — torch's sequential semantics, exactly (the
+            # input stats never enter a train-mode forward, so grads/outputs
+            # are unaffected; equality vs the sequential oracle is pinned in
+            # tests/test_train_step.py).
         grads = jax.lax.pmean(grads, "data")
         # Running BN stats: averaged across replicas so state stays replicated.
         # (With SYNCBN the normalization stats are already cross-replica; this

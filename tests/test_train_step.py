@@ -216,24 +216,28 @@ def test_grad_accumulation_equivalence(fresh_cfg, mesh):
     np.testing.assert_allclose(m1["loss_sum"], m2["loss_sum"], rtol=1e-5)
 
 
-def test_grad_accum_bn_stats_closeness(fresh_cfg, mesh):
+def test_grad_accum_bn_stats_sequential_exactness(fresh_cfg, mesh):
     """Pins the grad-accum BN running-stat semantics (`trainer.py` accum scan):
 
-    1. EXACT contract: accum=2 stats == the average of one-step updates
-       computed on each micro-half separately (the documented "scan-average"
-       rule — linear in the per-micro stats, so it commutes with pmean).
-       A refactor that switches to e.g. last-micro-wins or sum-not-mean
-       breaks this at O(0.1), far beyond the 1e-5 float32 band.
-    2. BALLPARK bound vs accum=1 at equal global batch: micro-batch
-       normalization makes downstream statistics genuinely differ, but the
-       running-stat drift is momentum-damped; pin the band so a future
-       change can't silently widen the approximation.
+    1. EXACT contract: accum=2 stats == torch's SEQUENTIAL semantics —
+       micro-half 0 EMAs the running stats, micro-half 1 EMAs the result
+       (the stats thread through the scan carry; r4's scan-average
+       approximation is gone). pmean commutes with the EMA (both linear),
+       so the oracle may pmean per half. A refactor back to averaging (or
+       last-micro-wins) breaks this at O(1e-3), beyond the float32 band.
+    2. BALLPARK bound vs accum=1 at equal global batch: two real effects —
+       micro-batch statistics genuinely differ from full-batch ones, and
+       sequential semantics apply K EMA updates per optimizer step (torch
+       does too) so the init-stats transient decays as m^K, not m. Pin the
+       band so a change can't silently widen it further.
     """
     model = TinyCNN()
     batch = _batch(n=32)
 
-    def run(accum, b):
+    def run(accum, b, batch_stats=None):
         state, tx = create_train_state(model, jax.random.PRNGKey(0), mesh, 8)
+        if batch_stats is not None:
+            state = state.replace(batch_stats=batch_stats)
         step = make_train_step(model, tx, mesh, topk=2, accum_steps=accum)
         new_state, _ = step(
             state, _device_batch(b, mesh), jnp.float32(1.0), jax.random.PRNGKey(0)
@@ -246,51 +250,29 @@ def test_grad_accum_bn_stats_closeness(fresh_cfg, mesh):
     # micro-half j of the global batch: device d holds local shard
     # [4d:4d+4); its accum=2 micro j is local[2j:2j+2]
     local = np.arange(32).reshape(8, 2, 2)
-    halves = [
-        run(1, {k: v[local[:, j, :].reshape(-1)] for k, v in batch.items()})
-        for j in (0, 1)
-    ]
-    oracle = jax.tree.map(lambda a, b: (a + b) / 2, *halves)
+    half = lambda j: {k: v[local[:, j, :].reshape(-1)] for k, v in batch.items()}
+    r1 = run(1, half(0))                      # stats after micro 0
+    oracle = run(1, half(1), batch_stats=r1)  # ... then micro 1, in order
 
     for got, want in zip(jax.tree.leaves(stats_accum), jax.tree.leaves(oracle)):
         np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
     for got, ref in zip(jax.tree.leaves(stats_accum), jax.tree.leaves(stats_full)):
-        np.testing.assert_allclose(got, ref, atol=5e-3)
+        np.testing.assert_allclose(got, ref, atol=5e-2)
 
 
 @pytest.mark.slow
 @pytest.mark.parametrize("accum", [8, 32])
-def test_grad_accum_bn_drift_at_lamb_scale(fresh_cfg, mesh, accum):
-    """Quantifies the scan-average running-stat approximation against the
-    sequential-EMA oracle (torch's semantics: each micro forward EMAs the
-    running stats in order) at the accum counts the LAMB large-batch path
-    actually uses (8-32 micros per step).
+def test_grad_accum_bn_sequential_at_lamb_scale(fresh_cfg, mesh, accum):
+    """The accum scan's running stats equal the sequential-EMA oracle
+    (torch semantics) EXACTLY at the accum counts the LAMB large-batch path
+    uses (8-32 micros/step) — and stay equal over repeated steps.
 
-    Setup isolates the BN machinery: LR=0 (params frozen) and a fixed batch,
-    so per-micro batch statistics are step-invariant and both semantics have
-    closed forms. With momentum m and per-micro stats s_j (mean s̄):
-
-      scan-average after K steps:  m^K r0 + (1-m^K) s̄
-      sequential  after K steps:   m^{JK} r0 + (1-m)Σ m^{...} s_j  → ≈ s̄ fast
-
-    Drift decomposition (exact, from the closed forms):
-
-      scan(K) − seq(K) = m^K (r0 − s̄) − m^{JK} (r0 − w̄)  +  (s̄ − w̄)
-                         └──── transient, decays like m^K ────┘   └ bias ┘
-
-    where w̄ is the sequential oracle's within-step RECENCY-weighted micro
-    average (weights (1−m)m^{J−1−j}). The persistent term is the *oracle's*
-    recency bias: with reshuffled data (every real epoch) micro order is
-    random, so w̄ varies around s̄ and that term is zero-mean across steps —
-    the scan-average is the unbiased estimator of the same limit.
-
-    Pinned properties:
-      1. the trainer's accum step reproduces the scan-average closed form
-         exactly (extends the accum=2 exactness test to 8/32);
-      2. after subtracting the oracle's recency bias, the remaining drift
-         CONTRACTS (residual(25) < 0.75·residual(1)) — the approximation error is a transient;
-      3. total 25-step drift stays < 25% of the distance the running stats
-         have actually moved — the band a recipe consumer cares about.
+    r4 carried a scan-average approximation here with a documented drift
+    bound; the stats now thread through the scan carry, so the bound
+    collapses to equality. Setup isolates the BN machinery: LR=0 (params
+    frozen) and a fixed batch, so per-micro statistics s_j are
+    step-invariant and the oracle is a pure EMA fold over them, K·steps
+    applications deep.
     """
     m_bn = 0.9
     model = TinyCNN()
@@ -321,7 +303,6 @@ def test_grad_accum_bn_drift_at_lamb_scale(fresh_cfg, mesh, accum):
         stats_j.append(
             jax.tree.map(lambda rj, r0_: (rj - m_bn * r0_) / (1.0 - m_bn), r_j, r0)
         )
-    s_bar = jax.tree.map(lambda *xs: sum(xs) / len(xs), *stats_j)
 
     def seq_oracle(k_steps):
         r = r0
@@ -330,40 +311,20 @@ def test_grad_accum_bn_drift_at_lamb_scale(fresh_cfg, mesh, accum):
                 r = jax.tree.map(lambda r_, s_: m_bn * r_ + (1.0 - m_bn) * s_, r, sj)
         return r
 
-    def scan_closed_form(k_steps):
-        decay = m_bn**k_steps
-        return jax.tree.map(lambda r0_, s_: decay * r0_ + (1 - decay) * s_, r0, s_bar)
-
     def flat(t):
         return np.concatenate([np.ravel(x) for x in jax.tree.leaves(t)])
 
-    # the oracle's within-step recency weights; micro J-1 (last) is heaviest
-    wts = [
-        (1 - m_bn) * m_bn ** (accum - 1 - j) / (1 - m_bn**accum)
-        for j in range(accum)
-    ]
-    w_bar = jax.tree.map(lambda *xs: sum(w * x for w, x in zip(wts, xs)), *stats_j)
-    bias = flat(w_bar) - flat(s_bar)  # steady-state scan−seq offset = −bias
-
     step = make_train_step(model, tx, mesh, topk=2, accum_steps=accum)
     state = fresh_state()
-    drift, resid = {}, {}
-    for k in range(1, 26):
+    for k in (1, 2, 3):
         state, _ = step(
             state, _device_batch(batch, mesh), jnp.float32(0.0), jax.random.PRNGKey(k)
         )
-        if k in (1, 25):
-            got = jax.device_get(state.batch_stats)
-            np.testing.assert_allclose(  # property 1: exact scan semantics
-                flat(got), flat(scan_closed_form(k)), atol=2e-4, rtol=2e-4
-            )
-            d = flat(got) - flat(seq_oracle(k))
-            drift[k] = float(np.max(np.abs(d)))
-            resid[k] = float(np.max(np.abs(d + bias)))  # transient part
-
-    assert resid[25] < 0.75 * resid[1], (resid, drift)  # property 2
-    moved = float(np.max(np.abs(flat(seq_oracle(25)) - flat(r0))))
-    assert drift[25] < 0.25 * moved, (drift, moved)  # property 3
+        got = jax.device_get(state.batch_stats)
+        np.testing.assert_allclose(
+            flat(got), flat(seq_oracle(k)), atol=2e-5, rtol=2e-5,
+            err_msg=f"step {k}: accum stats != sequential-EMA oracle",
+        )
 
 
 def test_train_step_with_lamb(fresh_cfg, mesh):
