@@ -21,6 +21,12 @@ and `wait_for_saves()` blocks until everything is durable — the trainer calls
 it before exiting. Multi-host aware: every process calls save, Orbax
 coordinates so the write happens once — the analog of the reference's
 rank-0-only save gate at `utils.py:369-370`.
+
+Fault-tolerance extensions (docs/FAULT_TOLERANCE.md): mid-epoch *emergency*
+checkpoints (``ckpt_mid_ep_{E:03d}_it_{S:06d}``, written on preemption and
+pruned once a durable epoch checkpoint dominates them), `restore_latest`
+(resume-position ranking across both kinds, with corrupt-checkpoint
+fallback), and retry-with-backoff around the Orbax save/restore dispatch.
 """
 
 from __future__ import annotations
@@ -32,11 +38,14 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from distribuuuu_tpu import resilience
+from distribuuuu_tpu.logging import logger
 from distribuuuu_tpu.runtime import pathio
 
 _NAME_PREFIX = "ckpt_ep_"
 _DIR_NAME = "checkpoints"
 _BEST_NAME = "best"
+_MID_FMT = "ckpt_mid_ep_{epoch:03d}_it_{step:06d}"
 
 
 def get_checkpoint_dir(out_dir: str) -> str:
@@ -55,6 +64,12 @@ def get_best_path(out_dir: str) -> str:
 # (ckpt_ep_XXX.orbax-checkpoint-tmp-<ts>, left behind by a killed run) are
 # never mistaken for complete checkpoints during auto-resume.
 _CKPT_RE = re.compile(rf"^{_NAME_PREFIX}(\d+)$")
+_MID_RE = re.compile(r"^ckpt_mid_ep_(\d+)_it_(\d+)$")
+
+
+def get_mid_checkpoint_path(out_dir: str, epoch: int, step: int) -> str:
+    """Path of a mid-epoch emergency checkpoint (preemption save)."""
+    return pathio.join(get_checkpoint_dir(out_dir), _MID_FMT.format(epoch=epoch, step=step))
 
 
 def _complete_checkpoints(out_dir: str) -> list[tuple[int, str]]:
@@ -69,6 +84,21 @@ def _complete_checkpoints(out_dir: str) -> list[tuple[int, str]]:
         m = _CKPT_RE.match(f)
         if m:
             out.append((int(m.group(1)), pathio.join(d, f)))
+    return sorted(out)
+
+
+def _mid_checkpoints(out_dir: str) -> list[tuple[int, int, str]]:
+    """Committed mid-epoch emergency checkpoints as (epoch, step, path),
+    sorted ascending. Same exact-name match as the epoch scan, so Orbax
+    in-progress temp dirs never count."""
+    d = get_checkpoint_dir(out_dir)
+    if not pathio.isdir(d):
+        return []
+    out = []
+    for f in pathio.listdir(d):
+        m = _MID_RE.match(f)
+        if m:
+            out.append((int(m.group(1)), int(m.group(2)), pathio.join(d, f)))
     return sorted(out)
 
 
@@ -115,17 +145,135 @@ def save_checkpoint(out_dir: str, epoch: int, state: Any, best_acc1: float, is_b
     }
     path = get_checkpoint_path(out_dir, epoch + 1)
     ckptr = _checkpointer("epoch")
-    ckptr.wait_until_finished()  # ≤1 in flight; no-op when idle
-    ckptr.save(path, payload, force=True)
+    # the wait is where the PREVIOUS save's background serialize+write
+    # surfaces its errors; a transiently failed old checkpoint must not kill
+    # a healthy training run (Orbax leaves only a tmp dir, which the resume
+    # scan already ignores) — warn and move on to writing the new one
+    prev_durable = _wait_tolerating_failure(ckptr, "previous epoch checkpoint")
+    if prev_durable:
+        # every epoch save issued before this point is committed now, so any
+        # emergency checkpoint from an epoch before `epoch` is strictly
+        # dominated by a *durable* epoch checkpoint and can be pruned. When
+        # the previous write failed, that dominator may not exist — keep the
+        # emergency checkpoints as fallback resume points.
+        prune_mid_checkpoints(out_dir, before_epoch=epoch)
+    resilience.retry(
+        ckptr.save, path, payload, force=True, desc=f"checkpoint save {path}"
+    )
     if is_best:
         best = _checkpointer("best")
-        best.wait_until_finished()
-        best.save(
+        _wait_tolerating_failure(best, "previous best checkpoint")
+        resilience.retry(
+            best.save,
             get_best_path(out_dir),
             {"params": state.params, "batch_stats": state.batch_stats},
             force=True,
+            desc="best-checkpoint save",
         )
     return path
+
+
+# Transient background-write failures are tolerated (logged, run continues),
+# but persistently broken storage must still fail loudly — a 90-epoch run
+# whose writes all fail silently would "complete" with no checkpoints.
+_MAX_CONSECUTIVE_WAIT_FAILURES = 3
+_wait_failures: dict[int, int] = {}  # id(checkpointer) -> consecutive failures
+
+
+def _wait_tolerating_failure(ckptr: ocp.AsyncCheckpointer, what: str) -> bool:
+    """Drain the checkpointer's in-flight save; returns False (after logging)
+    when its background write failed instead of re-raising — until the
+    failures run consecutive (broken storage, not a blip), which re-raises."""
+    try:
+        ckptr.wait_until_finished()  # ≤1 in flight; no-op when idle
+        _wait_failures.pop(id(ckptr), None)
+        return True
+    except Exception as exc:
+        n = _wait_failures.get(id(ckptr), 0) + 1
+        _wait_failures[id(ckptr)] = n
+        if n >= _MAX_CONSECUTIVE_WAIT_FAILURES:
+            logger.error(
+                f"background write of the {what} failed {n} times in a row — "
+                f"checkpoint storage looks broken, aborting"
+            )
+            raise
+        logger.error(
+            f"background write of the {what} failed ({exc!r}); continuing — "
+            f"the resume scan skips its partial directory"
+        )
+        return False
+
+
+def save_mid_checkpoint(
+    out_dir: str, epoch: int, step: int, state: Any, best_acc1: float, rng_key: Any
+) -> str:
+    """Emergency mid-epoch checkpoint for graceful preemption.
+
+    Beyond the per-epoch payload it records the in-progress 0-based ``epoch``,
+    the ``step`` (batches of that epoch already consumed — resume skips
+    exactly that many) and the host ``rng_key`` (the trainer's dropout key,
+    so runs with ``RNG_SEED None`` resume with the same stream).
+
+    Synchronous, unlike the epoch save: the process is about to exit, and
+    the retry must cover the *whole* write — a transient failure in the
+    background serialize/commit would otherwise surface only after the save
+    "succeeded", leaving the preemption window spent and no checkpoint.
+    """
+    payload = {
+        "epoch": np.int32(epoch),
+        "step": np.int32(step),
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+        "best_acc1": np.float32(best_acc1),
+        "rng_key": np.asarray(jax.device_get(rng_key)),
+    }
+    path = get_mid_checkpoint_path(out_dir, epoch, step)
+    ckptr = _checkpointer("mid")
+    _wait_tolerating_failure(ckptr, "previous emergency checkpoint")
+
+    def save_committed():
+        ckptr.save(path, payload, force=True)
+        ckptr.wait_until_finished()  # durable (or raising) before we return
+
+    resilience.retry(
+        save_committed,
+        retry_on=(Exception,),
+        desc=f"emergency checkpoint save {path}",
+    )
+    return path
+
+
+def prune_mid_checkpoints(out_dir: str, before_epoch: int) -> None:
+    """Best-effort removal of emergency checkpoints for epochs < before_epoch
+    (each is dominated by a committed complete epoch checkpoint by the time
+    this is called — see save_checkpoint). Truly best-effort: object-store
+    backends raise non-OSError types (tf gfile errors via etils), and a
+    failed cleanup must never kill the save path that invoked it."""
+    for e, s, path in _mid_checkpoints(out_dir):
+        if e < before_epoch:
+            try:
+                pathio.rmtree(path)
+            except Exception as exc:
+                logger.warning(f"could not prune stale emergency checkpoint {path}: {exc!r}")
+
+
+def _as_template(tree):
+    return jax.tree.map(lambda x: ocp.utils.to_shape_dtype_struct(x), tree)
+
+
+def _restore(path: str, template: dict):
+    """Retryable restore: transient object-store hiccups are retried; a
+    genuinely corrupt directory exhausts the retries and raises (callers that
+    can fall back catch it — see restore_latest)."""
+    ckptr = _checkpointer()
+    return resilience.retry(
+        ckptr.restore,
+        path,
+        args=ocp.args.PyTreeRestore(item=template),
+        retry_on=(OSError,),
+        desc=f"checkpoint restore {path}",
+    )
 
 
 def load_checkpoint(path: str, state: Any, load_opt: bool = True):
@@ -140,27 +288,104 @@ def load_checkpoint(path: str, state: Any, load_opt: bool = True):
     wait_for_saves()  # the path may be a save still committing in background
     ckptr = _checkpointer()
     meta = ckptr.metadata(path)
-    names = set(meta.item_metadata.tree.keys()) if hasattr(meta, "item_metadata") else set(
-        meta.tree.keys()
-    )
+    # top-level payload key names across orbax metadata generations: the
+    # modern CheckpointMetadata wrapper, the bare tree object, or (oldest)
+    # a plain dict tree
+    if hasattr(meta, "item_metadata"):
+        names = set(meta.item_metadata.tree.keys())
+    elif hasattr(meta, "tree"):
+        names = set(meta.tree.keys())
+    else:
+        names = set(meta.keys())
 
-    def as_template(tree):
-        return jax.tree.map(lambda x: ocp.utils.to_shape_dtype_struct(x), tree)
-
-    template = {"params": as_template(state.params), "batch_stats": as_template(state.batch_stats)}
+    template = {"params": _as_template(state.params), "batch_stats": _as_template(state.batch_stats)}
     full = {"epoch", "opt_state", "best_acc1"} <= names
     if full:
         template.update(
             {
                 "epoch": np.int32(0),
-                "opt_state": as_template(state.opt_state),
+                "opt_state": _as_template(state.opt_state),
                 "best_acc1": np.float32(0.0),
             }
         )
-    restored = ckptr.restore(path, args=ocp.args.PyTreeRestore(item=template))
+    restored = _restore(path, template)
     new_state = state.replace(params=restored["params"], batch_stats=restored["batch_stats"])
     if full:
         if load_opt:
             new_state = new_state.replace(opt_state=restored["opt_state"])
         return new_state, int(restored["epoch"]) + 1, float(restored["best_acc1"])
     return new_state, 0, 0.0
+
+
+def load_mid_checkpoint(path: str, state: Any):
+    """Restore an emergency checkpoint: (state, epoch, step, best_acc1,
+    rng_key). ``epoch`` is the in-progress 0-based epoch to re-enter and
+    ``step`` the number of its batches already consumed."""
+    wait_for_saves()
+    template = {
+        "epoch": np.int32(0),
+        "step": np.int32(0),
+        "params": _as_template(state.params),
+        "batch_stats": _as_template(state.batch_stats),
+        "opt_state": _as_template(state.opt_state),
+        "best_acc1": np.float32(0.0),
+        "rng_key": np.zeros((2,), np.uint32),
+    }
+    restored = _restore(path, template)
+    new_state = state.replace(
+        params=restored["params"],
+        batch_stats=restored["batch_stats"],
+        opt_state=restored["opt_state"],
+    )
+    return (
+        new_state,
+        int(restored["epoch"]),
+        int(restored["step"]),
+        float(restored["best_acc1"]),
+        np.asarray(restored["rng_key"]),
+    )
+
+
+def restore_latest(
+    out_dir: str,
+    state: Any,
+    *,
+    step_granular: bool = True,
+    skip_corrupt: bool = True,
+    load_opt: bool = True,
+):
+    """Resume from the most-advanced restorable checkpoint in ``out_dir``.
+
+    Candidates are complete per-epoch checkpoints (resume position
+    ``(N, 0)``) and — when ``step_granular`` — mid-epoch emergency
+    checkpoints (position ``(epoch, step)``). The highest resume position
+    wins; at an equal position a complete epoch checkpoint is preferred over
+    an emergency one. With ``skip_corrupt``, a candidate that fails to
+    restore (corrupt or partial — e.g. the node died while Orbax was
+    finalizing) is skipped with a warning and the next-highest is tried, so
+    one bad directory can never wedge the restart loop.
+
+    Returns ``(state, start_epoch, start_step, best_acc1, rng_key | None,
+    path)``, or ``None`` when nothing is restorable.
+    """
+    candidates: list[tuple[tuple[int, int, int], str, str]] = [
+        ((n, 0, 1), "epoch", p) for n, p in _complete_checkpoints(out_dir)
+    ]
+    if step_granular:
+        candidates += [((e, s, 0), "mid", p) for e, s, p in _mid_checkpoints(out_dir)]
+    candidates.sort(key=lambda c: c[0], reverse=True)
+    for _, kind, path in candidates:
+        try:
+            if kind == "epoch":
+                st, start_epoch, best = load_checkpoint(path, state, load_opt=load_opt)
+                return st, start_epoch, 0, best, None, path
+            st, epoch, step, best, rng_key = load_mid_checkpoint(path, state)
+            return st, epoch, step, best, rng_key, path
+        except Exception as exc:
+            if not skip_corrupt:
+                raise
+            logger.warning(
+                f"Checkpoint {path} failed to restore ({exc!r}); "
+                f"falling back to the next-highest checkpoint"
+            )
+    return None

@@ -130,6 +130,41 @@ _C.OPTIM.WEIGHT_DECAY = 5e-5
 _C.MESH = CN()
 _C.MESH.DATA = -1  # -1: all devices on the 'data' axis
 
+# Fault tolerance (TPU addition; docs/FAULT_TOLERANCE.md). The reference has
+# no mid-epoch failure story; these knobs govern the resilience layer.
+_C.FAULT = CN()
+# Jitted all-finite check on loss/grads: a non-finite step leaves params,
+# optimizer state and BN stats untouched (bit-exact no-op for finite steps).
+_C.FAULT.NONFINITE_GUARD = True
+# Abort the run after this many consecutive skipped steps (divergence, not a
+# one-off blip). Counted at PRINT_FREQ window granularity on the host.
+_C.FAULT.MAX_CONSECUTIVE_SKIPS = 10
+# Exponential-backoff-with-full-jitter retry knobs for flaky I/O (shard
+# reads/decodes, dataset provisioning, checkpoint save/restore).
+_C.FAULT.RETRY_ATTEMPTS = 3
+_C.FAULT.RETRY_BASE_DELAY = 0.1
+_C.FAULT.RETRY_MAX_DELAY = 2.0
+# Graceful degradation: a sample that fails all retries is logged and
+# substituted (zero image, weight 0) instead of killing the run.
+_C.FAULT.DEGRADE = True
+# Install the SIGTERM/SIGINT → graceful-preemption handler in train_model.
+_C.FAULT.HANDLE_SIGNALS = True
+# Deterministic fault injection (test-only; DTPU_FAULT_* env vars override —
+# see resilience.FaultInjector). All inert at these defaults.
+_C.FAULT.INJECT_IO_INDICES = []
+_C.FAULT.INJECT_IO_FAILURES = 1
+_C.FAULT.INJECT_NAN_STEPS = []
+_C.FAULT.INJECT_PREEMPT_STEP = -1
+
+# Resume policy (TPU addition). Epoch checkpoints stay the primary contract;
+# these govern the extra step-granular/robustness behavior on top.
+_C.RESUME = CN()
+# Consider mid-epoch emergency checkpoints (preemption saves) when resuming.
+_C.RESUME.STEP_GRANULAR = True
+# A corrupt/partial highest checkpoint is skipped with a warning (fall back
+# to the next-highest) instead of crashing the restart loop.
+_C.RESUME.SKIP_CORRUPT = True
+
 # Output directory
 _C.OUT_DIR = "./exp"
 _C.CFG_DEST = "config.yaml"

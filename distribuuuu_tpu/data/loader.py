@@ -35,17 +35,12 @@ import jax
 import numpy as np
 from PIL import Image
 
+from distribuuuu_tpu import resilience
 from distribuuuu_tpu.config import cfg, get_default
 from distribuuuu_tpu.data import native
 from distribuuuu_tpu.data.dataset import DummyDataset, ImageFolder, open_image_dataset
 from distribuuuu_tpu.data.transforms import eval_transform_u8, train_transform_u8
-
-
-class _ProducerError:
-    """Carrier for an exception raised inside the producer thread."""
-
-    def __init__(self, exc: BaseException):
-        self.exc = exc
+from distribuuuu_tpu.logging import logger
 
 
 def _qput(out_q: queue.Queue, item, stop: threading.Event) -> bool:
@@ -77,6 +72,7 @@ class HostDataLoader:
         seed: int,
         prefetch_batches: int = 4,
         crop_size: int = 224,
+        injector: "resilience.FaultInjector | None" = None,
     ):
         self.dataset = dataset
         self.host_batch = host_batch
@@ -90,6 +86,8 @@ class HostDataLoader:
         self.crop_size = crop_size  # eval center-crop (reference hardcodes 224, `utils.py:166`)
         self.use_native = native.available()
         self.epoch = 0
+        self.start_batch = 0  # mid-epoch resume fast-forward (set_epoch)
+        self.injector = injector if injector is not None else resilience.FaultInjector()
 
         total = len(dataset)
         self.shard_size = (total + process_count - 1) // process_count
@@ -107,9 +105,16 @@ class HostDataLoader:
         else:
             self.num_batches = (self.shard_size + host_batch - 1) // host_batch
 
-    def set_epoch(self, epoch: int) -> None:
-        """Reshuffle determinism hook (reference `trainer.py:33`)."""
+    def set_epoch(self, epoch: int, start_batch: int = 0) -> None:
+        """Reshuffle determinism hook (reference `trainer.py:33`).
+
+        ``start_batch`` fast-forwards the epoch for step-granular resume: the
+        producer starts at that batch index without decoding the skipped
+        samples (the shuffle and per-slot augmentation seeds are pure
+        functions of (seed, epoch, index), so the replay is exact).
+        """
         self.epoch = epoch
+        self.start_batch = start_batch
 
     def __len__(self) -> int:
         return self.num_batches
@@ -136,9 +141,60 @@ class HostDataLoader:
         return order[self.process_index :: self.process_count]
 
     def _load_one(self, idx: int, slot_seed: int):
+        """Retryable per-sample load with graceful degradation.
+
+        Flaky shard reads / decode errors are retried with backoff
+        (FAULT.RETRY_*); a sample that fails every attempt is logged and
+        substituted rather than killing a pod-scale run (unless FAULT.DEGRADE
+        is off). Eval substitutes a weight-0 zero sample — exactly the
+        padding semantics, invisible to the exact metrics. Train substitutes
+        a *neighboring real sample* instead: the train loss is unweighted
+        (torch parity), so a zero image would actively teach "black → class
+        0", while a duplicated real sample only reweights the data
+        distribution by one draw. If the neighbors are unreadable too (a
+        corrupt shard region), train fails loudly — there is no masked way
+        to degrade an unweighted loss.
+        """
         if idx < 0:  # eval padding slot: zero image, weight 0 (masked in metrics)
             size = self.im_size if self.train else self.crop_size
             return np.zeros((size, size, 3), dtype=np.uint8), 0, 0.0
+        try:
+            return resilience.retry(
+                self._load_one_raw,
+                idx,
+                slot_seed,
+                retry_on=(OSError, ValueError),
+                desc=f"sample load idx={idx}",
+            )
+        except (OSError, ValueError) as exc:
+            if not cfg.FAULT.DEGRADE:
+                raise
+            if self.train:
+                total = len(self.dataset.samples)
+                for off in (1, 2, 3):  # deterministic fallbacks, single try each
+                    alt = (idx + off) % total
+                    try:
+                        arr, label, _ = self._load_one_raw(alt, slot_seed)
+                    except (OSError, ValueError):
+                        continue
+                    resilience.RUN_STATS.count_substitution()
+                    logger.warning(
+                        f"sample idx={idx} failed all retries ({exc!r}); "
+                        f"substituted neighboring sample idx={alt}"
+                    )
+                    return arr, label, 1.0
+                # no masked degradation exists for the unweighted train loss
+                # (a zero sample would train "black → class 0") — fail loudly
+                raise
+            resilience.RUN_STATS.count_substitution()
+            logger.warning(
+                f"sample idx={idx} failed all retries ({exc!r}); substituting "
+                f"a masked zero sample"
+            )
+            return np.zeros((self.crop_size, self.crop_size, 3), dtype=np.uint8), 0, 0.0
+
+    def _load_one_raw(self, idx: int, slot_seed: int):
+        self.injector.maybe_fail_io(idx)
         name, label = self.dataset.samples[idx]
         # tar shards hand back member bytes (positional pread, no per-image
         # open); plain ImageFolder decodes straight from the path
@@ -172,7 +228,7 @@ class HostDataLoader:
                 arr = eval_transform_u8(im, self.im_size, self.crop_size)
         return arr, label, 1.0
 
-    def _produce(self, out_q: queue.Queue, stop: threading.Event) -> None:
+    def _produce(self, out_q: queue.Queue, stop: threading.Event, err_box: list) -> None:
         indices = self._shard_indices()
         # per-host, per-epoch augmentation stream (the reference's seed+rank
         # analog, `utils.py:60-65`): distinct crops/flips on every host
@@ -181,15 +237,20 @@ class HostDataLoader:
         ) & 0x7FFFFFFF
         try:
             self._produce_batches(out_q, stop, indices, base)
-        except BaseException as exc:  # surface decode/IO errors in the consumer
-            _qput(out_q, _ProducerError(exc), stop)
-        finally:
+        except BaseException as exc:
+            # surface in the consumer via the side channel, NOT the bounded
+            # queue: a full queue must not delay a KeyboardInterrupt/
+            # SystemExit (or any failure) behind unconsumed batches. stop
+            # doubles as the wake-up: the consumer polls err_box on timeout.
+            err_box.append(exc)
+            stop.set()
+        else:
             # end-marker: waits for queue space unless the consumer is gone
             _qput(out_q, None, stop)
 
     def _produce_batches(self, out_q, stop, indices, base) -> None:
         with ThreadPoolExecutor(self.workers) as pool:
-            for b in range(self.num_batches):
+            for b in range(self.start_batch, self.num_batches):
                 if stop.is_set():
                     return
                 chunk = indices[b * self.host_batch : (b + 1) * self.host_batch]
@@ -217,23 +278,60 @@ class HostDataLoader:
                 ):
                     return
 
+    @staticmethod
+    def _raise_producer_error(exc: BaseException) -> None:
+        if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+            # control-flow exceptions keep their identity so Ctrl-C /
+            # sys.exit in a worker aborts the run the normal way
+            raise exc
+        # fail the run like the reference's torch DataLoader would
+        # (a silent short epoch would desync multi-host batch counts)
+        raise RuntimeError("data loader worker failed") from exc
+
     def __iter__(self) -> Iterator[dict]:
         out_q: queue.Queue = queue.Queue(maxsize=self.prefetch_batches)
         stop = threading.Event()
-        producer = threading.Thread(target=self._produce, args=(out_q, stop), daemon=True)
+        err_box: list = []
+        producer = threading.Thread(
+            target=self._produce, args=(out_q, stop, err_box), daemon=True
+        )
         producer.start()
         try:
             while True:
-                batch = out_q.get()
+                if err_box:  # checked before draining: failures preempt
+                    self._raise_producer_error(err_box[0])  # buffered batches
+                try:
+                    batch = out_q.get(timeout=0.2)
+                except queue.Empty:
+                    if err_box:
+                        self._raise_producer_error(err_box[0])
+                    if not producer.is_alive():
+                        # producer is gone: re-check err_box first — the
+                        # append happens-before thread death, so an error
+                        # raised after the check above is visible here (a
+                        # silent short epoch would desync multi-host counts)
+                        if err_box:
+                            self._raise_producer_error(err_box[0])
+                        # clean exit between queue drain and sentinel (or
+                        # killed): hand over what it left, then stop
+                        # instead of polling forever
+                        while True:
+                            try:
+                                batch = out_q.get_nowait()
+                            except queue.Empty:
+                                return
+                            if batch is None:
+                                return
+                            yield batch
+                    continue
                 if batch is None:
                     break
-                if isinstance(batch, _ProducerError):
-                    # fail the run like the reference's torch DataLoader would
-                    # (a silent short epoch would desync multi-host batch counts)
-                    raise RuntimeError("data loader worker failed") from batch.exc
                 yield batch
         finally:
+            # wake/stop the producer even when the consumer abandons the
+            # epoch early, then reap it so threads never leak across epochs
             stop.set()
+            producer.join(timeout=5.0)
 
 
 # Marker key: a loader that yields a batch containing this key promises the
@@ -251,17 +349,18 @@ class DummyLoader:
 
     def __init__(self, host_batch: int, im_size: int, num_batches: int):
         self.num_batches = max(1, num_batches)
+        self.start_batch = 0
         self._batch = DummyDataset(im_size=im_size).sample_batch(host_batch)
         self._batch[REPLAY_CONST] = True
 
-    def set_epoch(self, epoch: int) -> None:
-        pass
+    def set_epoch(self, epoch: int, start_batch: int = 0) -> None:
+        self.start_batch = start_batch
 
     def __len__(self) -> int:
         return self.num_batches
 
     def __iter__(self):
-        for _ in range(self.num_batches):
+        for _ in range(self.start_batch, self.num_batches):
             yield self._batch
 
 
@@ -436,3 +535,4 @@ def prefetch_to_device(iterator, mesh, prefetch: int = 2):
             yield item
     finally:
         stop.set()
+        t.join(timeout=5.0)  # reap: abandoned epochs must not leak workers

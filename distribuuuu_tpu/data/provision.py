@@ -20,6 +20,8 @@ import os
 import numpy as np
 from PIL import Image
 
+from distribuuuu_tpu import resilience
+
 
 @contextlib.contextmanager
 def _provision_lock(root: str):
@@ -76,7 +78,20 @@ def digits_imagefolder(
             import shutil
 
             shutil.rmtree(root)
-        _materialize(root, marker, stamp, im_size, val_per_class, train_per_class)
+        # retryable (FAULT.RETRY_*): materialization is deterministic and
+        # marker-last, so a re-run after a transient disk/NFS error simply
+        # rewrites the same JPEGs in place
+        resilience.retry(
+            _materialize,
+            root,
+            marker,
+            stamp,
+            im_size,
+            val_per_class,
+            train_per_class,
+            retry_on=(OSError,),
+            desc=f"digits provisioning at {root}",
+        )
     return root
 
 

@@ -1,0 +1,408 @@
+"""Fault-tolerance layer: preemption handling, retryable I/O, fault injection.
+
+The reference survives failures only at epoch granularity (per-epoch
+checkpoints + auto-resume, `/root/reference/distribuuuu/utils.py:319-410`),
+which is adequate for short Slurm GPU jobs but not for long TPU-pod runs:
+pods are routinely preempted mid-epoch, a single NaN step or flaky shard
+read would kill the whole run, and at 8k+ global batch an ImageNet epoch is
+too expensive to redo. This module holds the host-side half of the
+fault-tolerance layer; the device-side half (the non-finite gradient guard)
+lives inside the jitted train step (`trainer.make_train_step`).
+
+Pieces, all config-driven via the ``FAULT`` section:
+
+- **Preemption**: `install_preemption_handler` turns SIGTERM/SIGINT into a
+  flag (`preemption_requested`) the epoch loop polls at step boundaries; the
+  trainer then writes a mid-epoch emergency checkpoint (global step, RNG
+  state and all — see `checkpoint.save_mid_checkpoint`) and exits via
+  `Preempted`, a `SystemExit` carrying the conventional 143 (128+SIGTERM)
+  exit code.
+- **Retryable I/O**: `retry` wraps flaky operations (shard reads, JPEG
+  decode, object-store checkpoint writes) in exponential backoff with full
+  jitter. Callers that can degrade gracefully (the data loader) substitute a
+  masked sample after the last attempt instead of failing the run.
+- **Fault injection**: `FaultInjector` deterministically injects I/O errors
+  at chosen dataset indices, NaN batches at chosen global steps, and a
+  simulated SIGTERM at a chosen step — driven by cfg keys or ``DTPU_FAULT_*``
+  env vars so subprocess CLI runs can be fault-tested too. This is what
+  makes the whole layer exercisable by tier-1 CPU tests
+  (`tests/test_resilience.py`).
+- **RunStats**: host-side counters (skipped steps per epoch, substituted
+  samples, retries, preemption point) — the observable surface the trainer
+  logs and tests assert on.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+import time
+from typing import Any, Callable
+
+from distribuuuu_tpu.logging import logger
+
+
+class Preempted(SystemExit):
+    """Graceful-preemption exit: emergency checkpoint committed.
+
+    Exit code is 128 + the triggering signal when one was recorded (143 for
+    the scheduler's SIGTERM, 130 for an operator SIGINT — supervisors treat
+    them differently), 143 for signal-less preemption (fault injection,
+    explicit `request_preemption`).
+    """
+
+    def __init__(self, message: str = "preempted", code: int | None = None):
+        if code is None:
+            code = 128 + _preempt_signum if _preempt_signum else 143
+        super().__init__(code)
+        self.message = message
+
+    def __str__(self) -> str:  # SystemExit.__str__ would print the code
+        return self.message
+
+
+class NonFiniteDivergence(RuntimeError):
+    """Too many consecutive non-finite steps: the run has diverged (or the
+    input pipeline is poisoned) and skipping further updates cannot save it."""
+
+
+class InjectedIOError(OSError):
+    """Deterministic I/O fault raised by `FaultInjector` (retryable)."""
+
+
+def _fault_cfg():
+    from distribuuuu_tpu.config import cfg
+
+    return cfg.FAULT if "FAULT" in cfg else None
+
+
+# ---------------------------------------------------------------------------
+# Run statistics (the metrics surface of the resilience layer)
+# ---------------------------------------------------------------------------
+
+class RunStats:
+    """Host-side resilience counters for the current run.
+
+    ``skipped_steps`` maps epoch → number of optimizer updates skipped by the
+    non-finite guard; ``substituted_samples`` counts loader samples replaced
+    after exhausting retries; ``retries`` counts individual retry sleeps;
+    ``preempted_at`` records the (epoch, step) an emergency checkpoint was
+    written at. Reset by `trainer.train_model` at run start.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        self.skipped_steps: dict[int, int] = {}
+        self.substituted_samples = 0
+        self.retries = 0
+        self.preempted_at: tuple[int, int] | None = None
+
+    @property
+    def total_skipped(self) -> int:
+        return sum(self.skipped_steps.values())
+
+    def count_retry(self) -> None:
+        with self._lock:
+            self.retries += 1
+
+    def count_substitution(self) -> None:
+        with self._lock:
+            self.substituted_samples += 1
+
+
+RUN_STATS = RunStats()
+
+
+def reset_run_stats() -> None:
+    RUN_STATS.reset()
+
+
+# ---------------------------------------------------------------------------
+# Retryable I/O
+# ---------------------------------------------------------------------------
+
+# Module-level jitter stream: seeded so two identical runs log identical
+# backoff delays (the delays never influence numerics, only wall time).
+_jitter_rng = random.Random(0x7E51)
+
+
+def retry(
+    fn: Callable[..., Any],
+    *args: Any,
+    attempts: int | None = None,
+    base_delay: float | None = None,
+    max_delay: float | None = None,
+    retry_on: tuple[type[BaseException], ...] = (OSError,),
+    desc: str | None = None,
+    sleep: Callable[[float], None] = time.sleep,
+    **kwargs: Any,
+):
+    """Call ``fn(*args, **kwargs)``, retrying on ``retry_on`` failures.
+
+    Exponential backoff with *full jitter*: attempt ``a`` sleeps
+    ``uniform(0, min(max_delay, base_delay · 2^a))``. Defaults for
+    ``attempts``/``base_delay``/``max_delay`` come from ``cfg.FAULT.RETRY_*``
+    so one knob set governs every retryable I/O site (loader shard reads,
+    dataset provisioning, checkpoint save/restore). The last failure is
+    re-raised unchanged once attempts are exhausted — graceful degradation
+    (substitute vs abort) is the caller's policy, not retry's.
+    """
+    fc = _fault_cfg()
+    if attempts is None:
+        attempts = fc.RETRY_ATTEMPTS if fc is not None else 3
+    if base_delay is None:
+        base_delay = fc.RETRY_BASE_DELAY if fc is not None else 0.1
+    if max_delay is None:
+        max_delay = fc.RETRY_MAX_DELAY if fc is not None else 2.0
+    attempts = max(1, int(attempts))
+    what = desc or getattr(fn, "__name__", "operation")
+    for attempt in range(attempts):
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as exc:
+            if attempt == attempts - 1:
+                raise
+            delay = _jitter_rng.uniform(0.0, min(max_delay, base_delay * (2.0**attempt)))
+            RUN_STATS.count_retry()
+            logger.warning(
+                f"{what} failed (attempt {attempt + 1}/{attempts}): {exc!r}; "
+                f"retrying in {delay:.3f}s"
+            )
+            sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+# ---------------------------------------------------------------------------
+# Preemption flag + signal handling
+# ---------------------------------------------------------------------------
+
+_preempt_flag = threading.Event()
+_preempt_signum: int | None = None
+_prev_handlers: dict[int, Any] = {}
+
+
+def request_preemption(reason: str = "signal", signum: int | None = None) -> None:
+    """Flag the run for graceful preemption (polled at step boundaries).
+    ``signum`` records the triggering signal so `Preempted` can exit with
+    the conventional 128+signum code."""
+    global _preempt_signum
+    if signum is not None:
+        _preempt_signum = signum
+    if not _preempt_flag.is_set():
+        logger.warning(f"Preemption requested ({reason}); will checkpoint at the next step boundary")
+    _preempt_flag.set()
+
+
+def preemption_requested() -> bool:
+    return _preempt_flag.is_set()
+
+
+def clear_preemption() -> None:
+    global _preempt_signum
+    _preempt_signum = None
+    _preempt_flag.clear()
+
+
+_warned_local_signal_multihost = False
+
+
+def preemption_stop_requested(step: int) -> bool:
+    """Should this host stop and emergency-checkpoint at this step boundary?
+
+    Single process: just the local flag. Multi-host: every host must stop at
+    the SAME step boundary — a lone host leaving the step loop would strand
+    the rest in their next collective until the hard preemption deadline
+    kills the job. Agreement comes from the JAX coordination service's
+    preemption sync point (the scheduler's SIGTERM reaches the coordinator,
+    which fans the notice out so `reached_preemption_sync_point` flips True
+    on all hosts at the same ``step``). When the sync manager isn't available
+    (older runtime, no distributed init) we fall back to the local flag —
+    schedulers deliver SIGTERM to every host, so same-cadence polling aligns
+    the stop step in the common case.
+
+    A *local-only* signal on a multi-host run with a working sync manager
+    (operator SIGINT on one host, say) can NOT safely stop the run — there
+    is no step every host agrees on — so it is logged loudly and otherwise
+    ignored; the emergency-checkpoint promise holds only for coordinated
+    preemption there.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return preemption_requested()
+    try:
+        from jax.experimental import multihost_utils
+
+        if multihost_utils.reached_preemption_sync_point(step):
+            return True
+        has_sync_manager = True
+    except Exception:
+        has_sync_manager = False
+    if not has_sync_manager:
+        return preemption_requested()
+    if preemption_requested():
+        global _warned_local_signal_multihost
+        if not _warned_local_signal_multihost:
+            _warned_local_signal_multihost = True
+            logger.warning(
+                "Local preemption signal on a multi-host run: waiting for the "
+                "coordinated preemption notice (a unilateral stop would strand "
+                "the other hosts in their next collective). A second signal "
+                "kills this process immediately, without an emergency "
+                "checkpoint."
+            )
+    return False
+
+
+def install_preemption_handler(
+    signals: tuple[int, ...] = (signal.SIGTERM, signal.SIGINT),
+) -> bool:
+    """Route SIGTERM/SIGINT into the preemption flag. Returns False when not
+    installable (non-main thread — e.g. a server embedding the trainer).
+
+    First signal: set the flag and restore the previous handler, so a second
+    signal behaves as before installation (typically: kill immediately) —
+    an operator's double Ctrl-C still works.
+    """
+    installed: dict[int, Any] = {}
+    try:
+        for sig in signals:
+            prev = signal.getsignal(sig)
+
+            def _handler(signum, frame, _prev=prev):
+                request_preemption(f"signal {signum}", signum=signum)
+                _restore = _prev if (callable(_prev) or _prev in (signal.SIG_DFL, signal.SIG_IGN)) else signal.SIG_DFL
+                signal.signal(signum, _restore)
+
+            signal.signal(sig, _handler)
+            installed[sig] = prev
+    except ValueError:
+        # signal.signal only works on the main thread; fall back to polling
+        # FAULT.INJECT_PREEMPT_STEP / explicit request_preemption() calls
+        for sig, prev in installed.items():
+            signal.signal(sig, prev)
+        logger.warning("Preemption signal handler not installed (not on the main thread)")
+        return False
+    _prev_handlers.update(installed)
+    return True
+
+
+def uninstall_preemption_handler() -> None:
+    """Restore pre-installation handlers (test hygiene)."""
+    while _prev_handlers:
+        sig, prev = _prev_handlers.popitem()
+        try:
+            signal.signal(sig, prev)
+        except (ValueError, TypeError):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Deterministic fault injection (test-only)
+# ---------------------------------------------------------------------------
+
+def _parse_int_list(raw: str) -> list[int]:
+    return [int(x) for x in raw.replace(",", " ").split() if x.strip()]
+
+
+class FaultInjector:
+    """Deterministic, test-only fault injection. Inert unless configured.
+
+    Sources, in precedence order: ``DTPU_FAULT_*`` env vars (so subprocess
+    CLI runs can be fault-tested without touching YAMLs), then the
+    ``cfg.FAULT.INJECT_*`` keys. Knobs:
+
+    - ``INJECT_IO_INDICES`` / ``DTPU_FAULT_IO_INDICES``: dataset indices whose
+      load raises `InjectedIOError`.
+    - ``INJECT_IO_FAILURES`` / ``DTPU_FAULT_IO_FAILURES``: how many times each
+      such index fails before succeeding (−1 = always fails → exercises the
+      substitution path).
+    - ``INJECT_NAN_STEPS`` / ``DTPU_FAULT_NAN_STEPS``: global steps whose
+      batch is NaN-poisoned before the train step (exercises the non-finite
+      guard end to end).
+    - ``INJECT_PREEMPT_STEP`` / ``DTPU_FAULT_PREEMPT_STEP``: simulate SIGTERM
+      exactly *before* this global step runs (−1 = disabled). Equality, not
+      ``>=``: a resumed run that starts past the step will not re-fire, but
+      tests should still clear the knob for the relaunch.
+
+    Global step is ``epoch * steps_per_epoch + it`` — stable across
+    preempt/resume, which is what makes kill-at-step-k tests deterministic.
+    """
+
+    def __init__(
+        self,
+        io_indices: list[int] | None = None,
+        io_failures: int | None = None,
+        nan_steps: list[int] | None = None,
+        preempt_step: int | None = None,
+    ):
+        fc = _fault_cfg()
+        env = os.environ
+        if io_indices is None:
+            if "DTPU_FAULT_IO_INDICES" in env:
+                io_indices = _parse_int_list(env["DTPU_FAULT_IO_INDICES"])
+            else:
+                io_indices = list(fc.INJECT_IO_INDICES) if fc is not None else []
+        if io_failures is None:
+            if "DTPU_FAULT_IO_FAILURES" in env:
+                io_failures = int(env["DTPU_FAULT_IO_FAILURES"])
+            else:
+                io_failures = fc.INJECT_IO_FAILURES if fc is not None else 1
+        if nan_steps is None:
+            if "DTPU_FAULT_NAN_STEPS" in env:
+                nan_steps = _parse_int_list(env["DTPU_FAULT_NAN_STEPS"])
+            else:
+                nan_steps = list(fc.INJECT_NAN_STEPS) if fc is not None else []
+        if preempt_step is None:
+            if "DTPU_FAULT_PREEMPT_STEP" in env:
+                preempt_step = int(env["DTPU_FAULT_PREEMPT_STEP"])
+            else:
+                preempt_step = fc.INJECT_PREEMPT_STEP if fc is not None else -1
+        self.io_indices = frozenset(int(i) for i in io_indices)
+        self.io_failures = int(io_failures)
+        self.nan_steps = frozenset(int(s) for s in nan_steps)
+        self.preempt_step = int(preempt_step)
+        self._io_counts: dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def active(self) -> bool:
+        return bool(self.io_indices or self.nan_steps or self.preempt_step >= 0)
+
+    def maybe_fail_io(self, idx: int) -> None:
+        """Raise `InjectedIOError` for a configured index (counted per index,
+        thread-safe — the loader calls this from its decode pool)."""
+        if idx not in self.io_indices:
+            return
+        with self._lock:
+            n = self._io_counts.get(idx, 0)
+            if 0 <= self.io_failures <= n:
+                return
+            self._io_counts[idx] = n + 1
+        raise InjectedIOError(f"injected I/O fault for sample index {idx} (failure #{n + 1})")
+
+    def is_nan_step(self, global_step: int) -> bool:
+        return global_step in self.nan_steps
+
+    def should_preempt(self, global_step: int) -> bool:
+        return self.preempt_step >= 0 and global_step == self.preempt_step
+
+
+def poison_batch_nan(batch: dict) -> dict:
+    """Return a copy of a device batch whose images are all-NaN float32.
+
+    `transforms.device_normalize` passes float inputs through, so the NaNs
+    propagate to the loss and gradients — the authentic non-finite-step
+    scenario the jitted guard exists for (the dtype change retraces the step
+    once; params selected by the guard are unaffected).
+    """
+    import jax.numpy as jnp
+
+    out = dict(batch)
+    out["image"] = batch["image"].astype(jnp.float32) * jnp.float32(float("nan"))
+    return out

@@ -1,0 +1,34 @@
+"""JAX version compatibility shims.
+
+The framework targets the current `jax.shard_map` API (top-level export,
+``check_vma`` kwarg). Older runtimes — e.g. a CPU dev box pinned to
+jax 0.4.x — only ship `jax.experimental.shard_map.shard_map` with the
+``check_rep`` spelling of the same knob. `ensure_jax_compat` installs a
+top-level alias translating the new signature, so one code path serves both
+runtimes. Called at trainer import and from tests/conftest.py; idempotent
+and a no-op on modern JAX.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def ensure_jax_compat() -> None:
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, mesh=None, in_specs=None, out_specs=None, check_vma=None, **kwargs):
+            if check_vma is not None:
+                kwargs.setdefault("check_rep", check_vma)
+            return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.lax, "axis_size"):
+        def axis_size(axis_name):
+            # psum of 1 over a named axis constant-folds to the static axis
+            # size at trace time — the pre-axis_size spelling of the same op
+            return jax.lax.psum(1, axis_name)
+
+        jax.lax.axis_size = axis_size

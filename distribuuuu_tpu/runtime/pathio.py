@@ -42,6 +42,13 @@ def join(path: str, *parts: str) -> str:
     return str(epath.Path(path).joinpath(*parts))
 
 
+def rmtree(path: str) -> None:
+    """Recursively delete a directory if it exists (local or object store)."""
+    p = epath.Path(path)
+    if p.exists():
+        p.rmtree()
+
+
 def open_write(path: str) -> IO[str]:
     """Open ``path`` for text writing. On object stores the content becomes
     visible at ``close()`` (no partial writes), which is exactly right for

@@ -21,6 +21,7 @@ from __future__ import annotations
 import functools
 import importlib
 import os
+import sys
 import time
 from typing import Any
 
@@ -32,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distribuuuu_tpu import checkpoint as ckpt
 from distribuuuu_tpu import optim
+from distribuuuu_tpu import resilience
 from distribuuuu_tpu.config import cfg, dump_cfg
 from distribuuuu_tpu.data import (
     construct_train_loader,
@@ -50,7 +52,10 @@ from distribuuuu_tpu.metrics import (
 )
 from distribuuuu_tpu.models import build_model
 from distribuuuu_tpu.runtime import data_mesh, setup_distributed, setup_seed
+from distribuuuu_tpu.runtime.compat import ensure_jax_compat
 from distribuuuu_tpu.runtime.seeding import configure_determinism
+
+ensure_jax_compat()  # older runtimes: alias jax.shard_map (check_vma→check_rep)
 
 
 @flax.struct.dataclass
@@ -85,7 +90,10 @@ def _forward_loss(model, params, batch_stats, batch, train: bool, rng):
     return loss, (logits, new_stats)
 
 
-def make_train_step(model, tx, mesh: Mesh, topk: int, accum_steps: int = 1):
+def make_train_step(
+    model, tx, mesh: Mesh, topk: int, accum_steps: int = 1,
+    nonfinite_guard: bool | None = None,
+):
     """Build the jitted SPMD train step.
 
     Per-device: forward/backward on the local batch shard → `pmean` grads over
@@ -97,7 +105,19 @@ def make_train_step(model, tx, mesh: Mesh, topk: int, accum_steps: int = 1):
     optimizer update — same effective batch as more chips, constant memory.
     BN running stats thread through the scan carry and EMA sequentially per
     micro-batch (torch-exact semantics).
+
+    ``nonfinite_guard`` (default ``cfg.FAULT.NONFINITE_GUARD``): compile an
+    all-finite check over loss+grads into the step. A bad step (NaN/inf from
+    an overflowed bf16 reduction, a poisoned batch, a flaky chip) passes
+    params, optimizer state and BN stats through *unchanged* and zeroes its
+    metric contributions; the metrics gain a ``skipped`` flag the host loop
+    counts (per-epoch ``skipped_steps``, consecutive-skip abort — see
+    docs/FAULT_TOLERANCE.md). The check pieces ride the pmean'd values, so
+    every device takes the same branch, and a finite step's selected values
+    are bit-identical to an unguarded step's.
     """
+    if nonfinite_guard is None:
+        nonfinite_guard = cfg.FAULT.NONFINITE_GUARD
 
     def grads_one(params, batch_stats, micro, rng):
         def loss_fn(p):
@@ -154,12 +174,37 @@ def make_train_step(model, tx, mesh: Mesh, topk: int, accum_steps: int = 1):
         new_params = optim.apply_updates_with_lr(state.params, updates, lr)
         n = jnp.float32(batch["label"].shape[0])
         correct = topk_correct(logits, batch["label"], ks=(1, topk))
+        if nonfinite_guard:
+            # keep is derived from pmean'd values only, so it is identical on
+            # every device and the selection below stays replicated. A NaN
+            # anywhere on any device poisons the pmean'd grads, so checking
+            # the post-collective values catches per-device faults too.
+            keep = jnp.isfinite(jax.lax.pmean(loss, "data"))
+            for g in jax.tree.leaves(grads):
+                keep = jnp.logical_and(keep, jnp.all(jnp.isfinite(g)))
+
+            def sel(new, old):
+                return jnp.where(keep, new, old)
+
+            new_params = jax.tree.map(sel, new_params, state.params)
+            new_opt_state = jax.tree.map(sel, new_opt_state, state.opt_state)
+            new_stats = jax.tree.map(sel, new_stats, state.batch_stats)
+            # a skipped step contributes nothing to the epoch averages (its
+            # loss is NaN and NaN logits rank every label "correct")
+            zero = jnp.float32(0.0)
+            loss_term = jnp.where(keep, loss * n, zero)
+            n = jnp.where(keep, n, zero)
+            correct = {k: jnp.where(keep, v, zero) for k, v in correct.items()}
+        else:
+            loss_term = loss * n
         metrics = {
-            "loss_sum": jax.lax.psum(loss * n, "data"),
+            "loss_sum": jax.lax.psum(loss_term, "data"),
             "n": jax.lax.psum(n, "data"),
             "correct1": jax.lax.psum(correct[1], "data"),
             f"correct{topk}": jax.lax.psum(correct[topk], "data"),
         }
+        if nonfinite_guard:
+            metrics["skipped"] = 1.0 - keep.astype(jnp.float32)
         return (
             TrainState(params=new_params, batch_stats=new_stats, opt_state=new_opt_state),
             metrics,
@@ -321,11 +366,21 @@ def _pretrained_path() -> str:
 def train_epoch(
     loader, mesh, train_step, state, epoch: int, rng, is_primary: bool,
     start_epoch: int = 0, run_tic: float | None = None,
+    start_step: int = 0, best_acc1: float = 0.0, injector=None,
 ):
     lr = optim.get_epoch_lr(epoch)
     if is_primary:
         logger.info(f"Epoch[{epoch}] current learning rate: {lr:.6f}")
-    loader.set_epoch(epoch)
+    if start_step:
+        # mid-epoch resume: fast-forward past already-consumed batches at
+        # the index level (the loader never decodes the skipped samples)
+        loader.set_epoch(epoch, start_batch=start_step)
+        if is_primary:
+            logger.info(
+                f"Epoch[{epoch}] resuming mid-epoch at step {start_step}/{len(loader)}"
+            )
+    else:
+        loader.set_epoch(epoch)
     lr_arr = jnp.asarray(lr, jnp.float32)
     topk = cfg.TRAIN.TOPK
     batch_time, data_time, losses, top1, topk_m, progress = construct_meters(
@@ -341,14 +396,50 @@ def train_epoch(
 
     profile = cfg.TRAIN.PROFILE and epoch == 0 and is_primary
     trace_active = False
+    steps_per_epoch = len(loader)
+    max_consec = cfg.FAULT.MAX_CONSECUTIVE_SKIPS
+    epoch_skipped = 0
+    consec_skipped = 0
+    first_window = True
     window: list = []
     epoch_start = time.time()
     t_end = epoch_start
     t_window = epoch_start
     for it, batch in enumerate(
-        prefetch_to_device(loader, mesh, cfg.TRAIN.PREFETCH)
+        prefetch_to_device(loader, mesh, cfg.TRAIN.PREFETCH), start=start_step
     ):
         data_time.update(time.time() - t_end)
+        gstep = epoch * steps_per_epoch + it
+        if injector is not None and injector.should_preempt(gstep):
+            # injection keys off gstep, identical on every host — safe to
+            # stop without the multi-host agreement below
+            resilience.request_preemption(f"injected at global step {gstep}")
+            stop_here = True
+        else:
+            # multi-host: stop only when every host agrees on this step
+            # boundary (a lone host leaving would strand the rest in their
+            # next collective until the preemption deadline kills the job)
+            stop_here = resilience.preemption_stop_requested(gstep)
+        if stop_here:
+            # state reflects exactly `it` consumed batches of this epoch;
+            # commit it (with step + RNG) before giving the slice back
+            path = ckpt.save_mid_checkpoint(
+                cfg.OUT_DIR, epoch, it, state, best_acc1, rng
+            )
+            try:  # drain older async epoch saves; the emergency save above
+                ckpt.wait_for_saves()  # is already durable (synchronous), so
+            except Exception as exc:  # a failure here must not eat Preempted
+                logger.error(f"async save wait during preemption failed: {exc!r}")
+            resilience.RUN_STATS.preempted_at = (epoch, it)
+            logger.warning(
+                f"Preempted at epoch {epoch} step {it}: emergency checkpoint "
+                f"{path} committed; exiting"
+            )
+            raise resilience.Preempted(f"preempted at epoch {epoch} step {it}")
+        if injector is not None and injector.is_nan_step(gstep):
+            batch = resilience.poison_batch_nan(batch)
+            if is_primary:
+                logger.warning(f"FAULT INJECTION: NaN batch at global step {gstep}")
         if profile and not trace_active and it == cfg.TRAIN.PROFILE_START:
             jax.profiler.start_trace(f"{cfg.OUT_DIR}/profile")
             trace_active = True
@@ -367,19 +458,36 @@ def train_epoch(
             # some transports); fetch BEFORE timestamping the window
             vals = jax.device_get(window)
             now = time.time()
-            if it == 0:
+            if first_window:
                 # first window = compile + autotune: show it as .val but keep
                 # it out of the running Time average (honest steady-state avg)
                 batch_time.val = (now - t_window) / len(window)
+                first_window = False
             else:
                 batch_time.update((now - t_window) / len(window), n=len(window))
             t_window = now
+            # non-finite-guard accounting: per-epoch skipped_steps plus an
+            # abort when skips run back-to-back (divergence, not a blip)
+            for v in vals:
+                if v.get("skipped", 0.0) >= 0.5:
+                    epoch_skipped += 1
+                    consec_skipped += 1
+                    if consec_skipped >= max_consec:
+                        raise resilience.NonFiniteDivergence(
+                            f"{consec_skipped} consecutive non-finite steps at "
+                            f"epoch {epoch} step {it} — aborting (loss/grads "
+                            f"are NaN/inf every step; FAULT.MAX_CONSECUTIVE_"
+                            f"SKIPS={max_consec})"
+                        )
+                else:
+                    consec_skipped = 0
             n = sum(v["n"] for v in vals)
-            losses.update(float(sum(v["loss_sum"] for v in vals) / n), n=int(n))
-            top1.update(float(100.0 * sum(v["correct1"] for v in vals) / n), n=int(n))
-            topk_m.update(
-                float(100.0 * sum(v[f"correct{topk}"] for v in vals) / n), n=int(n)
-            )
+            if n > 0:  # a window of all-skipped steps has nothing to average
+                losses.update(float(sum(v["loss_sum"] for v in vals) / n), n=int(n))
+                top1.update(float(100.0 * sum(v["correct1"] for v in vals) / n), n=int(n))
+                topk_m.update(
+                    float(100.0 * sum(v[f"correct{topk}"] for v in vals) / n), n=int(n)
+                )
             window.clear()
             if is_primary:
                 progress.display(it)
@@ -387,8 +495,15 @@ def train_epoch(
     if trace_active:  # epoch shorter than PROFILE_START+STEPS
         jax.profiler.stop_trace()
         logger.info(f"Wrote profiler trace to {cfg.OUT_DIR}/profile (short epoch)")
-    if is_primary and len(loader):
-        imgs = cfg.TRAIN.BATCH_SIZE * cfg.TRAIN.ACCUM_STEPS * jax.device_count() * len(loader)
+    resilience.RUN_STATS.skipped_steps[epoch] = epoch_skipped
+    if epoch_skipped and is_primary:
+        logger.warning(
+            f"Epoch[{epoch}] skipped_steps: {epoch_skipped} non-finite step(s) "
+            f"left params/optimizer state untouched"
+        )
+    steps_run = len(loader) - start_step
+    if is_primary and steps_run > 0:
+        imgs = cfg.TRAIN.BATCH_SIZE * cfg.TRAIN.ACCUM_STEPS * jax.device_count() * steps_run
         wall = time.time() - epoch_start
         if wall > 0:
             logger.info(
@@ -469,6 +584,20 @@ def _bn_dtype_scoped(fn):
     return wrapper
 
 
+def _recommit_state(state: TrainState, mesh: Mesh) -> TrainState:
+    """Launder restored checkpoint arrays through a jitted copy.
+
+    Orbax hands back host-resident array layouts (``memory_kind=
+    unpinned_host`` on some runtimes); feeding those straight into the
+    donated train step crashes XLA:CPU on its second invocation. The jitted
+    copy re-materializes the state exactly as `create_train_state` does —
+    replicated sharding, device-committed buffers — so donation behaves
+    identically to the fresh-init path. Values are copied bit-exactly.
+    """
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(lambda s: jax.tree.map(jnp.copy, s), out_shardings=replicated)(state)
+
+
 @_bn_dtype_scoped
 def train_model():
     """Full training run (reference `trainer.py:106-173`).
@@ -481,6 +610,19 @@ def train_model():
     if info.is_primary:
         dump_cfg()
     setup_logger(cfg.OUT_DIR, info.process_index)
+    resilience.reset_run_stats()
+    # a stale flag from an earlier preempted run in this process must not
+    # immediately re-preempt the relaunch
+    resilience.clear_preemption()
+    if cfg.FAULT.HANDLE_SIGNALS:
+        resilience.install_preemption_handler()
+    injector = resilience.FaultInjector()
+    if injector.active:
+        logger.warning(
+            f"FAULT INJECTION active: io_indices={sorted(injector.io_indices)} "
+            f"(failures={injector.io_failures}), nan_steps="
+            f"{sorted(injector.nan_steps)}, preempt_step={injector.preempt_step}"
+        )
     mesh = data_mesh(cfg.MESH.DATA)
     logger.info(
         f"Devices: {info.global_device_count} ({info.process_count} hosts), "
@@ -513,32 +655,76 @@ def train_model():
     )
     eval_step = make_eval_step(model, mesh, cfg.TRAIN.TOPK)
 
-    start_epoch, best_acc1 = 0, 0.0
-    if cfg.TRAIN.AUTO_RESUME and ckpt.has_checkpoint(cfg.OUT_DIR):
-        path = ckpt.get_last_checkpoint(cfg.OUT_DIR)
-        state, start_epoch, best_acc1 = ckpt.load_checkpoint(path, state)
-        logger.info(f"Resumed from {path} (epoch {start_epoch}, best {best_acc1:.3f})")
-    elif cfg.MODEL.WEIGHTS:
+    start_epoch, start_step, best_acc1 = 0, 0, 0.0
+    resumed = False
+    if cfg.TRAIN.AUTO_RESUME:
+        res = ckpt.restore_latest(
+            cfg.OUT_DIR,
+            state,
+            step_granular=cfg.RESUME.STEP_GRANULAR,
+            skip_corrupt=cfg.RESUME.SKIP_CORRUPT,
+        )
+        if res is not None:
+            state, start_epoch, start_step, best_acc1, rng_key, path = res
+            if rng_key is not None:
+                # mid-epoch resume: continue the interrupted run's dropout
+                # stream even when RNG_SEED is unset (fresh OS entropy would
+                # otherwise desync the replay of the in-progress epoch)
+                dropout_key = jnp.asarray(rng_key)
+            resumed = True
+            logger.info(
+                f"Resumed from {path} (epoch {start_epoch}, step {start_step}, "
+                f"best {best_acc1:.3f})"
+            )
+    if not resumed and cfg.MODEL.WEIGHTS:
         state, _, _ = ckpt.load_checkpoint(
             cfg.MODEL.WEIGHTS, state, load_opt=cfg.TRAIN.LOAD_OPT
         )
+        resumed = True  # restored arrays: recommit below
         logger.info(f"Warm-started weights from {cfg.MODEL.WEIGHTS}")
-    elif cfg.MODEL.PRETRAINED:
+    elif not resumed and cfg.MODEL.PRETRAINED:
         state, _, _ = ckpt.load_checkpoint(_pretrained_path(), state, load_opt=False)
+        resumed = True
         logger.info(f"Initialized from pretrained weights ({cfg.MODEL.ARCH})")
+    if resumed:
+        state = _recommit_state(state, mesh)
 
     run_tic = time.time()
-    for epoch in range(start_epoch, cfg.OPTIM.MAX_EPOCH):
-        state = train_epoch(
-            train_loader, mesh, train_step, state, epoch, dropout_key,
-            info.is_primary, start_epoch=start_epoch, run_tic=run_tic,
-        )
-        acc1, _ = validate(val_loader, mesh, eval_step, state, info.is_primary)
-        is_best = acc1 > best_acc1
-        best_acc1 = max(acc1, best_acc1)
-        path = ckpt.save_checkpoint(cfg.OUT_DIR, epoch, state, best_acc1, is_best)
-        logger.info(f"Saving checkpoint (async): {path} (best Acc@1 {best_acc1:.3f})")
-    ckpt.wait_for_saves()  # don't exit with a checkpoint mid-commit
+    try:
+        for epoch in range(start_epoch, cfg.OPTIM.MAX_EPOCH):
+            state = train_epoch(
+                train_loader, mesh, train_step, state, epoch, dropout_key,
+                info.is_primary, start_epoch=start_epoch, run_tic=run_tic,
+                start_step=start_step if epoch == start_epoch else 0,
+                best_acc1=best_acc1, injector=injector,
+            )
+            acc1, _ = validate(val_loader, mesh, eval_step, state, info.is_primary)
+            is_best = acc1 > best_acc1
+            best_acc1 = max(acc1, best_acc1)
+            path = ckpt.save_checkpoint(cfg.OUT_DIR, epoch, state, best_acc1, is_best)
+            logger.info(f"Saving checkpoint (async): {path} (best Acc@1 {best_acc1:.3f})")
+    finally:
+        # runs on success, preemption AND any mid-epoch exception: never
+        # abandon an in-flight async Orbax write (a partial directory would
+        # poison the next auto-resume scan). Guarded so a failed background
+        # write cannot replace a primary exception (a Preempted exit must
+        # stay a Preempted exit) — but a CLEAN run with a failed final
+        # checkpoint must not exit 0.
+        primary_exc = sys.exc_info()[0] is not None
+        saves_durable = True
+        try:
+            ckpt.wait_for_saves()
+        except Exception as exc:
+            saves_durable = False
+            if not primary_exc:
+                raise
+            logger.error(f"final checkpoint wait failed: {exc!r}")
+    if saves_durable:
+        # completed run with every epoch checkpoint durable: any leftover
+        # emergency checkpoint is strictly dominated — clean it up. (If the
+        # final write failed, the emergency checkpoints stay: they may be
+        # the most-advanced restorable state.)
+        ckpt.prune_mid_checkpoints(cfg.OUT_DIR, before_epoch=cfg.OPTIM.MAX_EPOCH)
     return state, best_acc1
 
 

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Standalone kill-at-step-k / resume check (debugging aid for the
+fault-tolerance layer, docs/FAULT_TOLERANCE.md).
+
+Runs the same scenario as
+tests/test_resilience.py::test_kill_at_step_k_resume_is_bitwise_identical but
+outside pytest, with the phases spelled out and timed, so a failing resume
+can be bisected interactively:
+
+    python scripts/run_resilience_check.py [--preempt-step N] [--epochs E]
+
+Phase 1: uninterrupted tiny DUMMY_INPUT run  → reference params
+Phase 2: identical run, injected SIGTERM at global step N → emergency ckpt
+Phase 3: relaunch with auto-resume            → must match phase 1 bitwise
+
+Exit code 0 iff final params are bitwise identical and checkpoint names
+match. Self-pins to a virtual 8-device CPU mesh (cpu_mesh_run-style
+bootstrap), so it runs anywhere.
+"""
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distribuuuu_tpu.runtime.compile_cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+
+import numpy as np  # noqa: E402
+import flax.linen as nn  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from distribuuuu_tpu import config, resilience, trainer  # noqa: E402
+from distribuuuu_tpu import checkpoint as ckpt  # noqa: E402
+from distribuuuu_tpu.models import list_models, register_model  # noqa: E402
+
+if "resil_check_tiny" not in list_models():
+
+    class _Tiny(nn.Module):
+        num_classes: int = 4
+
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = nn.Conv(4, (3, 3), use_bias=False, dtype=jnp.float32)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            return nn.Dense(self.num_classes)(nn.relu(x).mean(axis=(1, 2)))
+
+    @register_model("resil_check_tiny")
+    def resil_check_tiny(num_classes, dtype, bn_axis_name=None, remat=False):
+        return _Tiny(num_classes=num_classes)
+
+
+def configure(out_dir: str, epochs: int) -> None:
+    config.reset_cfg()
+    c = config.cfg
+    c.MODEL.ARCH = "resil_check_tiny"
+    c.MODEL.NUM_CLASSES = 4
+    c.MODEL.DTYPE = "float32"
+    c.MODEL.DUMMY_INPUT = True
+    c.TRAIN.BATCH_SIZE = 2
+    c.TRAIN.IM_SIZE = 8
+    c.TEST.IM_SIZE = 8
+    c.TEST.CROP_SIZE = 8
+    c.TEST.BATCH_SIZE = 2
+    c.TRAIN.DUMMY_EPOCH_SAMPLES = 64  # 4 steps/epoch on 8 devices
+    c.TRAIN.PRINT_FREQ = 1
+    c.OPTIM.MAX_EPOCH = epochs
+    c.OPTIM.WARMUP_EPOCHS = 0
+    c.RNG_SEED = 5
+    c.FAULT.HANDLE_SIGNALS = False
+    c.OUT_DIR = out_dir
+
+
+def leaves(state):
+    return [np.asarray(x) for x in jax.tree.leaves(jax.device_get(state.params))]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--preempt-step", type=int, default=5,
+                    help="global step to inject the simulated SIGTERM before")
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--keep", action="store_true", help="keep scratch OUT_DIRs")
+    args = ap.parse_args()
+
+    scratch = tempfile.mkdtemp(prefix="dtpu_resilience_check_")
+    out_a, out_b = os.path.join(scratch, "a"), os.path.join(scratch, "b")
+    rc = 1
+    try:
+        t0 = time.time()
+        configure(out_a, args.epochs)
+        state_a, best_a = trainer.train_model()
+        print(f"[1/3] uninterrupted run done in {time.time() - t0:.1f}s "
+              f"(best {best_a:.2f})")
+
+        t0 = time.time()
+        configure(out_b, args.epochs)
+        config.cfg.FAULT.INJECT_PREEMPT_STEP = args.preempt_step
+        try:
+            trainer.train_model()
+            print("ERROR: run completed without being preempted "
+                  f"(is --preempt-step {args.preempt_step} within the run?)")
+            return 1
+        except SystemExit as e:
+            print(f"[2/3] preempted (exit {e.code}) at "
+                  f"{resilience.RUN_STATS.preempted_at} in {time.time() - t0:.1f}s; "
+                  f"mid ckpts: {[(ep, s) for ep, s, _ in ckpt._mid_checkpoints(out_b)]}")
+
+        t0 = time.time()
+        configure(out_b, args.epochs)
+        state_b, best_b = trainer.train_model()
+        print(f"[3/3] resumed run done in {time.time() - t0:.1f}s (best {best_b:.2f})")
+
+        mismatches = sum(
+            not np.array_equal(a, b) for a, b in zip(leaves(state_a), leaves(state_b))
+        )
+        names_a = sorted(os.listdir(os.path.join(out_a, "checkpoints")))
+        names_b = sorted(os.listdir(os.path.join(out_b, "checkpoints")))
+        if mismatches == 0 and names_a == names_b:
+            print(f"PASS: params bitwise identical, checkpoint names match ({names_a})")
+            rc = 0
+        else:
+            print(f"FAIL: {mismatches} param leaves differ; "
+                  f"names a={names_a} b={names_b}")
+    finally:
+        if args.keep:
+            print(f"scratch kept at {scratch}")
+        else:
+            shutil.rmtree(scratch, ignore_errors=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,12 @@ from distribuuuu_tpu.runtime.compile_cache import enable_persistent_cache  # noq
 
 enable_persistent_cache()
 
+# Older jax runtimes: install the jax.shard_map alias before any test (or the
+# package) touches it.
+from distribuuuu_tpu.runtime.compat import ensure_jax_compat  # noqa: E402
+
+ensure_jax_compat()
+
 import pytest  # noqa: E402
 
 

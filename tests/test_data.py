@@ -148,14 +148,30 @@ def test_consumer_abort_terminates_producer(image_root):
     assert threading.active_count() <= before
 
 
-def test_producer_exception_propagates(image_root, monkeypatch):
-    """A corrupt image must fail the epoch loudly, not truncate it silently."""
-    l = _mk_loader(image_root, 0, 1, host_batch=2)
+def test_producer_exception_propagates(image_root, monkeypatch, fresh_cfg):
+    """A corrupt image: substituted under the fault-tolerance default
+    (FAULT.DEGRADE, masked weight-0 sample after retries), a loud epoch
+    failure with degradation off — never a silent truncation either way
+    (docs/FAULT_TOLERANCE.md). Eval loader: identity order, so the corrupt
+    sample is deterministically consumed."""
+    from distribuuuu_tpu import resilience
+
+    fresh_cfg.FAULT.RETRY_ATTEMPTS = 2
+    fresh_cfg.FAULT.RETRY_BASE_DELAY = 0.001
+    resilience.reset_run_stats()
+    l = _mk_loader(image_root, 0, 1, train=False, host_batch=2)
     bad_path = l.dataset.samples[0][0]
     open(bad_path, "wb").write(b"not a jpeg")
     try:
+        batches = list(l)  # degraded, not fatal: full epoch, one masked slot
+        assert len(batches) == len(l)
+        assert resilience.RUN_STATS.substituted_samples == 1
+        total_w = sum(float(b["weight"].sum()) for b in batches)
+        assert total_w == len(l.dataset) - 1  # only the bad sample is masked
+
+        fresh_cfg.FAULT.DEGRADE = False
         with pytest.raises(RuntimeError, match="data loader worker failed"):
-            list(l)
+            list(_mk_loader(image_root, 0, 1, train=False, host_batch=2))
     finally:
         Image.new("RGB", (40, 50)).save(bad_path)
 

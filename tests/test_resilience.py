@@ -1,0 +1,619 @@
+"""Fault-tolerance layer (docs/FAULT_TOLERANCE.md), exercised on CPU via
+deterministic fault injection.
+
+The resume contract tests are the strongest ones here: a run preempted at
+step k (injected SIGTERM) must, after relaunch, produce *bitwise-identical*
+final params and the same checkpoint names as a never-interrupted run — the
+whole point of step-granular emergency checkpoints. The non-finite guard,
+retryable I/O, corrupt-checkpoint fallback and producer-thread exception
+paths are each pinned separately.
+"""
+
+import os
+import queue
+import signal
+import threading
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+from PIL import Image
+
+from distribuuuu_tpu import checkpoint as ckpt
+from distribuuuu_tpu import resilience, trainer
+from distribuuuu_tpu.data.loader import HostDataLoader
+from distribuuuu_tpu.models import list_models, register_model
+from distribuuuu_tpu.runtime import data_mesh
+from distribuuuu_tpu.trainer import TrainState, create_train_state, make_train_step
+
+# ---------------------------------------------------------------------------
+# A conv+BN+fc arch small enough for in-process train_model runs in tier-1
+# ---------------------------------------------------------------------------
+
+if "resil_tiny" not in list_models():
+
+    class _ResilTiny(nn.Module):
+        num_classes: int = 4
+
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = nn.Conv(4, (3, 3), use_bias=False, dtype=jnp.float32)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            x = nn.relu(x).mean(axis=(1, 2))
+            return nn.Dense(self.num_classes)(x)
+
+    @register_model("resil_tiny")
+    def resil_tiny(num_classes, dtype, bn_axis_name=None, remat=False):
+        return _ResilTiny(num_classes=num_classes)
+
+
+def _tiny_run_cfg(c, out_dir, max_epoch=3):
+    """4 steps/epoch DUMMY_INPUT recipe on the tiny arch (seconds per run)."""
+    c.MODEL.ARCH = "resil_tiny"
+    c.MODEL.NUM_CLASSES = 4
+    c.MODEL.DTYPE = "float32"
+    c.MODEL.DUMMY_INPUT = True
+    c.TRAIN.BATCH_SIZE = 2
+    c.TRAIN.IM_SIZE = 8
+    c.TEST.IM_SIZE = 8
+    c.TEST.CROP_SIZE = 8
+    c.TEST.BATCH_SIZE = 2
+    c.TRAIN.DUMMY_EPOCH_SAMPLES = 64  # // (2 * 8 devices) = 4 steps/epoch
+    c.TRAIN.PRINT_FREQ = 1
+    c.OPTIM.MAX_EPOCH = max_epoch
+    c.OPTIM.WARMUP_EPOCHS = 0
+    c.RNG_SEED = 5
+    c.FAULT.HANDLE_SIGNALS = False  # keep process signal state test-local
+    c.OUT_DIR = str(out_dir)
+    return c
+
+
+def _param_leaves(state):
+    # np.array (copy!) not np.asarray: on CPU device_get returns zero-copy
+    # views of the device buffer, which the donated step updates in place —
+    # an uncopied "snapshot" would silently track the live state
+    return [np.array(x) for x in jax.tree.leaves(jax.device_get(state.params))]
+
+
+def _opt_leaves(state):
+    return [np.array(x) for x in jax.tree.leaves(jax.device_get(state.opt_state))]
+
+
+@pytest.fixture(autouse=True)
+def _reset_resilience():
+    resilience.reset_run_stats()
+    resilience.clear_preemption()
+    yield
+    resilience.clear_preemption()
+    resilience.uninstall_preemption_handler()
+
+
+# ---------------------------------------------------------------------------
+# retry()
+# ---------------------------------------------------------------------------
+
+def test_retry_succeeds_after_transient_failures():
+    calls, delays = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert resilience.retry(flaky, attempts=5, base_delay=0.01, sleep=delays.append) == "ok"
+    assert len(calls) == 3 and len(delays) == 2
+    # full jitter: each delay within the exponential envelope
+    assert 0.0 <= delays[0] <= 0.01 and 0.0 <= delays[1] <= 0.02
+
+
+def test_retry_exhaustion_reraises_last_error():
+    def always():
+        raise OSError("persistent")
+
+    with pytest.raises(OSError, match="persistent"):
+        resilience.retry(always, attempts=3, base_delay=0.0, sleep=lambda _: None)
+
+
+def test_retry_does_not_catch_outside_retry_on():
+    def bad():
+        raise KeyError("not retryable")
+
+    with pytest.raises(KeyError):
+        resilience.retry(bad, attempts=3, base_delay=0.0, sleep=lambda _: None)
+
+
+def test_retry_delay_envelope_capped_by_max_delay():
+    delays = []
+
+    def always():
+        raise OSError("x")
+
+    with pytest.raises(OSError):
+        resilience.retry(
+            always, attempts=6, base_delay=1.0, max_delay=2.0, sleep=delays.append
+        )
+    assert len(delays) == 5 and all(d <= 2.0 for d in delays)
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faultinject
+def test_injector_io_counting_and_env_override(fresh_cfg, monkeypatch):
+    inj = resilience.FaultInjector(io_indices=[3], io_failures=2, nan_steps=[7], preempt_step=9)
+    for _ in range(2):
+        with pytest.raises(resilience.InjectedIOError):
+            inj.maybe_fail_io(3)
+    inj.maybe_fail_io(3)  # budget spent: passes now
+    inj.maybe_fail_io(4)  # un-targeted index never fails
+    assert inj.is_nan_step(7) and not inj.is_nan_step(8)
+    assert inj.should_preempt(9) and not inj.should_preempt(10)
+
+    monkeypatch.setenv("DTPU_FAULT_IO_INDICES", "1, 2")
+    monkeypatch.setenv("DTPU_FAULT_NAN_STEPS", "5")
+    monkeypatch.setenv("DTPU_FAULT_PREEMPT_STEP", "11")
+    env_inj = resilience.FaultInjector()
+    assert env_inj.io_indices == {1, 2}
+    assert env_inj.nan_steps == {5}
+    assert env_inj.preempt_step == 11 and env_inj.active
+
+
+def test_injector_inert_by_default(fresh_cfg):
+    inj = resilience.FaultInjector()
+    assert not inj.active
+    inj.maybe_fail_io(0)
+    assert not inj.is_nan_step(0) and not inj.should_preempt(0)
+
+
+# ---------------------------------------------------------------------------
+# Preemption signal handling
+# ---------------------------------------------------------------------------
+
+def test_sigterm_sets_preemption_flag():
+    assert resilience.install_preemption_handler((signal.SIGTERM,))
+    assert not resilience.preemption_requested()
+    os.kill(os.getpid(), signal.SIGTERM)
+    # the Python-level handler runs between bytecodes; give it a beat
+    for _ in range(100):
+        if resilience.preemption_requested():
+            break
+    assert resilience.preemption_requested()
+    # first signal restored the previous handler (second-signal-kills policy)
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+
+
+def test_preempted_exit_code_tracks_signal():
+    """128+signum when a signal triggered the preemption (130 = operator
+    Ctrl-C, which supervisors must NOT auto-relaunch), 143 otherwise."""
+    resilience.request_preemption("test", signum=signal.SIGINT)
+    assert resilience.Preempted().code == 130
+    resilience.clear_preemption()
+    resilience.request_preemption("injected")  # no signal: scheduler-style 143
+    assert resilience.Preempted().code == 143
+
+
+def test_handler_not_installable_off_main_thread():
+    results = []
+    t = threading.Thread(target=lambda: results.append(resilience.install_preemption_handler()))
+    t.start()
+    t.join()
+    assert results == [False]
+
+
+# ---------------------------------------------------------------------------
+# Non-finite guard (unit: jitted step level)
+# ---------------------------------------------------------------------------
+
+class _GuardCNN(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(4, (3, 3), use_bias=False, dtype=jnp.float32)(x)
+        x = nn.BatchNorm(use_running_average=not train)(x)
+        return nn.Dense(4)(nn.relu(x).mean(axis=(1, 2)))
+
+
+def _dev_batch(mesh, image):
+    n = image.shape[0]
+    return {
+        "image": jax.device_put(image, NamedSharding(mesh, P("data", None, None, None))),
+        "label": jax.device_put(
+            (np.arange(n) % 4).astype(np.int32), NamedSharding(mesh, P("data"))
+        ),
+        "weight": jax.device_put(np.ones(n, np.float32), NamedSharding(mesh, P("data"))),
+    }
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return data_mesh(-1)
+
+
+def test_guard_skips_nonfinite_step_and_reports(fresh_cfg, mesh):
+    model = _GuardCNN()
+    state, tx = create_train_state(model, jax.random.PRNGKey(0), mesh, 8)
+    p0 = _param_leaves(state)
+    o0 = _opt_leaves(state)
+    step = make_train_step(model, tx, mesh, topk=2, nonfinite_guard=True)
+    nan_img = np.full((16, 8, 8, 3), np.nan, np.float32)
+    state, m = step(state, _dev_batch(mesh, nan_img), jnp.float32(0.1), jax.random.PRNGKey(1))
+    m = jax.device_get(m)
+    assert m["skipped"] == 1.0
+    # a skipped step contributes nothing to the epoch averages
+    assert m["n"] == 0.0 and m["loss_sum"] == 0.0 and m["correct1"] == 0.0
+    # params, opt state and BN stats pass through bit-identically
+    for a, b in zip(p0, _param_leaves(state)):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(o0, _opt_leaves(state)):
+        np.testing.assert_array_equal(a, b)
+
+    # a good step afterwards applies normally (skipped flag clears)
+    good = np.random.default_rng(0).standard_normal((16, 8, 8, 3)).astype(np.float32)
+    state, m = step(state, _dev_batch(mesh, good), jnp.float32(0.1), jax.random.PRNGKey(2))
+    m = jax.device_get(m)
+    assert m["skipped"] == 0.0 and m["n"] == 16.0
+    assert any(
+        not np.array_equal(a, b) for a, b in zip(p0, _param_leaves(state))
+    ), "good step must update params"
+
+
+def test_guard_off_lets_nan_poison_params(fresh_cfg, mesh):
+    model = _GuardCNN()
+    state, tx = create_train_state(model, jax.random.PRNGKey(0), mesh, 8)
+    step = make_train_step(model, tx, mesh, topk=2, nonfinite_guard=False)
+    nan_img = np.full((16, 8, 8, 3), np.nan, np.float32)
+    state, m = step(state, _dev_batch(mesh, nan_img), jnp.float32(0.1), jax.random.PRNGKey(1))
+    assert "skipped" not in jax.device_get(m)
+    assert any(np.isnan(x).any() for x in _param_leaves(state))
+
+
+def test_guard_is_bitexact_noop_on_finite_steps(fresh_cfg, mesh):
+    """Zero-fault byte-identity: the guarded step's selected values equal the
+    unguarded step's exactly, so enabling the fault layer changes no
+    checkpoint bytes (acceptance criterion)."""
+    model = _GuardCNN()
+    img = np.random.default_rng(1).standard_normal((16, 8, 8, 3)).astype(np.float32)
+    outs = []
+    for guard in (True, False):
+        state, tx = create_train_state(model, jax.random.PRNGKey(0), mesh, 8)
+        step = make_train_step(model, tx, mesh, topk=2, nonfinite_guard=guard)
+        for i in range(3):
+            state, _ = step(
+                state, _dev_batch(mesh, img), jnp.float32(0.1), jax.random.PRNGKey(i)
+            )
+        outs.append(_param_leaves(state) + _opt_leaves(state))
+    for a, b in zip(*outs):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Loader: retry, graceful substitution, producer exception propagation
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def mini_imagefolder(tmp_path_factory):
+    root = tmp_path_factory.mktemp("mini")
+    rng = np.random.default_rng(0)
+    for cls in ("a", "b"):
+        d = root / "val" / cls
+        d.mkdir(parents=True)
+        for i in range(6):
+            arr = rng.integers(0, 255, (12, 12, 3)).astype(np.uint8)
+            Image.fromarray(arr).save(d / f"{i}.jpg", quality=95)
+    return str(root / "val")
+
+
+def _mini_loader(root, injector=None, host_batch=4, train=False, start_batch=0):
+    from distribuuuu_tpu.data.dataset import open_image_dataset
+
+    loader = HostDataLoader(
+        open_image_dataset(root),
+        host_batch=host_batch,
+        train=train,
+        im_size=12,
+        process_index=0,
+        process_count=1,
+        workers=2,
+        seed=0,
+        crop_size=8,
+        injector=injector,
+    )
+    loader.set_epoch(0, start_batch=start_batch)
+    return loader
+
+
+@pytest.mark.faultinject
+def test_loader_retries_transient_io_to_identical_batches(fresh_cfg, mini_imagefolder):
+    fresh_cfg.FAULT.RETRY_BASE_DELAY = 0.001
+    clean = list(_mini_loader(mini_imagefolder))
+    inj = resilience.FaultInjector(io_indices=[1, 5], io_failures=1, nan_steps=[], preempt_step=-1)
+    faulty = list(_mini_loader(mini_imagefolder, injector=inj))
+    assert resilience.RUN_STATS.retries >= 2
+    assert resilience.RUN_STATS.substituted_samples == 0
+    assert len(clean) == len(faulty)
+    for cb, fb in zip(clean, faulty):
+        np.testing.assert_array_equal(cb["image"], fb["image"])
+        np.testing.assert_array_equal(cb["label"], fb["label"])
+        np.testing.assert_array_equal(cb["weight"], fb["weight"])
+
+
+@pytest.mark.faultinject
+def test_loader_substitutes_sample_that_fails_all_retries(fresh_cfg, mini_imagefolder):
+    fresh_cfg.FAULT.RETRY_ATTEMPTS = 2
+    fresh_cfg.FAULT.RETRY_BASE_DELAY = 0.001
+    inj = resilience.FaultInjector(io_indices=[2], io_failures=-1, nan_steps=[], preempt_step=-1)
+    batches = list(_mini_loader(mini_imagefolder, injector=inj))
+    assert resilience.RUN_STATS.substituted_samples == 1
+    # eval order is the identity permutation: sample 2 is slot 2 of batch 0
+    b0 = batches[0]
+    assert b0["weight"][2] == 0.0  # masked: contributes nothing to metrics
+    np.testing.assert_array_equal(b0["image"][2], np.zeros_like(b0["image"][2]))
+    assert all(b["weight"].sum() == len(b["weight"]) for b in batches[1:])
+
+
+@pytest.mark.faultinject
+def test_loader_train_substitution_uses_neighbor_sample(fresh_cfg, mini_imagefolder):
+    """Train substitution must duplicate a real neighboring sample, not feed
+    a black class-0 image into the (unweighted) train loss."""
+    fresh_cfg.FAULT.RETRY_ATTEMPTS = 2
+    fresh_cfg.FAULT.RETRY_BASE_DELAY = 0.001
+    inj = resilience.FaultInjector(io_indices=[4], io_failures=-1, nan_steps=[], preempt_step=-1)
+    batches = list(_mini_loader(mini_imagefolder, injector=inj, train=True))
+    assert resilience.RUN_STATS.substituted_samples == 1
+    # no masked slots and no injected black image: every slot is a real draw
+    assert all(float(b["weight"].min()) == 1.0 for b in batches)
+    assert all(int(b["image"].sum(axis=(1, 2, 3)).min()) > 0 for b in batches)
+
+
+@pytest.mark.faultinject
+def test_loader_train_fails_loudly_when_neighbors_also_fail(fresh_cfg, mini_imagefolder):
+    """A corrupt region (sample + all fallback neighbors unreadable) must
+    fail a train epoch loudly — there is no masked way to degrade an
+    unweighted train loss."""
+    fresh_cfg.FAULT.RETRY_ATTEMPTS = 1
+    fresh_cfg.FAULT.RETRY_BASE_DELAY = 0.001
+    inj = resilience.FaultInjector(
+        io_indices=list(range(12)), io_failures=-1, nan_steps=[], preempt_step=-1
+    )
+    with pytest.raises(RuntimeError, match="data loader worker failed"):
+        list(_mini_loader(mini_imagefolder, injector=inj, train=True))
+    assert resilience.RUN_STATS.substituted_samples == 0  # nothing silently fed
+
+
+@pytest.mark.faultinject
+def test_loader_failure_is_fatal_with_degrade_off(fresh_cfg, mini_imagefolder):
+    fresh_cfg.FAULT.DEGRADE = False
+    fresh_cfg.FAULT.RETRY_ATTEMPTS = 2
+    fresh_cfg.FAULT.RETRY_BASE_DELAY = 0.001
+    inj = resilience.FaultInjector(io_indices=[0], io_failures=-1, nan_steps=[], preempt_step=-1)
+    with pytest.raises(RuntimeError, match="data loader worker failed"):
+        list(_mini_loader(mini_imagefolder, injector=inj))
+
+
+def test_loader_keyboardinterrupt_propagates_as_itself(fresh_cfg, mini_imagefolder):
+    """Control-flow exceptions from worker threads must not be laundered into
+    RuntimeError, and the producer must be reaped (no thread leak)."""
+    loader = _mini_loader(mini_imagefolder)
+    boom_count = [0]
+    orig = loader._load_one_raw
+
+    def boom(idx, slot_seed):
+        boom_count[0] += 1
+        raise KeyboardInterrupt
+
+    loader._load_one_raw = boom
+    before = {t.ident for t in threading.enumerate()}
+    with pytest.raises(KeyboardInterrupt):
+        list(loader)
+    leaked = [
+        t for t in threading.enumerate()
+        if t.ident not in before and t.is_alive() and "ThreadPoolExecutor" not in repr(t)
+    ]
+    assert not leaked, leaked
+    # the loader remains usable afterwards
+    loader._load_one_raw = orig
+    assert len(list(loader)) == len(loader)
+
+
+def test_loader_start_batch_fast_forward(fresh_cfg, mini_imagefolder):
+    """set_epoch(start_batch=k) replays exactly the tail of the epoch —
+    the step-granular resume contract at the loader level."""
+    full = list(_mini_loader(mini_imagefolder))
+    tail = list(_mini_loader(mini_imagefolder, start_batch=2))
+    assert len(tail) == len(full) - 2
+    for fb, tb in zip(full[2:], tail):
+        np.testing.assert_array_equal(fb["image"], tb["image"])
+        np.testing.assert_array_equal(fb["label"], tb["label"])
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint: mid-epoch saves, resume ordering, corrupt fallback
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def tiny_state():
+    params = {"w": jnp.arange(4.0), "b": jnp.zeros((2,))}
+    opt_state = {"momentum": {"w": jnp.ones(4), "b": jnp.zeros(2)}}
+    return TrainState(params=params, batch_stats={"m": jnp.zeros(3)}, opt_state=opt_state)
+
+
+def test_mid_checkpoint_roundtrip(tmp_path, tiny_state):
+    out = str(tmp_path)
+    rng_key = jax.random.PRNGKey(42)
+    path = ckpt.save_mid_checkpoint(out, epoch=2, step=17, state=tiny_state,
+                                    best_acc1=33.0, rng_key=rng_key)
+    assert path.endswith("ckpt_mid_ep_002_it_000017")
+    ckpt.wait_for_saves()
+    blank = jax.tree.map(jnp.zeros_like, tiny_state)
+    st, epoch, step, best, rng = ckpt.load_mid_checkpoint(path, blank)
+    assert (epoch, step, best) == (2, 17, 33.0)
+    np.testing.assert_array_equal(np.asarray(st.params["w"]), np.arange(4.0))
+    np.testing.assert_array_equal(np.asarray(st.opt_state["momentum"]["w"]), np.ones(4))
+    np.testing.assert_array_equal(rng, np.asarray(jax.device_get(rng_key)))
+
+
+def test_restore_latest_prefers_most_advanced_position(tmp_path, tiny_state):
+    out = str(tmp_path)
+    blank = jax.tree.map(jnp.zeros_like, tiny_state)
+    rng_key = jax.random.PRNGKey(0)
+
+    # epoch ckpts 1..2 (epochs 0,1 complete) + mid ckpt inside epoch 2
+    ckpt.save_checkpoint(out, 0, tiny_state, best_acc1=1.0, is_best=False)
+    ckpt.save_checkpoint(out, 1, tiny_state, best_acc1=2.0, is_best=False)
+    ckpt.save_mid_checkpoint(out, epoch=2, step=5, state=tiny_state,
+                             best_acc1=2.0, rng_key=rng_key)
+    ckpt.wait_for_saves()
+    res = ckpt.restore_latest(out, blank)
+    assert res is not None
+    _, epoch, step, _, rng, path = res
+    assert (epoch, step) == (2, 5) and rng is not None
+    assert path.endswith("ckpt_mid_ep_002_it_000005")
+
+    # a complete checkpoint for that epoch outranks the mid ckpt
+    ckpt.save_checkpoint(out, 2, tiny_state, best_acc1=3.0, is_best=False)
+    ckpt.wait_for_saves()
+    res = ckpt.restore_latest(out, blank)
+    _, epoch, step, best, rng, path = res
+    assert (epoch, step, best) == (3, 0, 3.0) and rng is None
+    assert path.endswith("ckpt_ep_003")
+
+    # step_granular=False ignores mid ckpts entirely
+    res = ckpt.restore_latest(out, blank, step_granular=False)
+    assert res[5].endswith("ckpt_ep_003")
+
+
+def test_restore_latest_skips_corrupt_highest(tmp_path, tiny_state, caplog):
+    """A corrupt/partial highest checkpoint must not wedge the restart loop:
+    warn, fall back to the next-highest (satellite bugfix)."""
+    import logging as _logging
+    import shutil
+
+    out = str(tmp_path)
+    blank = jax.tree.map(jnp.zeros_like, tiny_state)
+    ckpt.save_checkpoint(out, 0, tiny_state, best_acc1=7.0, is_best=False)
+    ckpt.save_checkpoint(out, 1, tiny_state, best_acc1=8.0, is_best=False)
+    ckpt.wait_for_saves()
+    # corrupt the highest: an empty directory with a valid checkpoint name
+    # (what a crash mid-finalize can leave on some filesystems)
+    top = ckpt.get_checkpoint_path(out, 2)
+    shutil.rmtree(top)
+    os.makedirs(top)
+
+    from distribuuuu_tpu.logging import logger as dtpu_logger
+
+    with caplog.at_level(_logging.WARNING, logger=dtpu_logger.name):
+        dtpu_logger.propagate = True
+        try:
+            res = ckpt.restore_latest(out, blank)
+        finally:
+            dtpu_logger.propagate = False
+    assert res is not None
+    st, epoch, step, best, _, path = res
+    assert path.endswith("ckpt_ep_001") and (epoch, step, best) == (1, 0, 7.0)
+    np.testing.assert_array_equal(np.asarray(st.params["w"]), np.arange(4.0))
+    assert any("failed to restore" in r.message for r in caplog.records)
+
+    # nothing restorable at all → None (caller falls through to fresh init)
+    shutil.rmtree(ckpt.get_checkpoint_path(out, 1))
+    os.makedirs(ckpt.get_checkpoint_path(out, 1))
+    shutil.rmtree(ckpt.get_checkpoint_path(out, 1 + 1), ignore_errors=True)
+    empty_res = ckpt.restore_latest(str(tmp_path / "nothing"), blank)
+    assert empty_res is None
+
+
+def test_prune_mid_checkpoints(tmp_path, tiny_state):
+    out = str(tmp_path)
+    rng_key = jax.random.PRNGKey(0)
+    for e, s in ((0, 3), (1, 2), (2, 9)):
+        ckpt.save_mid_checkpoint(out, e, s, tiny_state, 0.0, rng_key)
+    ckpt.wait_for_saves()
+    ckpt.prune_mid_checkpoints(out, before_epoch=2)
+    remaining = [(e, s) for e, s, _ in ckpt._mid_checkpoints(out)]
+    assert remaining == [(2, 9)]
+
+
+# ---------------------------------------------------------------------------
+# Provisioning retry wiring
+# ---------------------------------------------------------------------------
+
+def test_provision_retries_transient_errors(fresh_cfg, tmp_path, monkeypatch):
+    from distribuuuu_tpu.data import provision
+
+    fresh_cfg.FAULT.RETRY_BASE_DELAY = 0.001
+    calls = []
+
+    def flaky_materialize(root, marker, stamp, *a, **kw):
+        calls.append(1)
+        if len(calls) == 1:
+            raise OSError("disk hiccup")
+        os.makedirs(root, exist_ok=True)
+        with open(marker, "w") as f:
+            f.write(stamp)
+
+    monkeypatch.setattr(provision, "_materialize", flaky_materialize)
+    root = str(tmp_path / "digits")
+    assert provision.digits_imagefolder(root) == root
+    assert len(calls) == 2 and resilience.RUN_STATS.retries >= 1
+
+
+# ---------------------------------------------------------------------------
+# End-to-end resume contract (the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faultinject
+def test_kill_at_step_k_resume_is_bitwise_identical(fresh_cfg, tmp_path):
+    """Preempt (injected SIGTERM) at global step 5 — mid epoch 1 of 3 — then
+    relaunch: the resumed run must finish with final params bitwise-equal to
+    an uninterrupted run and write the same checkpoint names, with the
+    emergency checkpoint pruned once dominated."""
+    from distribuuuu_tpu import config
+
+    # uninterrupted reference
+    _tiny_run_cfg(fresh_cfg, tmp_path / "a")
+    state_a, best_a = trainer.train_model()
+    leaves_a = _param_leaves(state_a)
+
+    # interrupted at global step 5 (epoch 1, step 1)
+    config.reset_cfg()
+    c = _tiny_run_cfg(config.cfg, tmp_path / "b")
+    c.FAULT.INJECT_PREEMPT_STEP = 5
+    with pytest.raises(SystemExit) as ei:
+        trainer.train_model()
+    assert ei.value.code == 143
+    assert resilience.RUN_STATS.preempted_at == (1, 1)
+    mids = ckpt._mid_checkpoints(str(tmp_path / "b"))
+    assert [(e, s) for e, s, _ in mids] == [(1, 1)]
+
+    # relaunch (injection cleared) resumes step-granularly and completes
+    config.reset_cfg()
+    _tiny_run_cfg(config.cfg, tmp_path / "b")
+    state_b, best_b = trainer.train_model()
+    for a, b in zip(leaves_a, _param_leaves(state_b)):
+        np.testing.assert_array_equal(a, b)
+    assert best_b == best_a
+    names_a = sorted(os.listdir(tmp_path / "a" / "checkpoints"))
+    names_b = sorted(os.listdir(tmp_path / "b" / "checkpoints"))
+    assert names_a == names_b  # emergency ckpt pruned once dominated
+
+
+@pytest.mark.faultinject
+def test_nan_steps_are_skipped_and_reported(fresh_cfg, tmp_path):
+    c = _tiny_run_cfg(fresh_cfg, tmp_path / "out", max_epoch=2)
+    c.FAULT.INJECT_NAN_STEPS = [1]
+    state, _ = trainer.train_model()
+    assert resilience.RUN_STATS.skipped_steps[0] == 1
+    assert resilience.RUN_STATS.skipped_steps[1] == 0
+    assert all(np.isfinite(x).all() for x in _param_leaves(state))
+
+
+@pytest.mark.faultinject
+def test_consecutive_nonfinite_steps_abort(fresh_cfg, tmp_path):
+    c = _tiny_run_cfg(fresh_cfg, tmp_path / "out", max_epoch=1)
+    c.FAULT.INJECT_NAN_STEPS = [0, 1, 2, 3]
+    c.FAULT.MAX_CONSECUTIVE_SKIPS = 2
+    with pytest.raises(resilience.NonFiniteDivergence, match="consecutive non-finite"):
+        trainer.train_model()

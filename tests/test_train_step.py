@@ -108,8 +108,10 @@ def test_grad_pmean_equals_global_batch_grad(fresh_cfg, mesh):
     batch = _batch(n=16)
 
     state, tx = create_train_state(model, jax.random.PRNGKey(0), mesh, 8)
-    init_params = jax.device_get(state.params)  # snapshot: step() donates state
-    init_stats = jax.device_get(state.batch_stats)
+    # snapshot COPIES: step() donates state, and on CPU device_get returns
+    # zero-copy views of the device buffer that the donated update mutates
+    init_params = jax.tree.map(np.array, jax.device_get(state.params))
+    init_stats = jax.tree.map(np.array, jax.device_get(state.batch_stats))
     step = make_train_step(model, tx, mesh, topk=2)
     new_state, _ = step(
         state, _device_batch(batch, mesh), jnp.float32(1.0), jax.random.PRNGKey(0)
@@ -335,7 +337,8 @@ def test_train_step_with_lamb(fresh_cfg, mesh):
     model = TinyCNN()
     batch = _batch(n=16)
     state, tx = create_train_state(model, jax.random.PRNGKey(0), mesh, 8)
-    p0 = jax.device_get(state.params)
+    # copy, not view: the donated step would mutate a bare device_get on CPU
+    p0 = jax.tree.map(np.array, jax.device_get(state.params))
     step = make_train_step(model, tx, mesh, topk=2)
     for i in range(2):
         state, m = step(
