@@ -1,0 +1,56 @@
+"""dtpu-lint — JAX-aware static analysis + runtime guards for the hot path.
+
+The paper's value proposition is a training loop whose speed comes from
+keeping every step on-device; in the JAX rebuild the equivalent purity is
+*trace hygiene*: no hidden host syncs, no silent recompilation, no PRNG key
+reuse, no PartitionSpec that doesn't match a declared mesh axis. Generic
+linters cannot express any of these — a stray ``.item()`` in a step loop is
+perfectly legal Python — so this package carries the rules the framework
+actually lives or dies by.
+
+Two halves:
+
+* **Static** (`lint_paths`, ``python -m distribuuuu_tpu.analysis`` /
+  ``dtpu-lint``): an AST pass with six JAX-specific rules (DT001–DT006, one
+  module each under :mod:`distribuuuu_tpu.analysis.rules`), inline
+  ``# dtpu-lint: disable=...`` suppressions, and a committed-baseline
+  mechanism for grandfathered findings (:mod:`.baseline`).
+* **Runtime** (:mod:`.guards`): :class:`CompileGuard` asserts an exact
+  compile count over a region (a training epoch must compile its step
+  exactly once) and :class:`TransferGuard` wraps ``jax.transfer_guard`` so
+  tests can pin that host transfers happen only at PRINT_FREQ boundaries.
+
+See docs/STATIC_ANALYSIS.md for the rule catalog and CI wiring.
+"""
+
+from __future__ import annotations
+
+from distribuuuu_tpu.analysis.baseline import Baseline, load_baseline, write_baseline
+from distribuuuu_tpu.analysis.core import (
+    Finding,
+    all_rules,
+    lint_file,
+    lint_paths,
+    lint_sources,
+)
+from distribuuuu_tpu.analysis.guards import (
+    CompileGuard,
+    CompileGuardError,
+    TransferGuard,
+    allow_transfers,
+)
+
+__all__ = [
+    "Baseline",
+    "CompileGuard",
+    "CompileGuardError",
+    "Finding",
+    "TransferGuard",
+    "all_rules",
+    "allow_transfers",
+    "lint_file",
+    "lint_paths",
+    "lint_sources",
+    "load_baseline",
+    "write_baseline",
+]

@@ -1,0 +1,155 @@
+"""CLI: ``python -m distribuuuu_tpu.analysis`` / ``dtpu-lint``.
+
+    dtpu-lint distribuuuu_tpu/ scripts/ tests/            # lint, exit 1 on findings
+    dtpu-lint --write-baseline ...                        # grandfather current tree
+    dtpu-lint --no-baseline ...                           # full findings, baseline off
+    dtpu-lint --select DT001,DT005 ...                    # subset of rules
+    dtpu-lint --list-rules                                # rule catalog
+    dtpu-lint --format json ...                           # machine-readable
+
+The baseline file defaults to ``.dtpu-lint-baseline.json`` in the current
+directory when it exists (the committed repo-root convention); pass
+``--baseline PATH`` to point elsewhere. Exit codes: 0 clean (baselined
+findings allowed), 1 findings beyond the baseline, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from distribuuuu_tpu.analysis.baseline import (
+    DEFAULT_BASELINE,
+    load_baseline,
+    normalize_paths,
+    write_baseline,
+)
+from distribuuuu_tpu.analysis.core import all_rules, lint_paths
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="dtpu-lint",
+        description="JAX-aware static analysis for the distribuuuu-tpu hot path",
+    )
+    ap.add_argument("paths", nargs="*", help="files or directories to lint")
+    ap.add_argument(
+        "--baseline",
+        default=None,
+        help=f"baseline file (default: ./{DEFAULT_BASELINE} when present)",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true", help="ignore any baseline file"
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write the current findings as the new baseline and exit 0",
+    )
+    ap.add_argument(
+        "--select", default=None, help="comma-separated rule codes (e.g. DT001,DT005)"
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-rules", action="store_true", help="print the rule catalog")
+    return ap
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for r in all_rules():
+            fix = " [autofixable]" if r["autofixable"] else ""
+            print(f"{r['code']}{fix}: {r['summary']}")
+        return 0
+
+    if not args.paths:
+        print("dtpu-lint: no paths given (try: dtpu-lint distribuuuu_tpu/)", file=sys.stderr)
+        return 2
+
+    select = None
+    if args.select:
+        select = {c.strip().upper() for c in args.select.split(",") if c.strip()}
+        if args.write_baseline:
+            # a select-filtered write would silently drop every other rule's
+            # grandfathered entries and fail the next full run
+            print(
+                "dtpu-lint: refusing --write-baseline with --select "
+                "(would discard the unselected rules' baseline entries)",
+                file=sys.stderr,
+            )
+            return 2
+
+    try:
+        findings = lint_paths(args.paths, select=select)
+    except OSError as exc:
+        print(f"dtpu-lint: {exc}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline
+    if baseline_path is None and os.path.exists(DEFAULT_BASELINE):
+        baseline_path = DEFAULT_BASELINE
+
+    # fingerprints must be invocation-independent: anchor paths to the
+    # baseline file's directory (absolute inputs, odd cwds — same hashes)
+    anchor = os.path.dirname(os.path.abspath(baseline_path or DEFAULT_BASELINE))
+    findings = normalize_paths(findings, anchor)
+
+    if args.write_baseline:
+        path = baseline_path or DEFAULT_BASELINE
+        write_baseline(path, findings)
+        print(f"dtpu-lint: wrote {len(findings)} finding(s) to {path}")
+        return 0
+
+    stale: list[dict] = []
+    new = findings
+    if baseline_path and not args.no_baseline:
+        try:
+            new, stale = load_baseline(baseline_path).apply(findings)
+        except (OSError, ValueError, KeyError) as exc:
+            print(f"dtpu-lint: bad baseline {baseline_path}: {exc}", file=sys.stderr)
+            return 2
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [
+                        {
+                            "path": f.path,
+                            "line": f.line,
+                            "col": f.col + 1,
+                            "code": f.code,
+                            "message": f.message,
+                            "autofixable": f.autofixable,
+                        }
+                        for f in new
+                    ],
+                    "baselined": len(findings) - len(new),
+                    "stale_baseline_entries": stale,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in new:
+            print(f.render())
+        n_base = len(findings) - len(new)
+        summary = f"dtpu-lint: {len(new)} finding(s)"
+        if n_base:
+            summary += f" ({n_base} baselined)"
+        print(summary, file=sys.stderr)
+        for entry in stale:
+            print(
+                f"dtpu-lint: stale baseline entry {entry.get('code')} "
+                f"{entry.get('path')} ({entry.get('line_text', '')!r}) — fixed? "
+                "regenerate with --write-baseline",
+                file=sys.stderr,
+            )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

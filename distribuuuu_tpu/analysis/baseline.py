@@ -1,0 +1,118 @@
+"""Committed-baseline mechanism for grandfathered findings.
+
+The linter must be adoptable on a tree with existing findings without
+blanket-disabling rules: a committed JSON file records each grandfathered
+finding by *fingerprint* (path + rule code + normalized source line — NOT
+the line number, so unrelated edits above a finding don't churn it) with a
+multiplicity count. At lint time baselined findings are subtracted; anything
+beyond the recorded count fails, so the mechanism un-suppresses the moment
+a baselined line is duplicated or a new instance appears. Stale entries
+(recorded but no longer found) are reported so the file shrinks over time —
+``--write-baseline`` regenerates it from the current tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from collections import Counter
+from dataclasses import dataclass, field
+
+from distribuuuu_tpu.analysis.core import Finding
+
+DEFAULT_BASELINE = ".dtpu-lint-baseline.json"
+_VERSION = 1
+
+
+def normalize_paths(findings: list[Finding], root: str) -> list[Finding]:
+    """Rewrite finding paths relative to ``root`` (the baseline file's
+    directory) so fingerprints are invocation-independent: ``dtpu-lint
+    /abs/path/tests`` and ``dtpu-lint tests`` must hash identically or the
+    committed baseline resurfaces every finding when run from elsewhere.
+    Paths outside ``root`` are left as given."""
+    root = os.path.abspath(root)
+    out = []
+    for f in findings:
+        rel = os.path.relpath(os.path.abspath(f.path), root)
+        if not rel.startswith(".."):
+            f = dataclasses.replace(f, path=rel.replace(os.sep, "/"))
+        out.append(f)
+    return out
+
+
+@dataclass
+class Baseline:
+    """Fingerprint -> allowed count, plus display metadata per entry."""
+
+    counts: Counter = field(default_factory=Counter)
+    meta: dict[str, dict] = field(default_factory=dict)
+
+    @classmethod
+    def from_findings(cls, findings: list[Finding]) -> "Baseline":
+        b = cls()
+        for f in findings:
+            fp = f.fingerprint()
+            b.counts[fp] += 1
+            b.meta.setdefault(
+                fp, {"path": f.path, "code": f.code, "line_text": f.line_text.strip()}
+            )
+        return b
+
+    def apply(self, findings: list[Finding]) -> tuple[list[Finding], list[dict]]:
+        """(new findings beyond the baseline, stale baseline entries)."""
+        remaining = Counter(self.counts)
+        new: list[Finding] = []
+        for f in findings:
+            fp = f.fingerprint()
+            if remaining.get(fp, 0) > 0:
+                remaining[fp] -= 1
+            else:
+                new.append(f)
+        stale = [
+            dict(self.meta.get(fp, {}), fingerprint=fp, count=cnt)
+            for fp, cnt in sorted(remaining.items())
+            if cnt > 0
+        ]
+        return new, stale
+
+
+def load_baseline(path: str) -> Baseline:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != _VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version {data.get('version')!r} "
+            f"(expected {_VERSION}); regenerate with --write-baseline"
+        )
+    b = Baseline()
+    for entry in data.get("findings", []):
+        fp = entry["fingerprint"]
+        b.counts[fp] += int(entry.get("count", 1))
+        b.meta[fp] = {
+            "path": entry.get("path", "?"),
+            "code": entry.get("code", "?"),
+            "line_text": entry.get("line_text", ""),
+        }
+    return b
+
+
+def write_baseline(path: str, findings: list[Finding]) -> Baseline:
+    b = Baseline.from_findings(findings)
+    entries = [
+        {
+            "fingerprint": fp,
+            "count": cnt,
+            "path": b.meta[fp]["path"],
+            "code": b.meta[fp]["code"],
+            "line_text": b.meta[fp]["line_text"],
+        }
+        for fp, cnt in sorted(b.counts.items(), key=lambda kv: (b.meta[kv[0]]["path"], kv[0]))
+    ]
+    payload = {"version": _VERSION, "findings": entries}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return b

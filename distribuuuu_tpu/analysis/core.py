@@ -1,0 +1,202 @@
+"""dtpu-lint core: findings, the rule registry, file walking, suppression.
+
+A rule module (see :mod:`distribuuuu_tpu.analysis.rules`) exports ``CODE``
+(``DTnnn``), ``AUTOFIXABLE`` (bool), and ``check(tree, model, ctx) ->
+list[Finding]``. Rules never read files themselves — linting is a pure
+function of parsed sources, so the test corpus can feed snippets directly
+(:func:`lint_sources`).
+
+Two-pass protocol: pass 1 lets rules with cross-file context collect it
+(today only DT005's mesh-axis census, via the optional module hook
+``collect(tree, ctx)``); pass 2 runs every ``check``. Suppression is
+line-anchored: ``# dtpu-lint: disable=DT001[,DT002]`` (or ``# noqa: DT001``)
+on the finding's line or the line above kills the finding at the source; the
+committed baseline (:mod:`.baseline`) grandfathers the rest.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import re
+from dataclasses import dataclass, field
+
+from distribuuuu_tpu.analysis.rules import RULE_MODULES
+from distribuuuu_tpu.analysis.rules.common import ModuleModel
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*(?:dtpu-lint:\s*disable=|noqa:\s*)(?P<codes>DT\d{3}(?:\s*,\s*DT\d{3})*)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+    autofixable: bool = False
+    line_text: str = ""
+
+    def fingerprint(self) -> str:
+        """Stable identity for baselining: path + rule + normalized line text
+        (NOT the line number, so pure line moves don't churn the baseline)."""
+        h = hashlib.sha256(
+            f"{self.path}::{self.code}::{self.line_text.strip()}".encode()
+        ).hexdigest()
+        return h[:16]
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1} {self.code} {self.message}"
+
+
+@dataclass
+class LintContext:
+    """Cross-file state threaded through both passes."""
+
+    known_axes: set[str] = field(default_factory=set)
+    axis_declarations: dict[str, list[str]] = field(default_factory=dict)
+
+
+def all_rules() -> list[dict]:
+    """Rule catalog: code, one-line summary, autofixable flag, module."""
+    out = []
+    for mod in RULE_MODULES:
+        doc = (mod.__doc__ or "").strip().splitlines()
+        out.append(
+            {
+                "code": mod.CODE,
+                "summary": doc[0] if doc else "",
+                "autofixable": mod.AUTOFIXABLE,
+                "module": mod.__name__,
+            }
+        )
+    return out
+
+
+def _suppressed_lines(src: str) -> dict[int, set[str]]:
+    """line number -> set of rule codes disabled on that line."""
+    out: dict[int, set[str]] = {}
+    for i, text in enumerate(src.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            codes = {c.strip() for c in m.group("codes").split(",")}
+            out.setdefault(i, set()).update(codes)
+            # a bare suppression comment line also covers the line below
+            if text.lstrip().startswith("#"):
+                out.setdefault(i + 1, set()).update(codes)
+    return out
+
+
+def _apply_suppressions(findings: list[Finding], src: str) -> list[Finding]:
+    table = _suppressed_lines(src)
+    if not table:
+        return findings
+    kept = []
+    for f in findings:
+        codes = table.get(f.line, set())
+        if f.code not in codes:
+            kept.append(f)
+    return kept
+
+
+def _parse(path: str, src: str) -> tuple[ast.AST | None, Finding | None]:
+    try:
+        return ast.parse(src, filename=path), None
+    except SyntaxError as exc:
+        return None, Finding(
+            path=path,
+            line=exc.lineno or 1,
+            col=(exc.offset or 1) - 1,
+            code="DTERR",
+            message=f"syntax error: {exc.msg}",
+        )
+
+
+def lint_sources(sources: dict[str, str], select: set[str] | None = None) -> list[Finding]:
+    """Lint an in-memory ``{path: source}`` mapping (the test-corpus entry
+    point; also what :func:`lint_paths` bottoms out in).
+
+    Both passes see ALL files, so DT005's axis census spans the whole run
+    exactly like the CLI over ``distribuuuu_tpu/ scripts/ tests/``.
+    """
+    ctx = LintContext()
+    parsed: dict[str, tuple[ast.AST | None, str, Finding | None]] = {}
+    for path, src in sources.items():
+        tree, err = _parse(path, src)
+        parsed[path] = (tree, src, err)
+        if tree is None:
+            continue
+        for mod in RULE_MODULES:
+            collect = getattr(mod, "collect", None)
+            if collect is not None:
+                collect(tree, ctx)
+
+    findings: list[Finding] = []
+    for path, (tree, src, err) in parsed.items():
+        if err is not None:
+            findings.append(err)
+            continue
+        assert tree is not None
+        model = ModuleModel(tree)
+        lines = src.splitlines()
+        file_findings: list[Finding] = []
+        for mod in RULE_MODULES:
+            if select and mod.CODE not in select:
+                continue
+            for f in mod.check(tree, model, ctx):
+                text = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+                file_findings.append(
+                    Finding(
+                        path=path,
+                        line=f.line,
+                        col=f.col,
+                        code=f.code,
+                        message=f.message,
+                        autofixable=f.autofixable,
+                        line_text=text,
+                    )
+                )
+        findings.extend(_apply_suppressions(file_findings, src))
+    # dedup: rules that analyze nested scopes can visit a node twice
+    unique: dict[tuple, Finding] = {}
+    for f in findings:
+        unique.setdefault((f.path, f.line, f.col, f.code), f)
+    findings = sorted(
+        unique.values(), key=lambda f: (f.path, f.line, f.col, f.code)
+    )
+    return findings
+
+
+def lint_file(path: str, select: set[str] | None = None) -> list[Finding]:
+    with open(path, encoding="utf-8") as fh:
+        return lint_sources({path: fh.read()}, select=select)
+
+
+def iter_python_files(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs if d not in {"__pycache__", ".git", ".ruff_cache"}
+            )
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    out.append(os.path.join(root, name))
+    return out
+
+
+def lint_paths(paths: list[str], select: set[str] | None = None) -> list[Finding]:
+    """Lint files/directories from disk (the CLI entry point)."""
+    sources: dict[str, str] = {}
+    for path in iter_python_files(paths):
+        with open(path, encoding="utf-8") as fh:
+            sources[os.path.normpath(path)] = fh.read()
+    return lint_sources(sources, select=select)

@@ -1,0 +1,161 @@
+"""Runtime guards: pin compile counts and transfer discipline in tests.
+
+The static rules catch what the AST shows; these two context managers pin
+the *dynamic* invariants the framework's speed rests on:
+
+* :class:`CompileGuard` — "one training epoch compiles the step exactly
+  once". Two counting modes: given a jitted function it uses the function's
+  own compile-cache size delta (``fn._cache_size()`` — exact retraces of
+  *that* function, immune to unrelated compiles and to the persistent
+  on-disk XLA cache serving the binary without a trace); without one it
+  counts every backend compile in the region via the ``jax.monitoring``
+  duration listener for ``/jax/core/compile/backend_compile_duration``
+  (cache-miss hook — right for "this warm region compiles nothing").
+* :class:`TransferGuard` — a wrapper over ``jax.transfer_guard`` that makes
+  the trainer's contract testable: under ``"disallow"`` every *implicit*
+  transfer raises (a numpy batch leaking straight into a jitted call, the
+  classic hidden H2D) while the loader's explicit ``device_put`` /
+  ``make_array_from_process_local_data`` and the PRINT_FREQ
+  ``jax.device_get`` boundary fetches stay legal. ``explicit_also=True``
+  escalates to ``"disallow_explicit"`` for regions that must do no
+  transfers at all.
+
+Both raise on exit (guards must not mask the body's own exception — if the
+body raised, the count check is skipped).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class CompileGuardError(AssertionError):
+    """Compile count over a guarded region violated the declared bound."""
+
+
+class CompileGuard:
+    """Assert an exact (or bounded) number of XLA compiles over a region.
+
+    ``with CompileGuard(train_step, exact=1): ...`` — fn mode, counts
+    retraces of ``train_step`` only (its compile-cache size delta).
+    ``with CompileGuard(exact=0): ...`` — global mode, counts every backend
+    compile dispatched in the region on this thread's process.
+
+    Parameters: ``exact`` pins the count; ``max_compiles`` bounds it from
+    above (both may be given; ``exact`` wins). ``.compiles`` holds the
+    measured count after exit.
+    """
+
+    def __init__(
+        self,
+        fn=None,
+        *,
+        exact: int | None = None,
+        max_compiles: int | None = None,
+        name: str | None = None,
+    ):
+        if exact is None and max_compiles is None:
+            raise ValueError("CompileGuard needs exact= or max_compiles=")
+        if fn is not None and not hasattr(fn, "_cache_size"):
+            raise TypeError(
+                f"CompileGuard(fn=...) needs a jitted callable with _cache_size(); "
+                f"got {type(fn).__name__} — pass the jax.jit result, not the python fn"
+            )
+        self._fn = fn
+        self._exact = exact
+        self._max = max_compiles
+        self._name = name or (getattr(fn, "__name__", None) if fn is not None else None)
+        self._start_cache = 0
+        self._event_count = 0
+        self._lock = threading.Lock()
+        self._active = False
+        self.compiles: int | None = None
+
+    # -- monitoring listener (global mode) ----------------------------------
+
+    def _listener(self, event: str, duration: float, **kwargs) -> None:
+        if event != _COMPILE_EVENT or not self._active:
+            return
+        with self._lock:
+            self._event_count += 1
+
+    def __enter__(self) -> "CompileGuard":
+        self.compiles = None
+        if self._fn is not None:
+            self._start_cache = self._fn._cache_size()
+        else:
+            self._event_count = 0
+            self._active = True
+            jax.monitoring.register_event_duration_secs_listener(self._listener)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._fn is not None:
+            self.compiles = self._fn._cache_size() - self._start_cache
+        else:
+            self._active = False
+            self.compiles = self._event_count
+            try:  # private in this jax version; the _active flag above is the fallback
+                from jax._src import monitoring as _m
+
+                _m._unregister_event_duration_listener_by_callback(self._listener)
+            except Exception:
+                pass
+        if exc_type is not None:
+            return False  # never mask the body's own failure
+        label = f" for `{self._name}`" if self._name else ""
+        if self._exact is not None and self.compiles != self._exact:
+            raise CompileGuardError(
+                f"CompileGuard{label}: expected exactly {self._exact} compile(s) "
+                f"in the guarded region, measured {self.compiles} — an unexpected "
+                "retrace usually means a shape/dtype or static-arg changed per "
+                "call (see DT003 in docs/STATIC_ANALYSIS.md)"
+            )
+        if self._max is not None and self._exact is None and self.compiles > self._max:
+            raise CompileGuardError(
+                f"CompileGuard{label}: {self.compiles} compile(s) exceeds "
+                f"max_compiles={self._max}"
+            )
+        return False
+
+
+class TransferGuard:
+    """``jax.transfer_guard`` with the framework's vocabulary.
+
+    ``with TransferGuard(): ...`` disallows *implicit* transfers (hidden
+    host syncs / numpy-into-jit H2D) while leaving explicit
+    ``device_put``/``device_get`` legal — the trainer's steady-state
+    contract. ``TransferGuard(explicit_also=True)`` forbids explicit ones
+    too (a region that must stay entirely on device). ``level`` accepts the
+    native jax levels ("allow", "log", "disallow") for log-first adoption.
+    """
+
+    def __init__(self, level: str = "disallow", *, explicit_also: bool = False):
+        if level not in {"allow", "log", "disallow"}:
+            raise ValueError(f"TransferGuard level must be allow/log/disallow, got {level!r}")
+        if explicit_also and level == "allow":
+            raise ValueError("explicit_also=True is meaningless with level='allow'")
+        self._level = f"{level}_explicit" if explicit_also else level
+        self._cm = None
+
+    def __enter__(self) -> "TransferGuard":
+        self._cm = jax.transfer_guard(self._level)
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        cm, self._cm = self._cm, None
+        return bool(cm.__exit__(exc_type, exc, tb))
+
+
+@contextlib.contextmanager
+def allow_transfers():
+    """Whitelisted sync point inside a :class:`TransferGuard` region — the
+    programmatic analog of the PRINT_FREQ boundary."""
+    with jax.transfer_guard("allow"):
+        yield

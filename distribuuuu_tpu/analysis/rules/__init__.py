@@ -1,0 +1,29 @@
+"""Rule registry for dtpu-lint.
+
+Each rule is one module exporting ``CODE`` (``DTnnn``), ``AUTOFIXABLE``, a
+``check(tree, model, ctx)`` pass, and optionally a cross-file
+``collect(tree, ctx)`` pre-pass. Adding a rule = adding a module here and
+appending it to ``RULE_MODULES`` (docs/STATIC_ANALYSIS.md walks through it).
+"""
+
+from __future__ import annotations
+
+from distribuuuu_tpu.analysis.rules import (
+    dt001_host_sync,
+    dt002_prng,
+    dt003_recompile,
+    dt004_donation,
+    dt005_sharding,
+    dt006_timing,
+)
+
+RULE_MODULES = [
+    dt001_host_sync,
+    dt002_prng,
+    dt003_recompile,
+    dt004_donation,
+    dt005_sharding,
+    dt006_timing,
+]
+
+__all__ = ["RULE_MODULES"]

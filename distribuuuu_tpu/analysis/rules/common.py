@@ -1,0 +1,398 @@
+"""Shared AST plumbing for dtpu-lint rules.
+
+Every rule works on the same per-file picture, built once here:
+
+* name resolution for the handful of jax modules the rules care about
+  (``jax.random``, ``jax.sharding.PartitionSpec`` aliases, ``time``);
+* a :class:`ModuleModel` that infers which local names are *device
+  dispatchers* (bound from ``jax.jit``, from a local factory whose return
+  statement is a ``jax.jit`` call, or simply named like a step function) and
+  which names hold *device values* (bound from a dispatcher call) vs. *host
+  values* (bound from ``jax.device_get``);
+* parent links, statement-order position keys, and the loop/sync-region
+  queries DT001/DT006 share.
+
+The inference is deliberately intra-module and conservative: a name the
+model cannot see bound is never flagged. False negatives are acceptable —
+the committed baseline plus CompileGuard/TransferGuard at runtime catch the
+rest — false positives on the real tree are not.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class RawFinding:
+    """What a rule emits; core attaches the path and source-line text."""
+
+    line: int
+    col: int
+    code: str
+    message: str
+    autofixable: bool = False
+
+# Callee names treated as device dispatch even without visible jit binding:
+# the framework's step functions follow this naming convention everywhere
+# (train_step/eval_step/one_step/step), including when they arrive as
+# function parameters the intra-module model cannot trace.
+DISPATCH_NAME_RE = re.compile(r"(^|_)step($|_)")
+
+# jax.random functions whose first positional argument is a PRNG key.
+KEY_CONSUMERS = frozenset(
+    {
+        "split",
+        "fold_in",
+        "normal",
+        "uniform",
+        "bernoulli",
+        "randint",
+        "permutation",
+        "choice",
+        "categorical",
+        "gumbel",
+        "truncated_normal",
+        "bits",
+        "beta",
+        "dirichlet",
+        "exponential",
+        "gamma",
+        "laplace",
+        "poisson",
+        "shuffle",
+    }
+)
+
+SYNC_FUNCS = frozenset({"device_get", "block_until_ready"})
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``jax.random.split``-style dotted name for a Name/Attribute chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> str | None:
+    """Trailing identifier of the callee (``a.b.f(...)`` → ``f``)."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def pos_key(node: ast.AST) -> tuple[int, int]:
+    return (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+
+
+def is_jit_call(node: ast.AST) -> bool:
+    """``jax.jit(...)`` / ``jit(...)`` / ``pjit(...)`` construction."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted(node.func)
+    return name in {"jax.jit", "jit", "pjit", "jax.pjit"} or (
+        name is not None and name.endswith(".jit")
+    )
+
+
+def is_shard_map_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    return (call_name(node) or "") in {"shard_map", "smap"}
+
+
+def donate_argnums_of(call: ast.Call) -> tuple[int, ...] | None:
+    """Literal ``donate_argnums`` of a jit call, or None when absent/opaque."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for e in v.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    out.append(e.value)
+                else:
+                    return None
+            return tuple(out)
+        return None
+    return None
+
+
+def assign_target_names(stmt: ast.AST) -> set[str]:
+    """All plain names bound by an Assign/AugAssign/AnnAssign/For target."""
+    names: set[str] = set()
+
+    def collect(t: ast.AST) -> None:
+        if isinstance(t, ast.Name):
+            names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                collect(e)
+        elif isinstance(t, ast.Starred):
+            collect(t.value)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            collect(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        collect(stmt.target)
+    elif isinstance(stmt, ast.For):
+        collect(stmt.target)
+    elif isinstance(stmt, ast.withitem) and stmt.optional_vars is not None:
+        collect(stmt.optional_vars)
+    return names
+
+
+def names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class ParentMap:
+    """Child → parent links plus ancestor queries."""
+
+    def __init__(self, tree: ast.AST):
+        self._parent: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parent[child] = parent
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return self._parent.get(node)
+
+    def ancestors(self, node: ast.AST):
+        cur = self._parent.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parent.get(cur)
+
+    def enclosing_statement(self, node: ast.AST) -> ast.stmt | None:
+        cur: ast.AST | None = node
+        while cur is not None:
+            parent = self._parent.get(cur)
+            if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)) or (
+                parent is not None
+                and isinstance(cur, ast.stmt)
+                and hasattr(parent, "body")
+            ):
+                if isinstance(cur, ast.stmt):
+                    return cur
+            cur = parent
+        return None
+
+
+def _walk_skipping_nested_defs(fn: ast.AST):
+    """Yield descendants of a function def without entering nested defs."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _jax_random_aliases(tree: ast.AST) -> tuple[set[str], set[str]]:
+    """(module aliases for jax.random, bare names imported from it)."""
+    mod_aliases = {"jax.random"}
+    bare: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.random" and a.asname:
+                    mod_aliases.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "random":
+                        mod_aliases.add(a.asname or "random")
+            elif node.module == "jax.random":
+                for a in node.names:
+                    bare.add(a.asname or a.name)
+    return mod_aliases, bare
+
+
+def _partition_spec_aliases(tree: ast.AST) -> set[str]:
+    names = {"PartitionSpec"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in {
+            "jax.sharding",
+            "jax.experimental.pjit",
+        }:
+            for a in node.names:
+                if a.name == "PartitionSpec":
+                    names.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            continue
+    return names
+
+
+@dataclass
+class ModuleModel:
+    """Intra-module inference shared by the rules. Built once per file."""
+
+    tree: ast.AST
+    parents: ParentMap = field(init=False)
+    # name -> donate_argnums (possibly empty tuple) for names bound to jitted
+    # callables; None donate means "jitted, donation unknown/absent".
+    jit_bound: dict[str, tuple[int, ...] | None] = field(default_factory=dict)
+    # local factory def name -> donate_argnums of the jit call it returns
+    factories: dict[str, tuple[int, ...] | None] = field(default_factory=dict)
+    # device/host value names are tracked PER enclosing function scope: a
+    # `m = device_get(m)` in one test function must not host-launder `m`
+    # in every other function of the module.
+    scope_device: dict[ast.AST, set[str]] = field(default_factory=dict)
+    scope_host: dict[ast.AST, set[str]] = field(default_factory=dict)
+    jax_random_modules: set[str] = field(default_factory=set)
+    jax_random_bare: set[str] = field(default_factory=set)
+    pspec_names: set[str] = field(default_factory=set)
+
+    def __post_init__(self) -> None:
+        self.parents = ParentMap(self.tree)
+        self.jax_random_modules, self.jax_random_bare = _jax_random_aliases(self.tree)
+        self.pspec_names = _partition_spec_aliases(self.tree)
+        self._collect_factories()
+        self._collect_bindings()
+
+    # -- inference -----------------------------------------------------------
+
+    def _collect_factories(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # only returns lexically belonging to THIS function: an outer
+            # function merely containing a nested jit-returning helper is
+            # not itself a factory (its own return value is something else)
+            for ret in _walk_skipping_nested_defs(node):
+                if isinstance(ret, ast.Return) and is_jit_call(ret.value):
+                    self.factories[node.name] = donate_argnums_of(ret.value)
+                    break
+
+    def _collect_bindings(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            targets = assign_target_names(node)
+            if is_jit_call(call):
+                donate = donate_argnums_of(call)
+                for t in targets:
+                    self.jit_bound[t] = donate
+                continue
+            callee = call_name(call)
+            if callee in self.factories:
+                for t in targets:
+                    self.jit_bound[t] = self.factories[callee]
+                continue
+            scope = self.enclosing_function(node) or self.tree
+            device = self.scope_device.setdefault(scope, set())
+            host = self.scope_host.setdefault(scope, set())
+            if callee in SYNC_FUNCS:
+                host.update(targets)
+                device.difference_update(targets)
+                continue
+            if self.is_dispatch_call(call):
+                for t in targets:
+                    if t not in host:
+                        device.add(t)
+
+    # -- queries -------------------------------------------------------------
+
+    def is_dispatch_call(self, call: ast.Call) -> bool:
+        """Call that launches device work: jit-bound name or step-named."""
+        callee = call_name(call)
+        if callee is None:
+            return False
+        return callee in self.jit_bound or bool(DISPATCH_NAME_RE.search(callee))
+
+    def is_jax_random_call(self, call: ast.Call) -> str | None:
+        """Canonical jax.random function name for this call, else None."""
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            mod = dotted(f.value)
+            if mod in self.jax_random_modules and (
+                f.attr in KEY_CONSUMERS or f.attr == "PRNGKey" or f.attr == "key"
+            ):
+                return f.attr
+        elif isinstance(f, ast.Name) and f.id in self.jax_random_bare:
+            return f.id
+        return None
+
+    def enclosing_function(self, node: ast.AST):
+        for anc in self.parents.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def references_device_value(self, node: ast.AST) -> bool:
+        scope = self.enclosing_function(node) or self.tree
+        return bool(names_in(node) & self.scope_device.get(scope, set()))
+
+    def in_sync_region(self, node: ast.AST) -> bool:
+        """Inside an ``if`` that gates a periodic boundary.
+
+        Recognized boundary tests: any modulo comparison (``it % freq == 0``
+        — the PRINT_FREQ pattern) and last-iteration checks
+        (``it == len(loader) - 1`` / ``it == n_batches - 1``).
+        """
+        for anc in self.parents.ancestors(node):
+            if isinstance(anc, ast.If) and _is_boundary_test(anc.test):
+                return True
+        return False
+
+    def enclosing_loop(self, node: ast.AST) -> ast.For | ast.While | None:
+        for anc in self.parents.ancestors(node):
+            if isinstance(anc, (ast.For, ast.While)):
+                return anc
+        return None
+
+    def is_step_loop(self, loop: ast.For | ast.While) -> bool:
+        """A loop that drives device steps: dispatch call in the body, or a
+        For over something loader/prefetch-shaped."""
+        if isinstance(loop, ast.For):
+            for n in ast.walk(loop.iter):
+                if isinstance(n, ast.Call):
+                    cn = call_name(n) or ""
+                    if re.search(r"loader|prefetch|batches", cn, re.IGNORECASE):
+                        return True
+                elif isinstance(n, ast.Name) and re.search(
+                    r"loader|batches", n.id, re.IGNORECASE
+                ):
+                    return True
+        for n in ast.walk(loop):
+            if isinstance(n, ast.Call) and self.is_dispatch_call(n):
+                return True
+        return False
+
+
+def _is_boundary_test(test: ast.AST) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Mod):
+            return True
+        if isinstance(n, ast.Compare):
+            for comp in n.comparators:
+                if (
+                    isinstance(comp, ast.BinOp)
+                    and isinstance(comp.op, ast.Sub)
+                    and isinstance(comp.right, ast.Constant)
+                    and comp.right.value == 1
+                ):
+                    return True
+    return False
+
+
+def iter_functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node

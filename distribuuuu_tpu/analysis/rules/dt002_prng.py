@@ -1,0 +1,124 @@
+"""DT002: PRNG key discipline.
+
+JAX keys are values, not stateful generators; the two ways to corrupt a
+randomness stream are silent and bit-reproducible, which is what makes them
+linter material rather than test material:
+
+* **Key reuse after split.** ``k1, k2 = jax.random.split(key)`` consumes
+  ``key``; any later ``jax.random.*`` use of the parent draws correlated
+  samples with its children. Flagged unless the split rebinds the same name
+  (the ``key, sub = split(key)`` idiom). ``fold_in`` is deliberately NOT a
+  consumer: deriving many streams from one parent with distinct fold values
+  (``fold_in(fold_in(rng, epoch), it)`` — the trainer's pattern) is the
+  documented idiom.
+
+* **Literal seed inside a loop.** ``jax.random.PRNGKey(0)`` (or
+  ``jax.random.key(0)``) constructed in a loop body yields the *same*
+  stream every iteration — dropout that never varies, augmentation that
+  repeats. Keys must be created once and folded/split per step.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from distribuuuu_tpu.analysis.rules.common import (
+    ModuleModel,
+    RawFinding,
+    assign_target_names,
+    iter_functions,
+    pos_key,
+)
+
+CODE = "DT002"
+AUTOFIXABLE = False
+
+
+def check(tree: ast.AST, model: ModuleModel, ctx) -> list[RawFinding]:
+    findings: list[RawFinding] = []
+    findings.extend(_check_reuse_after_split(tree, model))
+    findings.extend(_check_literal_seed_in_loop(tree, model))
+    return findings
+
+
+def _check_reuse_after_split(tree: ast.AST, model: ModuleModel) -> list[RawFinding]:
+    findings: list[RawFinding] = []
+    for scope in iter_functions(tree):
+        # (key name, position, ids of the split call's own descendant nodes)
+        splits: list[tuple[str, tuple[int, int], set[int]]] = []
+        rebinds: dict[str, list[tuple[int, int]]] = {}
+        uses: list[tuple[str, int, tuple[int, int]]] = []
+        for node in ast.walk(scope):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.For)):
+                for t in assign_target_names(node):
+                    rebinds.setdefault(t, []).append(pos_key(node))
+            if not isinstance(node, ast.Call):
+                continue
+            fn = model.is_jax_random_call(node)
+            if fn is None:
+                continue
+            key_args = [a for a in node.args if isinstance(a, ast.Name)]
+            if fn == "split" and node.args and isinstance(node.args[0], ast.Name):
+                stmt = model.parents.enclosing_statement(node)
+                rebound = stmt is not None and node.args[0].id in assign_target_names(stmt)
+                if not rebound:
+                    own = {id(n) for n in ast.walk(node)}
+                    splits.append((node.args[0].id, pos_key(node), own))
+            for a in key_args:
+                uses.append((a.id, id(a), pos_key(a)))
+        for key_name, split_pos, own_nodes in splits:
+            for use_name, use_id, use_pos in uses:
+                if use_name != key_name or use_pos <= split_pos:
+                    continue
+                if use_id in own_nodes:
+                    continue  # the split call's own key argument
+                # a rebind between the split and the use resets the key
+                if any(
+                    split_pos < rb <= use_pos for rb in rebinds.get(key_name, [])
+                ):
+                    continue
+                findings.append(
+                    RawFinding(
+                        use_pos[0],
+                        use_pos[1],
+                        CODE,
+                        f"PRNG key `{key_name}` used after being consumed by "
+                        "`jax.random.split`; use one of the split results or "
+                        "rebind the name (`key, sub = split(key)`)",
+                    )
+                )
+                break  # one report per split is enough
+    return findings
+
+
+def _check_literal_seed_in_loop(tree: ast.AST, model: ModuleModel) -> list[RawFinding]:
+    findings: list[RawFinding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = model.is_jax_random_call(node)
+        if fn not in {"PRNGKey", "key"}:
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Constant)):
+            continue
+        if model.enclosing_loop(node) is None:
+            continue
+        # fold_in(PRNGKey(c), i) varies per iteration — the idiom this rule
+        # points people AT — so a literal key feeding fold_in is fine
+        if any(
+            isinstance(anc, ast.Call)
+            and model.is_jax_random_call(anc) == "fold_in"
+            for anc in model.parents.ancestors(node)
+        ):
+            continue
+        findings.append(
+            RawFinding(
+                node.lineno,
+                node.col_offset,
+                CODE,
+                f"`jax.random.{fn}({node.args[0].value!r})` inside a loop "
+                "creates the identical stream every iteration; hoist the key "
+                "and `fold_in` the loop index instead",
+            )
+        )
+    return findings

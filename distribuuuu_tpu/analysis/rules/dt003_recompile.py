@@ -1,0 +1,164 @@
+"""DT003: recompilation hazards.
+
+XLA compilation is cached on (function identity, abstract shapes/dtypes,
+static values). Three statically-detectable ways to defeat the cache or
+poison a trace:
+
+* **jit construction inside a loop** — ``jax.jit(f)`` in a loop body makes
+  a fresh callable (fresh cache) every iteration: guaranteed retrace +
+  recompile per step.
+* **jit-then-call in one expression** — ``jax.jit(lambda ...)(x)`` (or
+  ``jax.jit(local_fn, ...)(x)`` inside a function) keys the compile cache
+  on a function object that is recreated on every call of the enclosing
+  function: every call retraces. Hoist the jitted callable to module level
+  or cache it keyed on the non-hashable closure (see
+  ``trainer._recommit_fn`` for the pattern). Autofixable in principle
+  (hoist), hence the flag.
+* **host-varying argument** — passing ``time.time()`` / ``random.random()``
+  etc. directly to a jit-bound callable: if consumed as a Python scalar it
+  bakes a new constant into the trace per call (retrace every step); noisy
+  weak-type churn at best.
+* **print / f-string print inside traced code** — a ``print`` in a
+  function that is jitted or shard_mapped runs at trace time only (silent
+  after compile) or, applied to traced values, forces an abstract-value
+  format; either way it signals host logic where only traced ops belong.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from distribuuuu_tpu.analysis.rules.common import (
+    ModuleModel,
+    RawFinding,
+    call_name,
+    dotted,
+    is_jit_call,
+    is_shard_map_call,
+)
+
+CODE = "DT003"
+AUTOFIXABLE = True
+
+_HOST_VARYING = {
+    "time.time",
+    "time.perf_counter",
+    "time.monotonic",
+    "random.random",
+    "random.randint",
+    "random.uniform",
+}
+
+
+def check(tree: ast.AST, model: ModuleModel, ctx) -> list[RawFinding]:
+    findings: list[RawFinding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # (a) jit construction inside a loop BODY (the iter/test expression
+        # of a for/while evaluates once — constructing there is fine)
+        if is_jit_call(node) and _in_loop_body(node, model):
+            findings.append(
+                RawFinding(
+                    node.lineno,
+                    node.col_offset,
+                    CODE,
+                    "jit constructed inside a loop: a fresh callable (and "
+                    "compile cache) every iteration — hoist the jit out of "
+                    "the loop",
+                    autofixable=True,
+                )
+            )
+            continue
+        # (b) immediate jit-then-call
+        if isinstance(node.func, ast.Call) and is_jit_call(node.func):
+            findings.append(
+                RawFinding(
+                    node.lineno,
+                    node.col_offset,
+                    CODE,
+                    "jit(...)(...) in one expression: the compile cache is "
+                    "keyed on a function object recreated per call, so every "
+                    "call of the enclosing scope retraces — bind the jitted "
+                    "callable once (module level or a keyed cache)",
+                    autofixable=True,
+                )
+            )
+            continue
+        # (c) host-varying argument into a jit-bound callable
+        cn = call_name(node)
+        if cn in model.jit_bound:
+            for arg in node.args:
+                if isinstance(arg, ast.Call) and dotted(arg.func) in _HOST_VARYING:
+                    findings.append(
+                        RawFinding(
+                            arg.lineno,
+                            arg.col_offset,
+                            CODE,
+                            f"host-varying `{dotted(arg.func)}()` passed to "
+                            f"jitted `{cn}`: a fresh Python scalar per call "
+                            "retraces unless marked static/traced — pass a "
+                            "device array or use static_argnums deliberately",
+                        )
+                    )
+    findings.extend(_check_print_in_traced(tree, model))
+    return findings
+
+
+def _in_loop_body(node: ast.AST, model: ModuleModel) -> bool:
+    loop = model.enclosing_loop(node)
+    if loop is None:
+        return False
+    once = [loop.iter] if isinstance(loop, ast.For) else [loop.test]
+    node_ids = {id(n) for expr in once for n in ast.walk(expr)}
+    return id(node) not in node_ids
+
+
+def _traced_defs(tree: ast.AST, model: ModuleModel) -> list[ast.FunctionDef]:
+    """Defs that are jitted/shard_mapped: by decorator, or by name passed to
+    jax.jit / shard_map anywhere in the module."""
+    jitted_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and (is_jit_call(node) or is_shard_map_call(node)):
+            if node.args and isinstance(node.args[0], ast.Name):
+                jitted_names.add(node.args[0].id)
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name in jitted_names:
+            out.append(node)
+            continue
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = dotted(target) or ""
+            if name in {"jax.jit", "jit", "pjit"} or name.endswith(".jit"):
+                out.append(node)
+                break
+            # functools.partial(jax.jit, ...) decorators
+            if isinstance(dec, ast.Call) and (dotted(dec.func) or "").endswith("partial"):
+                if dec.args and (dotted(dec.args[0]) or "").endswith("jit"):
+                    out.append(node)
+                    break
+    return out
+
+
+def _check_print_in_traced(tree: ast.AST, model: ModuleModel) -> list[RawFinding]:
+    findings: list[RawFinding] = []
+    for fn in _traced_defs(tree, model):
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                findings.append(
+                    RawFinding(
+                        node.lineno,
+                        node.col_offset,
+                        CODE,
+                        f"`print` inside traced `{fn.name}` runs at trace time "
+                        "only; use jax.debug.print for per-step device values",
+                    )
+                )
+    return findings

@@ -1,0 +1,112 @@
+"""DT004: donation-after-use.
+
+``jax.jit(..., donate_argnums=...)`` hands the argument's device buffers to
+XLA for in-place reuse — after the call the Python name still points at an
+array whose storage may have been overwritten by the outputs. Reading it is
+undefined behavior that *usually works on CPU* and corrupts silently on
+TPU, which is exactly the profile of bug a static pass must catch.
+
+Detection (intra-module, linear): donated callables are names bound from a
+``jax.jit(..., donate_argnums=...)`` call or from a local factory whose
+return statement is one (the ``make_train_step`` pattern). At each call
+site, a plain-name argument in a donated position is *dead* after the
+statement unless the statement itself rebinds it (``state, m =
+train_step(state, ...)`` — the donation idiom). Any later load of a dead
+name in the same block flags, up to the first rebind.
+
+Known limitation (documented, deliberate): uses reachable only through a
+loop back-edge or an outer scope are not tracked — the runtime
+CompileGuard/donation tests cover those.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from distribuuuu_tpu.analysis.rules.common import (
+    ModuleModel,
+    RawFinding,
+    assign_target_names,
+    call_name,
+)
+
+CODE = "DT004"
+AUTOFIXABLE = False
+
+
+def check(tree: ast.AST, model: ModuleModel, ctx) -> list[RawFinding]:
+    donated_fns = {
+        name: argnums
+        for name, argnums in model.jit_bound.items()
+        if argnums  # non-empty tuple of donated positions
+    }
+    if not donated_fns:
+        return []
+    findings: list[RawFinding] = []
+    for block in _blocks(tree):
+        findings.extend(_check_block(block, donated_fns))
+    return findings
+
+
+def _blocks(tree: ast.AST):
+    """Every statement list in the module (function bodies, loop bodies...)."""
+    for node in ast.walk(tree):
+        for field in ("body", "orelse", "finalbody"):
+            stmts = getattr(node, field, None)
+            if isinstance(stmts, list) and stmts and isinstance(stmts[0], ast.stmt):
+                yield stmts
+
+
+def _donated_call(stmt: ast.stmt, donated_fns: dict) -> tuple[ast.Call, str, list[str]] | None:
+    """(call, fn name, donated plain-name args) when stmt top-level-calls a
+    donated function."""
+    value = None
+    if isinstance(stmt, ast.Assign):
+        value = stmt.value
+    elif isinstance(stmt, ast.Expr):
+        value = stmt.value
+    if not isinstance(value, ast.Call):
+        return None
+    fn = call_name(value)
+    if fn not in donated_fns:
+        return None
+    donated_names = []
+    for pos in donated_fns[fn]:
+        if pos < len(value.args) and isinstance(value.args[pos], ast.Name):
+            donated_names.append(value.args[pos].id)
+    if not donated_names:
+        return None
+    return value, fn, donated_names
+
+
+def _check_block(stmts: list[ast.stmt], donated_fns: dict) -> list[RawFinding]:
+    findings: list[RawFinding] = []
+    dead: dict[str, str] = {}  # name -> donating fn
+    for stmt in stmts:
+        hit = _donated_call(stmt, donated_fns)
+        rebound = assign_target_names(stmt)
+        # loads of currently-dead names anywhere in this statement
+        for name, fn in list(dead.items()):
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) and n.id == name:
+                    findings.append(
+                        RawFinding(
+                            n.lineno,
+                            n.col_offset,
+                            CODE,
+                            f"`{name}` read after its buffers were donated to "
+                            f"`{fn}` (donate_argnums); its storage may have "
+                            "been reused — use the returned value or drop the "
+                            "donation",
+                        )
+                    )
+                    dead.pop(name, None)
+                    break
+        for name in rebound:
+            dead.pop(name, None)
+        if hit is not None:
+            _, fn, names = hit
+            for name in names:
+                if name not in rebound:
+                    dead[name] = fn
+    return findings

@@ -1,0 +1,196 @@
+"""DT005: sharding lint — axis names and shard_map spec arity.
+
+A ``PartitionSpec("dta")`` typo or a collective over an axis the mesh does
+not declare fails at trace time *on the mesh that has the axis missing* —
+i.e. on the pod, hours into a queue, not on the laptop. Both halves of the
+failure are static:
+
+* **Axis-name census (cross-file).** Pass 1 collects every axis name the
+  scanned tree *declares*: dict keys passed to ``create_mesh`` (the
+  ``runtime/mesh.py`` entry point — ``data_mesh`` declares ``data`` there),
+  string tuples passed to ``Mesh(...)``/``axis_names=``, and string
+  defaults of ``axis_name``/``bn_axis_name`` parameters (a library function
+  defaulting to ``"seq"`` is declaring that axis's vocabulary). Pass 2
+  flags any ``PartitionSpec``/``P`` string and any ``axis_name=`` /
+  positional collective axis string that the census never saw.
+* **shard_map spec arity.** ``shard_map(f, in_specs=(...))`` where ``f``
+  is a local def or lambda: ``len(in_specs)`` must equal ``f``'s positional
+  arity — a mismatch is an immediate trace error on every backend, flagged
+  here with file/line instead of a 40-frame traceback.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from distribuuuu_tpu.analysis.rules.common import (
+    ModuleModel,
+    RawFinding,
+    call_name,
+    dotted,
+    is_shard_map_call,
+    pos_key,
+)
+
+CODE = "DT005"
+AUTOFIXABLE = False
+
+_COLLECTIVES = {
+    "pmean",
+    "psum",
+    "pmax",
+    "pmin",
+    "ppermute",
+    "all_to_all",
+    "axis_index",
+    "axis_size",
+    "all_gather",
+    "pswapaxes",
+    "psum_scatter",
+}
+_AXIS_KWARGS = {"axis_name", "bn_axis_name"}
+
+
+def _str_elts(node: ast.AST):
+    """String constants in a node that may be a str or (nested) tuple/list."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            yield from _str_elts(e)
+
+
+def collect(tree: ast.AST, ctx) -> None:
+    """Pass 1: harvest declared axis names into ``ctx.known_axes``."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            cn = call_name(node) or ""
+            # create_mesh({"data": -1, "seq": 4})
+            if cn in {"create_mesh", "create_hybrid_device_mesh"}:
+                for arg in node.args:
+                    if isinstance(arg, ast.Dict):
+                        for k in arg.keys:
+                            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                                ctx.known_axes.add(k.value)
+            # Mesh(devices, ("data", "model")) / axis_names=(...)
+            if cn == "Mesh":
+                if len(node.args) >= 2:
+                    for s in _str_elts(node.args[1]):
+                        ctx.known_axes.add(s.value)
+            for kw in node.keywords:
+                if kw.arg == "axis_names":
+                    for s in _str_elts(kw.value):
+                        ctx.known_axes.add(s.value)
+        # def f(..., axis_name: str = "seq"): library default declares "seq"
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            all_args = args.posonlyargs + args.args + args.kwonlyargs
+            defaults = list(args.defaults) + list(args.kw_defaults)
+            # align defaults to the tail of the arg list
+            tail = all_args[len(all_args) - len(defaults) :] if defaults else []
+            for a, d in zip(tail, defaults):
+                if (
+                    a is not None
+                    and d is not None
+                    and a.arg in _AXIS_KWARGS
+                    and isinstance(d, ast.Constant)
+                    and isinstance(d.value, str)
+                ):
+                    ctx.known_axes.add(d.value)
+
+
+def check(tree: ast.AST, model: ModuleModel, ctx) -> list[RawFinding]:
+    findings: list[RawFinding] = []
+    known = ctx.known_axes
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cn = call_name(node) or ""
+        # PartitionSpec("data", None, ...) strings
+        if isinstance(node.func, ast.Name) and node.func.id in model.pspec_names or (
+            dotted(node.func) or ""
+        ).endswith("PartitionSpec"):
+            for arg in node.args:
+                for s in _str_elts(arg):
+                    if known and s.value not in known:
+                        findings.append(_unknown_axis(s, s.value, "PartitionSpec"))
+            continue
+        # collectives: positional axis string or axis_name kwarg.
+        # axis_index/axis_size take the axis name as their FIRST argument;
+        # the value-carrying collectives take it second.
+        if cn in _COLLECTIVES:
+            start = 0 if cn in {"axis_index", "axis_size"} else 1
+            for arg in node.args[start:]:
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    if known and arg.value not in known:
+                        findings.append(_unknown_axis(arg, arg.value, cn))
+        for kw in node.keywords:
+            if kw.arg in _AXIS_KWARGS and isinstance(kw.value, ast.Constant):
+                v = kw.value.value
+                if isinstance(v, str) and known and v not in known:
+                    findings.append(_unknown_axis(kw.value, v, cn or "call"))
+        if is_shard_map_call(node):
+            findings.extend(_check_shard_map_arity(node, model))
+    return findings
+
+
+def _unknown_axis(node: ast.AST, axis: str, where: str) -> RawFinding:
+    return RawFinding(
+        node.lineno,
+        node.col_offset,
+        CODE,
+        f"axis name {axis!r} in `{where}` is not declared by any mesh in the "
+        "linted tree (declared: via create_mesh/Mesh/axis_name defaults); "
+        "typo or missing mesh axis",
+    )
+
+
+def _positional_arity(fn: ast.FunctionDef | ast.Lambda) -> tuple[int, bool]:
+    """(positional param count, has *args) for a def or lambda."""
+    a = fn.args
+    return len(a.posonlyargs) + len(a.args), a.vararg is not None
+
+
+def _check_shard_map_arity(node: ast.Call, model: ModuleModel) -> list[RawFinding]:
+    if not node.args:
+        return []
+    target = node.args[0]
+    fn: ast.FunctionDef | ast.Lambda | None = None
+    if isinstance(target, ast.Lambda):
+        fn = target
+    elif isinstance(target, ast.Name):
+        # nearest preceding def with that name: modules reuse local names
+        # like `step`/`body` across factory functions, so the lexically
+        # closest definition before the call site is the one in scope
+        best_pos = None
+        call_pos = pos_key(node)
+        for cand in ast.walk(model.tree):
+            if isinstance(cand, ast.FunctionDef) and cand.name == target.id:
+                p = pos_key(cand)
+                if p < call_pos and (best_pos is None or p > best_pos):
+                    fn, best_pos = cand, p
+    if fn is None:
+        return []
+    in_specs = None
+    for kw in node.keywords:
+        if kw.arg == "in_specs":
+            in_specs = kw.value
+    if not isinstance(in_specs, (ast.Tuple, ast.List)):
+        return []  # single spec broadcast or opaque expression: fine
+    arity, has_varargs = _positional_arity(fn)
+    if has_varargs:
+        return []
+    n_specs = len(in_specs.elts)
+    if n_specs != arity:
+        fname = target.id if isinstance(target, ast.Name) else "<lambda>"
+        return [
+            RawFinding(
+                in_specs.lineno,
+                in_specs.col_offset,
+                CODE,
+                f"shard_map in_specs has {n_specs} entr{'y' if n_specs == 1 else 'ies'} "
+                f"but `{fname}` takes {arity} positional argument"
+                f"{'' if arity == 1 else 's'} — trace error on every backend",
+            )
+        ]
+    return []

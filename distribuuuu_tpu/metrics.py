@@ -3,11 +3,20 @@
 Host-side meters mirror the reference (`/root/reference/distribuuuu/utils.py:199-262`):
 running averages, a formatted per-iteration progress line, and ETA
 extrapolation. The accuracy computation differs by design: the reference
-computes top-k per step on device then calls ``.item()`` every iteration,
-forcing a GPU sync per step (`trainer.py:53-55` — flagged in SURVEY §3.2).
-Here `topk_correct` runs *inside* the jitted step and returns on-device
-counters; the trainer only materializes them on the host every PRINT_FREQ
-iterations, so the TPU never stalls on metrics.
+computed top-k on device and ``.item()``-synced it **every iteration**
+(`trainer.py:53-55` — flagged in SURVEY §3.2); here `topk_correct` runs
+*inside* the jitted step and returns on-device count sums, which the
+trainer accumulates in a window of un-fetched device values and
+materializes with ONE ``jax.device_get(window)`` per PRINT_FREQ boundary
+(plus the final iteration) — see ``train_epoch``. Between boundaries the
+accelerator never stalls on metrics; the meters below are fed from the
+fetched window sums, never from per-step host reads.
+
+This file is the motivating example for dtpu-lint rule **DT001** (host
+sync inside a step loop): the per-iteration ``.item()``/``float()`` pattern
+this module exists to avoid is exactly what DT001 flags, and the
+PRINT_FREQ-guarded window fetch is its whitelisted sync point
+(docs/STATIC_ANALYSIS.md).
 """
 
 from __future__ import annotations

@@ -29,8 +29,6 @@ index), so the result equals single-device causal attention exactly.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 

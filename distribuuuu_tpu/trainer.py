@@ -28,7 +28,6 @@ from typing import Any
 import flax.struct
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distribuuuu_tpu import checkpoint as ckpt
@@ -282,7 +281,9 @@ def create_train_state(model, key, mesh: Mesh, im_size: int):
         )
 
     replicated = NamedSharding(mesh, P())
-    state = jax.jit(init_fn, out_shardings=replicated)(key)
+    # jit-then-call is deliberate here: init runs once per (model, mesh,
+    # im_size) and a keyed cache would pin every model ever constructed
+    state = jax.jit(init_fn, out_shardings=replicated)(key)  # dtpu-lint: disable=DT003
     return state, tx
 
 
@@ -584,6 +585,17 @@ def _bn_dtype_scoped(fn):
     return wrapper
 
 
+@functools.lru_cache(maxsize=None)
+def _recommit_fn(mesh: Mesh):
+    """Jitted replicated-copy, cached per mesh: binding the callable once
+    keeps the compile cache keyed on a stable function object (a fresh
+    ``jax.jit(lambda ...)`` per call retraces every call — DT003; this was
+    dtpu-lint's first real catch, regression-pinned in tests/test_analysis.py).
+    Meshes are hashable and O(1)-few per process, so the cache is bounded."""
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(lambda s: jax.tree.map(jnp.copy, s), out_shardings=replicated)
+
+
 def _recommit_state(state: TrainState, mesh: Mesh) -> TrainState:
     """Launder restored checkpoint arrays through a jitted copy.
 
@@ -594,8 +606,7 @@ def _recommit_state(state: TrainState, mesh: Mesh) -> TrainState:
     replicated sharding, device-committed buffers — so donation behaves
     identically to the fresh-init path. Values are copied bit-exactly.
     """
-    replicated = NamedSharding(mesh, P())
-    return jax.jit(lambda s: jax.tree.map(jnp.copy, s), out_shardings=replicated)(state)
+    return _recommit_fn(mesh)(state)
 
 
 @_bn_dtype_scoped

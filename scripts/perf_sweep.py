@@ -49,6 +49,7 @@ def main():
     print("|---|---|---|---|")
     lr = jnp.asarray(0.1, jnp.float32)
     key = jax.random.PRNGKey(1)
+    init_key = jax.random.PRNGKey(0)  # same init every rung, hoisted (DT002)
     iters = QUICK_ITERS if quick else ITERS
 
     from distribuuuu_tpu.models.layers import set_bn_compute_dtype
@@ -65,7 +66,7 @@ def main():
             try:
                 # state/batch construction inside the try: OOM at the larger
                 # rungs happens here as readily as inside the step
-                state, _ = create_train_state(model, jax.random.PRNGKey(0), mesh, 224)
+                state, _ = create_train_state(model, init_key, mesh, 224)
                 batch = make_synthetic_batch(mesh, B * n_chips)
                 for _ in range(WARMUP):
                     state, m = step(state, batch, lr, key)

@@ -1,7 +1,6 @@
 """ViT family: pinned param inventories, forward/grad contracts, and the
 sequence-parallel encoder path (ring + Ulysses) vs the dense oracle."""
 
-import functools
 
 import jax
 import jax.numpy as jnp

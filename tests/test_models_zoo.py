@@ -81,11 +81,12 @@ def test_rel_pos_emb_against_bruteforce():
 
 def test_forward_shapes_eval_shape():
     """Output shapes/dtypes for the new families (abstract, no compile)."""
+    key = jax.random.PRNGKey(0)  # abstract eval only; hoisted (DT002)
     for arch, im in [("botnet50", 64), ("efficientnet_b0", 64), ("regnety_160", 32), ("densenet121", 32)]:
         model = build_model(arch, num_classes=7)
         shapes = jax.eval_shape(
             lambda k, x, m=model: m.init(k, x, train=False),
-            jax.random.PRNGKey(0),
+            key,
             jnp.zeros((2, im, im, 3), jnp.float32),
         )
         out = jax.eval_shape(

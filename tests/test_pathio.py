@@ -11,7 +11,6 @@ Orbax's own array writes already speak gs:// natively (tensorstore); what
 needed coverage is everything *around* Orbax that used ``os.*`` / ``open()``.
 """
 
-import logging
 
 import fsspec
 import pytest

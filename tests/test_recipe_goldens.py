@@ -17,7 +17,6 @@ import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distribuuuu_tpu import optim
-from distribuuuu_tpu.config import cfg
 
 
 # literal goldens: cos policy, BASE_LR 0.4, MAX_EPOCH 100, MIN_LR 0,

@@ -10,7 +10,6 @@ paths are each pinned separately.
 """
 
 import os
-import queue
 import signal
 import threading
 
@@ -278,8 +277,9 @@ def test_guard_is_bitexact_noop_on_finite_steps(fresh_cfg, mesh):
     model = _GuardCNN()
     img = np.random.default_rng(1).standard_normal((16, 8, 8, 3)).astype(np.float32)
     outs = []
+    init_key = jax.random.PRNGKey(0)  # both arms share the init — hoisted (DT002)
     for guard in (True, False):
-        state, tx = create_train_state(model, jax.random.PRNGKey(0), mesh, 8)
+        state, tx = create_train_state(model, init_key, mesh, 8)
         step = make_train_step(model, tx, mesh, topk=2, nonfinite_guard=guard)
         for i in range(3):
             state, _ = step(

@@ -1,6 +1,5 @@
 """Class-parallel head + vocab-parallel CE == dense oracle (values & grads)."""
 
-import functools
 
 import jax
 import jax.numpy as jnp

@@ -12,12 +12,9 @@ import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from distribuuuu_tpu import optim
 from distribuuuu_tpu.data.loader import prefetch_to_device
-from distribuuuu_tpu.models import build_model
 from distribuuuu_tpu.runtime import data_mesh
 from distribuuuu_tpu.trainer import (
-    TrainState,
     create_train_state,
     make_eval_step,
     make_train_step,
@@ -75,10 +72,15 @@ def test_train_step_loss_decreases(fresh_cfg, mesh, syncbn):
     batch = _device_batch(_batch(), mesh)
     lr = jnp.asarray(0.5, jnp.float32)
     rng = jax.random.PRNGKey(1)
-    losses = []
-    for i in range(8):
+    # metrics stay on device across the loop and are fetched once at the end
+    # — the trainer's PRINT_FREQ idiom (a per-iteration float() here was
+    # dtpu-lint DT001's first real catch; regression-pinned in test_analysis)
+    window = []
+    for _ in range(8):
         state, m = step(state, batch, lr, rng)
-        losses.append(float(m["loss_sum"] / m["n"]))
+        window.append(m)
+    vals = jax.device_get(window)
+    losses = [float(v["loss_sum"] / v["n"]) for v in vals]
     assert losses[-1] < losses[0] - 0.1, losses
 
 
@@ -203,11 +205,12 @@ def test_grad_accumulation_equivalence(fresh_cfg, mesh):
     batch = _batch(n=32)
 
     outs = []
+    key0 = jax.random.PRNGKey(0)  # both arms share the key — hoisted (DT002)
     for accum in (1, 2):
-        state, tx = create_train_state(model, jax.random.PRNGKey(0), mesh, 8)
+        state, tx = create_train_state(model, key0, mesh, 8)
         step = make_train_step(model, tx, mesh, topk=2, accum_steps=accum)
         new_state, m = step(
-            state, _device_batch(batch, mesh), jnp.float32(1.0), jax.random.PRNGKey(0)
+            state, _device_batch(batch, mesh), jnp.float32(1.0), key0
         )
         outs.append((jax.device_get(new_state.params), jax.device_get(m)))
     (p1, m1), (p2, m2) = outs
@@ -295,11 +298,12 @@ def test_grad_accum_bn_sequential_at_lamb_scale(fresh_cfg, mesh, accum):
     step1 = make_train_step(model, tx, mesh, topk=2, accum_steps=1)
     local = np.arange(n).reshape(8, accum, 1)
     stats_j = []
+    key0 = jax.random.PRNGKey(0)  # same key per micro, deliberately (DT002)
     for j in range(accum):
         micro = {k: v[local[:, j, :].reshape(-1)] for k, v in batch.items()}
         st, _ = step1(
             fresh_state(), _device_batch(micro, mesh), jnp.float32(0.0),
-            jax.random.PRNGKey(0),
+            key0,
         )
         r_j = jax.device_get(st.batch_stats)
         stats_j.append(
