@@ -32,13 +32,14 @@ fallback), and retry-with-backoff around the Orbax save/restore dispatch.
 from __future__ import annotations
 
 import re
+import time
 from typing import Any
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
-from distribuuuu_tpu import resilience
+from distribuuuu_tpu import obs, resilience
 from distribuuuu_tpu.logging import logger
 from distribuuuu_tpu.runtime import pathio
 
@@ -157,18 +158,30 @@ def save_checkpoint(out_dir: str, epoch: int, state: Any, best_acc1: float, is_b
         # the previous write failed, that dominator may not exist — keep the
         # emergency checkpoints as fallback resume points.
         prune_mid_checkpoints(out_dir, before_epoch=epoch)
+    tic = time.time()
     resilience.retry(
         ckptr.save, path, payload, force=True, desc=f"checkpoint save {path}"
+    )
+    # wall_s is the foreground cost (snapshot + dispatch): what the mesh
+    # actually stalled for — the background serialize/commit is free
+    obs.current().event(
+        "checkpoint", ckpt_kind="epoch", path=path, epoch=epoch,
+        wall_s=round(time.time() - tic, 4), synchronous=False,
     )
     if is_best:
         best = _checkpointer("best")
         _wait_tolerating_failure(best, "previous best checkpoint")
+        tic = time.time()
         resilience.retry(
             best.save,
             get_best_path(out_dir),
             {"params": state.params, "batch_stats": state.batch_stats},
             force=True,
             desc="best-checkpoint save",
+        )
+        obs.current().event(
+            "checkpoint", ckpt_kind="best", path=get_best_path(out_dir),
+            epoch=epoch, wall_s=round(time.time() - tic, 4), synchronous=False,
         )
     return path
 
@@ -236,10 +249,17 @@ def save_mid_checkpoint(
         ckptr.save(path, payload, force=True)
         ckptr.wait_until_finished()  # durable (or raising) before we return
 
+    tic = time.time()
     resilience.retry(
         save_committed,
         retry_on=(Exception,),
         desc=f"emergency checkpoint save {path}",
+    )
+    # typed journal event: mid-epoch emergency saves used to be log lines
+    # only (ISSUE 3 satellite); wall_s here is the full durable write
+    obs.current().event(
+        "checkpoint", ckpt_kind="emergency", path=path, epoch=epoch, step=step,
+        wall_s=round(time.time() - tic, 4), synchronous=True,
     )
     return path
 
@@ -267,13 +287,18 @@ def _restore(path: str, template: dict):
     genuinely corrupt directory exhausts the retries and raises (callers that
     can fall back catch it — see restore_latest)."""
     ckptr = _checkpointer()
-    return resilience.retry(
+    tic = time.time()
+    restored = resilience.retry(
         ckptr.restore,
         path,
         args=ocp.args.PyTreeRestore(item=template),
         retry_on=(OSError,),
         desc=f"checkpoint restore {path}",
     )
+    obs.current().event(
+        "restore", path=path, wall_s=round(time.time() - tic, 4)
+    )
+    return restored
 
 
 def load_checkpoint(path: str, state: Any, load_opt: bool = True):

@@ -156,6 +156,34 @@ _C.FAULT.INJECT_IO_FAILURES = 1
 _C.FAULT.INJECT_NAN_STEPS = []
 _C.FAULT.INJECT_PREEMPT_STEP = -1
 
+# Observability (TPU addition; docs/OBSERVABILITY.md). The structured
+# telemetry subsystem: rank-0 JSONL metrics journal, MFU/goodput accounting,
+# jax.monitoring counters, programmatic profiler windows, memory snapshots.
+_C.OBS = CN()
+# Master switch. When off, every telemetry call site degrades to a no-op.
+_C.OBS.ENABLED = True
+# Journal filename under OUT_DIR (JSONL, one typed record per line).
+_C.OBS.JOURNAL = "telemetry.jsonl"
+# os.fsync the journal after every record (power-loss-grade durability; the
+# default already flushes per record, losing at most one torn line).
+_C.OBS.FSYNC = False
+# Price the jitted step with the XLA cost model (by LOWERING it — tracing
+# only, no extra compile) and report MFU per window. Peak hardware FLOPs come
+# from the built-in per-device_kind table; PEAK_TFLOPS_PER_DEVICE overrides
+# (in TFLOP/s per JAX device; 0 = auto). Unknown hardware omits MFU.
+_C.OBS.MFU = True
+_C.OBS.PEAK_TFLOPS_PER_DEVICE = 0.0
+# Programmatic profiler windows: capture PROFILE_STEPS steps with
+# jax.profiler starting at each listed *global* step (epoch*steps_per_epoch
+# + it), traces under OUT_DIR/profile/gstep_*. SIGUSR1 asks a live run for
+# one window at the next step boundary (PROFILE_SIGUSR1 gates the handler).
+_C.OBS.PROFILE_AT_STEPS = []
+_C.OBS.PROFILE_STEPS = 5
+_C.OBS.PROFILE_SIGUSR1 = True
+_C.OBS.PROFILE_TOP_OPS = 20
+# Live-array/HBM snapshot journaled at each epoch boundary.
+_C.OBS.MEMORY_SNAPSHOTS = True
+
 # Resume policy (TPU addition). Epoch checkpoints stay the primary contract;
 # these govern the extra step-granular/robustness behavior on top.
 _C.RESUME = CN()

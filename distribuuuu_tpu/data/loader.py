@@ -28,6 +28,7 @@ import os
 import queue
 import random
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator
 
@@ -35,7 +36,7 @@ import jax
 import numpy as np
 from PIL import Image
 
-from distribuuuu_tpu import resilience
+from distribuuuu_tpu import obs, resilience
 from distribuuuu_tpu.config import cfg, get_default
 from distribuuuu_tpu.data import native
 from distribuuuu_tpu.data.dataset import DummyDataset, ImageFolder, open_image_dataset
@@ -300,9 +301,17 @@ class HostDataLoader:
             while True:
                 if err_box:  # checked before draining: failures preempt
                     self._raise_producer_error(err_box[0])  # buffered batches
+                t_wait = time.monotonic()
                 try:
                     batch = out_q.get(timeout=0.2)
+                    # producer-bound wait: how long this consumer sat on an
+                    # empty decode queue (journaled per epoch as a counter —
+                    # the "is the input pipeline the bottleneck?" number)
+                    obs.current().add_wait(
+                        "decode_wait_s", time.monotonic() - t_wait
+                    )
                 except queue.Empty:
+                    obs.current().add_wait("decode_wait_s", time.monotonic() - t_wait)
                     if err_box:
                         self._raise_producer_error(err_box[0])
                     if not producer.is_alive():
@@ -504,7 +513,12 @@ def prefetch_to_device(iterator, mesh, prefetch: int = 2):
                 if batch is last_host:
                     dev = last_dev  # marked replay batch: ship once
                 else:
+                    t0 = time.monotonic()
                     dev = to_device(batch)
+                    # dispatch-side H2D cost on the dedicated transfer
+                    # thread (the copy itself may still be in flight —
+                    # deliberately NOT a sync)  # dtpu-lint: disable=DT006
+                    obs.current().add_wait("h2d_transfer_s", time.monotonic() - t0)
                     if REPLAY_CONST in batch:
                         # memoize ONLY marked batches: holding a reference to
                         # every real batch would pin ~one extra host+device

@@ -22,31 +22,91 @@ logger = logging.getLogger("distribuuuu_tpu")
 # The remote-log writer currently owned by setup_logger, if any. Held at
 # module level so a repeat setup_logger call closes (= commits) the previous
 # object instead of leaking one open writer per call, and so atexit holds a
-# single idempotent closer rather than one registration per call.
+# single idempotent closer rather than one registration per call. The
+# handler and base path ride along so `commit_logs` can roll the committed
+# object over into a `.partN` continuation (object stores have no append).
 _owned_stream = None
+_owned_handler: logging.StreamHandler | None = None
+_owned_base_path: str | None = None
+_owned_part = 0
 
 
 def _close_owned_stream() -> None:
-    global _owned_stream
+    global _owned_stream, _owned_handler, _owned_base_path
     if _owned_stream is not None:
         try:
             if not getattr(_owned_stream, "closed", False):
                 _owned_stream.close()
         finally:
             _owned_stream = None
+            _owned_handler = None
+            _owned_base_path = None
 
 
 atexit.register(_close_owned_stream)
 
 
-def setup_logger(out_dir: str | None = None, process_index: int = 0) -> logging.Logger:
+def commit_logs() -> None:
+    """Make everything logged so far durable *now*.
+
+    atexit commits the remote log object on a clean exit, but a preempted
+    pod can be SIGKILLed at the hard deadline before atexit runs — losing
+    the whole remote log (the bug this fixes). Registered as a resilience
+    preemption hook by `setup_logger`, and also safe to call directly.
+
+    Local file handlers: flush. Remote owned writer: close (an object store
+    commits content at close) and continue logging into ``<path>.partN``
+    (`pathio.open_next_part` — the same rollover the telemetry journal
+    uses) so lines after the commit still land somewhere
+    durable-on-next-commit.
+    """
+    global _owned_stream, _owned_handler, _owned_base_path, _owned_part
+    for h in logger.handlers:
+        try:
+            h.flush()
+        except Exception:
+            pass
+    if _owned_stream is None or _owned_handler is None or _owned_base_path is None:
+        return
+    try:
+        if not getattr(_owned_stream, "closed", False):
+            _owned_stream.close()
+        from distribuuuu_tpu.runtime import pathio
+
+        _owned_stream, _owned_part = pathio.open_next_part(_owned_base_path)
+        _owned_handler.setStream(_owned_stream)
+    except Exception:
+        # committing must never raise into a signal handler / preemption
+        # path — and a handler left holding a CLOSED stream would error on
+        # every later record. Detach it; stderr remains the live copy.
+        handler, _owned_handler = _owned_handler, None
+        _owned_stream = None
+        _owned_base_path = None
+        if handler is not None:
+            try:
+                logger.removeHandler(handler)
+            except Exception:
+                pass
+
+
+def setup_logger(
+    out_dir: str | None = None,
+    process_index: int = 0,
+    journal_path: str | None = None,
+) -> logging.Logger:
     """Configure the package logger. Call once after distributed bring-up.
 
     Process 0: INFO to stderr + ``{out_dir}/{timestamp}.log`` (mirrors
     `utils.py:74-79`). Other processes: WARNING to stderr only.
+    ``journal_path`` (the run's telemetry journal, when observability is on)
+    is echoed into the log so a log reader can find the machine-readable
+    record of the same run.
 
     Safe to call repeatedly: previously attached file/remote handlers are
-    closed (committing any remote log object) before being replaced.
+    closed (committing any remote log object) before being replaced. The
+    remote writer's durability no longer rests on atexit alone: `commit_logs`
+    is registered on the resilience preemption path, so a preempted run's
+    log object commits before the hard deadline can SIGKILL the process.
     """
     for h in logger.handlers:
         if isinstance(h, logging.FileHandler):
@@ -69,17 +129,27 @@ def setup_logger(out_dir: str | None = None, process_index: int = 0) -> logging.
             logfile = pathio.join(out_dir, time.strftime("%Y%m%d_%H%M%S") + ".log")
             if pathio.is_remote(logfile):
                 # Object stores have no append: stream into one open writer
-                # whose content commits at close (atexit). A kill that skips
-                # atexit (SIGKILL/OOM) loses the whole remote log object —
-                # stderr carries the live copy, and the pod runner's stderr
-                # capture is the durable record for crashed runs.
-                global _owned_stream
+                # whose content commits at close. atexit covers clean exits;
+                # commit_logs (preemption hook, below) covers SIGTERM'd runs
+                # — only a no-warning hard kill (OOM) still falls back to the
+                # pod runner's stderr capture.
+                global _owned_stream, _owned_handler, _owned_base_path, _owned_part
                 _owned_stream = pathio.open_write(logfile)
+                _owned_base_path = logfile
+                _owned_part = 0
                 fh = logging.StreamHandler(_owned_stream)
+                _owned_handler = fh
             else:
                 fh = logging.FileHandler(logfile)
             fh.setFormatter(fmt)
             logger.addHandler(fh)
     else:
         logger.setLevel(logging.WARNING)
+
+    # function-level import: resilience imports this module at its top level
+    from distribuuuu_tpu import resilience
+
+    resilience.register_preemption_hook(commit_logs)
+    if journal_path and process_index == 0:
+        logger.info(f"telemetry journal: {journal_path}")
     return logger

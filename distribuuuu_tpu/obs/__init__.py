@@ -1,0 +1,42 @@
+"""`dtpu-obs`: structured telemetry for distribuuuu-tpu (docs/OBSERVABILITY.md).
+
+The observable surface of the framework, in one subsystem:
+
+- **Metrics journal** (`obs.journal`): crash-safe rank-0 JSONL, one typed
+  record per PRINT_FREQ window / epoch / eval / checkpoint / fault event,
+  schema-validated.
+- **Telemetry core** (`obs.telemetry`): the `Telemetry` handle the trainer,
+  checkpointing, data loader and resilience layer all report through;
+  `current()` is a no-op outside a run so instrumentation is unconditional.
+- **Counters** (`obs.monitors`): `jax.monitoring` backend-compile/cache
+  events bridged into per-epoch journal records.
+- **MFU/goodput** (`obs.flops` + telemetry): XLA-cost-model FLOPs per step
+  (priced by *lowering* — no extra compile) against the hardware peak, and
+  productive-time ÷ elapsed goodput.
+- **Profiler windows** (`obs.profiler` + `obs.traceparse`): config- and
+  SIGUSR1-driven `jax.profiler` captures with the per-op device-time table
+  journaled.
+- **CLI** (`obs.__main__`): ``python -m distribuuuu_tpu.obs summarize|validate``.
+"""
+
+from distribuuuu_tpu.obs.journal import (  # noqa: F401
+    Journal,
+    read_journal,
+    validate_journal,
+    validate_record,
+)
+from distribuuuu_tpu.obs.monitors import MonitoringBridge  # noqa: F401
+from distribuuuu_tpu.obs.profiler import (  # noqa: F401
+    ProfilerWindows,
+    install_sigusr1_handler,
+    request_profile,
+)
+from distribuuuu_tpu.obs.telemetry import (  # noqa: F401
+    NullTelemetry,
+    Telemetry,
+    current,
+    end_run,
+    journal_path,
+    set_current,
+    start_run,
+)

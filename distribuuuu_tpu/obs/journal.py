@@ -1,0 +1,356 @@
+"""Crash-safe JSONL metrics journal + record schema.
+
+The journal is the machine-readable counterpart of the rank-0 progress log:
+one JSON object per line, one line per telemetry event (PRINT_FREQ window,
+epoch summary, eval, checkpoint, fault, profile window, ...). MLPerf-style
+structured run logs are the model: a run's whole observable history greps
+and parses with nothing but stdlib json.
+
+Durability contract:
+
+- **Local OUT_DIR**: the file is opened in append mode and flushed after
+  every record, so a SIGKILL loses at most the line being written (the
+  reader skips a torn final line instead of failing). ``OBS.FSYNC`` adds an
+  ``os.fsync`` per record for power-loss-grade durability.
+- **Remote OUT_DIR** (gs://...): object stores have no append — records
+  stream into one open writer whose content commits at ``close()``.
+  ``commit()`` closes the current object and continues into
+  ``<path>.part<N>``, which is how the resilience preemption path makes the
+  journal durable *before* the process exits (see telemetry.Telemetry.commit
+  and docs/OBSERVABILITY.md); ``read_journal`` reassembles the parts.
+
+The schema below is deliberately hand-rolled (no jsonschema dependency):
+``validate_record`` checks the record kind, required fields and types, and
+``validate_journal`` applies it line by line — the obs-smoke CI job and
+tests/test_obs.py gate on it.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import threading
+from typing import Any, Iterator
+
+from distribuuuu_tpu.runtime import pathio
+
+# ---------------------------------------------------------------------------
+# Schema: kind -> (required fields, optional fields); values are type tuples.
+# Extra fields are allowed (forward compatibility); unknown kinds are not.
+# ---------------------------------------------------------------------------
+
+_NUM = (int, float)
+_NUM_OR_NONE = (int, float, type(None))
+_INT = (int,)
+_STR = (str,)
+_BOOL = (bool,)
+_DICT = (dict,)
+_LIST = (list,)
+
+SCHEMA: dict[str, tuple[dict[str, tuple], dict[str, tuple]]] = {
+    # run lifecycle -------------------------------------------------------
+    "run_start": (
+        {
+            "run_id": _STR,
+            "arch": _STR,
+            "hosts": _INT,
+            "devices": _INT,
+            "local_devices": _INT,
+            "platform": _STR,
+            "device_kind": _STR,
+            "global_batch": _INT,
+            "config_fingerprint": _STR,
+            "jax_version": _STR,
+        },
+        {"peak_tflops_per_device": _NUM_OR_NONE, "out_dir": _STR},
+    ),
+    "run_end": (
+        {"best_acc1": _NUM, "wall_s": _NUM, "goodput": _NUM, "total_skipped": _INT,
+         "clean": _BOOL},
+        {"epochs": _INT},
+    ),
+    # training ------------------------------------------------------------
+    "window": (
+        {
+            "epoch": _INT,
+            "step": _INT,
+            "gstep": _INT,
+            "steps": _INT,
+            "skipped": _INT,
+            "lr": _NUM,
+            "step_time": _NUM,
+            "data_time": _NUM,
+            "imgs_per_sec": _NUM,
+            "goodput": _NUM,
+            "warmup": _BOOL,
+        },
+        {
+            "loss": _NUM_OR_NONE,
+            "acc1": _NUM_OR_NONE,
+            "acck": _NUM_OR_NONE,
+            "mfu": _NUM_OR_NONE,
+            "flops_per_step": _NUM_OR_NONE,
+            "step_time_p50": _NUM,
+            "step_time_p90": _NUM,
+            "step_time_max": _NUM,
+        },
+    ),
+    "epoch_train": (
+        {"epoch": _INT, "steps": _INT, "skipped": _INT, "wall_s": _NUM,
+         "imgs_per_sec": _NUM, "goodput": _NUM},
+        {},
+    ),
+    "eval": (
+        {"acc1": _NUM, "acck": _NUM, "wall_s": _NUM, "samples": _NUM},
+        {"epoch": (int, type(None)), "loss": _NUM_OR_NONE},
+    ),
+    # checkpoints / resume ------------------------------------------------
+    "checkpoint": (
+        {"ckpt_kind": _STR, "path": _STR, "wall_s": _NUM, "synchronous": _BOOL},
+        {"epoch": _INT, "step": _INT},
+    ),
+    "restore": ({"path": _STR, "wall_s": _NUM}, {}),
+    "resume": (
+        {"path": _STR, "epoch": _INT, "step": _INT, "best_acc1": _NUM},
+        {},
+    ),
+    # resilience ----------------------------------------------------------
+    "preempt": ({"epoch": _INT, "step": _INT, "path": _STR}, {}),
+    "fault_skipped_steps": ({"epoch": _INT, "count": _INT}, {}),
+    "fault_abort": ({"epoch": _INT, "step": _INT, "consecutive": _INT}, {}),
+    # counters / memory / profiler ---------------------------------------
+    "counters": (
+        {"scope": _STR, "counters": _DICT, "durations": _DICT, "waits": _DICT},
+        {"epoch": _INT},
+    ),
+    "memory": (
+        {"epoch": _INT, "live_arrays": _INT, "live_bytes": _INT},
+        {"per_device": (dict, type(None))},
+    ),
+    "profile": (
+        {"gstep": _INT, "steps": _INT, "logdir": _STR},
+        {"device_ms_per_step": _NUM_OR_NONE, "top_ops": _LIST, "trigger": _STR},
+    ),
+}
+
+
+def validate_record(record: Any) -> list[str]:
+    """Schema errors for one decoded journal record ([] when valid)."""
+    if not isinstance(record, dict):
+        return [f"record is {type(record).__name__}, not an object"]
+    errors: list[str] = []
+    kind = record.get("kind")
+    if not isinstance(kind, str):
+        return ["missing/invalid 'kind'"]
+    if not isinstance(record.get("ts"), (int, float)):
+        errors.append(f"{kind}: missing/invalid 'ts'")
+    spec = SCHEMA.get(kind)
+    if spec is None:
+        return errors + [f"unknown record kind {kind!r}"]
+    required, optional = spec
+    for field, types in required.items():
+        if field not in record:
+            errors.append(f"{kind}: missing required field {field!r}")
+        elif not isinstance(record[field], types) or (
+            # bool is an int subclass; an int-typed field must not accept it
+            isinstance(record[field], bool) and bool not in types
+        ):
+            errors.append(
+                f"{kind}: field {field!r} is {type(record[field]).__name__}, "
+                f"expected {'/'.join(t.__name__ for t in types)}"
+            )
+    for field, types in optional.items():
+        if field in record and (
+            not isinstance(record[field], types)
+            or (isinstance(record[field], bool) and bool not in types)
+        ):
+            errors.append(
+                f"{kind}: field {field!r} is {type(record[field]).__name__}, "
+                f"expected {'/'.join(t.__name__ for t in types)}"
+            )
+    return errors
+
+
+def _journal_parts(path: str) -> list[str]:
+    """The journal file plus any ``.part<N>`` continuations, in write order."""
+    paths = [path]
+    parent, name = os.path.split(str(path))
+    try:
+        siblings = pathio.listdir(parent) if parent else []
+    except (OSError, FileNotFoundError):
+        siblings = []
+    parts = []
+    for f in siblings:
+        if f.startswith(name + ".part"):
+            suffix = f[len(name) + 5 :]
+            if suffix.isdigit():
+                parts.append((int(suffix), pathio.join(parent, f)))
+    return paths + [p for _, p in sorted(parts)]
+
+
+def read_journal(path: str, *, strict: bool = False) -> Iterator[dict]:
+    """Yield decoded records from a journal (and its commit continuations).
+
+    A torn final line of any part is skipped unless ``strict`` — a crash can
+    tear the last part's tail, and a signal-time ``commit()`` landing mid-
+    append can tear an earlier part's (the record's remainder is lost, the
+    stream continues in the next part). Any other undecodable line raises —
+    that is corruption, not tearing.
+    """
+    for part_path in _journal_parts(path):
+        with _open_read(part_path) as f:
+            lines = f.read().splitlines()
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                if strict or i != len(lines) - 1:
+                    raise
+                continue  # torn part tail: tolerated
+            yield record
+
+
+def _open_read(path: str) -> io.TextIOBase:
+    if pathio.is_remote(path):
+        from etils import epath
+
+        return epath.Path(path).open("r")
+    return open(path, "r")
+
+
+def validate_journal(path: str) -> list[str]:
+    """All schema errors across a journal, prefixed with the record index."""
+    errors: list[str] = []
+    n = 0
+    try:
+        for i, rec in enumerate(read_journal(path)):
+            n += 1
+            errors.extend(f"record {i}: {e}" for e in validate_record(rec))
+    except (OSError, FileNotFoundError, json.JSONDecodeError) as exc:
+        return [f"unreadable journal {path}: {exc!r}"]
+    if n == 0:
+        errors.append(f"journal {path} contains no records")
+    return errors
+
+
+def _jsonable(value: Any) -> Any:
+    """Coerce numpy scalars / arrays / tuples into plain JSON types."""
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    if isinstance(value, (str, bool, int, float, type(None))):
+        return value
+    # numpy scalar types expose item(); device arrays should never get here
+    # (telemetry is fed from already-fetched window values)
+    item = getattr(value, "item", None)
+    if callable(item):
+        try:
+            return item()
+        except (TypeError, ValueError):
+            pass
+    return str(value)
+
+
+def _truncate_torn_tail(path: str) -> None:
+    """Drop a partial trailing line (no final newline) from a local journal.
+
+    The torn record is already lost semantically — a crash interrupted its
+    write — and read_journal only tolerates it while it stays the *last*
+    line; once a relaunch appends after it the journal would stop parsing.
+    Backward chunked scan, so healing a large journal stays O(torn line).
+    """
+    try:
+        with open(path, "rb+") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            if size == 0:
+                return
+            f.seek(-1, os.SEEK_END)
+            if f.read(1) == b"\n":
+                return
+            pos = size
+            while pos > 0:
+                chunk = min(65536, pos)
+                f.seek(pos - chunk)
+                data = f.read(chunk)
+                nl = data.rfind(b"\n")
+                if nl >= 0:
+                    f.truncate(pos - chunk + nl + 1)
+                    return
+                pos -= chunk
+            f.truncate(0)  # the whole file is one torn line
+    except (OSError, FileNotFoundError):
+        pass  # nothing to heal / not seekable: append still works
+
+
+class Journal:
+    """Append-only JSONL writer with the durability contract above."""
+
+    def __init__(self, path: str, *, fsync: bool = False):
+        self.path = str(path)
+        self._fsync = fsync
+        self._remote = pathio.is_remote(self.path)
+        self._part = 0
+        # RLock, deliberately: commit() runs as a resilience preemption hook
+        # — i.e. potentially inside a signal handler interrupting this very
+        # thread mid-append(). A plain Lock would deadlock; with the RLock
+        # the commit proceeds (at worst tearing the in-flight line, which
+        # read_journal tolerates at part tails).
+        self._lock = threading.RLock()
+        parent = os.path.dirname(self.path)
+        if parent:
+            pathio.makedirs(parent)
+        if self._remote:
+            # never truncate what an earlier launch committed: continue the
+            # part sequence after any existing journal/parts in this OUT_DIR
+            self._f, self._part = pathio.open_next_part(self.path)
+        else:
+            # a previous launch may have died mid-append; drop its partial
+            # trailing line BEFORE appending, or this run's first record
+            # would glue onto it and corrupt both runs' history
+            _truncate_torn_tail(self.path)
+            self._f = open(self.path, "a")
+
+    def append(self, record: dict) -> None:
+        line = json.dumps(_jsonable(record), separators=(",", ":"))
+        with self._lock:
+            if self._f is None:
+                return  # closed (end of run): late events are dropped
+            self._f.write(line + "\n")
+            self._f.flush()
+            if self._fsync and not self._remote:
+                try:
+                    os.fsync(self._f.fileno())
+                except (OSError, io.UnsupportedOperation):
+                    pass
+
+    def commit(self) -> None:
+        """Make everything appended so far durable.
+
+        Local: flush + fsync. Remote: close the current object (an object
+        store commits content at close) and continue into ``.part<N>``.
+        Called from the preemption path, where 'the process may be killed
+        before atexit' is the whole threat model.
+        """
+        with self._lock:
+            if self._f is None:
+                return
+            if not self._remote:
+                self._f.flush()
+                try:
+                    os.fsync(self._f.fileno())
+                except (OSError, io.UnsupportedOperation):
+                    pass
+                return
+            self._f.close()
+            self._f, self._part = pathio.open_next_part(self.path)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None

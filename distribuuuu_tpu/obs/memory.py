@@ -1,0 +1,46 @@
+"""Live-array / HBM memory snapshots.
+
+Epoch-boundary memory accounting: how many device arrays are alive in this
+process and how many bytes they pin, plus — where the runtime exposes it
+(TPU; ``memory_stats()`` returns None on CPU) — the allocator's per-device
+``bytes_in_use`` / ``peak_bytes_in_use``. A leak (arrays accumulating across
+epochs — e.g. an un-donated state copy kept alive per step) shows up as a
+monotonic ``live_bytes`` ramp in the journal long before the OOM.
+
+Snapshotting walks ``jax.live_arrays()`` — O(live arrays) host work, no
+device sync — so it runs at epoch boundaries only, never inside the step
+loop.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def snapshot() -> dict:
+    """``{live_arrays, live_bytes, per_device}`` for this process.
+
+    ``per_device`` maps device id → the runtime's memory_stats dict
+    (byte-valued keys only), or is None when no device reports stats.
+    """
+    count = 0
+    total = 0
+    for arr in jax.live_arrays():
+        count += 1
+        try:
+            total += int(arr.nbytes)
+        except Exception:
+            pass  # deleted/donated buffers can race the walk
+    per_device: dict[str, dict] | None = {}
+    for dev in jax.local_devices():
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            per_device[str(dev.id)] = {
+                k: int(v) for k, v in stats.items() if isinstance(v, (int, float))
+            }
+    if not per_device:
+        per_device = None
+    return {"live_arrays": count, "live_bytes": total, "per_device": per_device}

@@ -1,0 +1,123 @@
+"""``jax.monitoring`` bridge: backend events → named journal counters.
+
+JAX instruments itself through ``jax.monitoring`` — every backend compile,
+trace, and compilation-cache interaction fires a named event (the same
+plumbing ``analysis/guards.CompileGuard`` taps for its global mode). By
+default those events go nowhere; this bridge subscribes one event listener
+and one duration listener for the life of a run and accumulates:
+
+- ``counters``: event name → fire count (e.g.
+  ``/jax/compilation_cache/compile_requests_use_cache``);
+- ``durations``: event name → ``{count, total_s}`` (e.g.
+  ``/jax/core/compile/backend_compile_duration`` — the cache-*miss* hook, so
+  its count is the true number of XLA compiles, immune to the persistent
+  compile cache serving a binary without compiling).
+
+`Telemetry` snapshots the maps at epoch boundaries and journals the deltas,
+so "epoch 1 compiled nothing" is a greppable fact rather than a hope
+(CompileGuard pins it in tests; the journal records it in production).
+
+``jax.monitoring`` has no supported unregister, so the module installs ONE
+process-global listener pair (lazily, on the first ``install()``) that
+dispatches to the currently-active bridges; ``close()`` just removes the
+bridge from that set. However many runs a process hosts (the test suite, a
+sweep driver), the global registry holds exactly two callbacks.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import jax
+
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_BRIDGES: "set[MonitoringBridge]" = set()
+_DISPATCH_INSTALLED = False
+
+
+def _dispatch_event(event: str, **kwargs: Any) -> None:
+    for bridge in list(_BRIDGES):
+        bridge._record_event(event)
+
+
+def _dispatch_duration(event: str, duration: float, **kwargs: Any) -> None:
+    for bridge in list(_BRIDGES):
+        bridge._record_duration(event, duration)
+
+
+def _ensure_dispatchers() -> None:
+    global _DISPATCH_INSTALLED
+    if not _DISPATCH_INSTALLED:
+        _DISPATCH_INSTALLED = True
+        jax.monitoring.register_event_listener(_dispatch_event)
+        jax.monitoring.register_event_duration_secs_listener(_dispatch_duration)
+
+
+class MonitoringBridge:
+    """Accumulate every ``jax.monitoring`` event into named counters."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = {}
+        self._durations: dict[str, dict[str, float]] = {}
+
+    # -- listeners (called from the module dispatchers) ---------------------
+
+    def _record_event(self, event: str) -> None:
+        with self._lock:
+            self._counters[event] = self._counters.get(event, 0) + 1
+
+    def _record_duration(self, event: str, duration: float) -> None:
+        with self._lock:
+            d = self._durations.setdefault(event, {"count": 0, "total_s": 0.0})
+            d["count"] += 1
+            d["total_s"] += float(duration)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def install(self) -> "MonitoringBridge":
+        _ensure_dispatchers()
+        _BRIDGES.add(self)
+        return self
+
+    def close(self) -> None:
+        _BRIDGES.discard(self)
+
+    # -- reads --------------------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        """Deep-copied ``{"counters": ..., "durations": ...}`` totals."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "durations": {k: dict(v) for k, v in self._durations.items()},
+            }
+
+    @staticmethod
+    def delta(now: dict[str, Any], since: dict[str, Any]) -> dict[str, Any]:
+        """Per-event difference of two snapshots (events with no change are
+        dropped, so epoch records stay small once compiles settle)."""
+        counters = {
+            k: v - since["counters"].get(k, 0)
+            for k, v in now["counters"].items()
+            if v - since["counters"].get(k, 0)
+        }
+        durations = {}
+        for k, v in now["durations"].items():
+            prev = since["durations"].get(k, {"count": 0, "total_s": 0.0})
+            dc = v["count"] - prev["count"]
+            if dc:
+                durations[k] = {
+                    "count": dc,
+                    "total_s": round(v["total_s"] - prev["total_s"], 6),
+                }
+        return {"counters": counters, "durations": durations}
+
+    @property
+    def backend_compiles(self) -> int:
+        """True XLA compile count so far (cache misses only)."""
+        with self._lock:
+            d = self._durations.get(BACKEND_COMPILE_EVENT)
+            return int(d["count"]) if d else 0

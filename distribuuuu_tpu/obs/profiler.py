@@ -1,0 +1,204 @@
+"""Programmatic ``jax.profiler`` capture windows.
+
+Two triggers, both resolved at step boundaries of the train loop (a capture
+can only start/stop between dispatches, never mid-step):
+
+- **Config**: ``OBS.PROFILE_AT_STEPS`` — global steps at which to capture
+  ``OBS.PROFILE_STEPS`` steps each (the legacy ``TRAIN.PROFILE`` epoch-0
+  window maps onto the same mechanism, see `ProfilerWindows.from_cfg`).
+- **Signal**: SIGUSR1 — an operator can ask a *live run* for a profile
+  without restarting it (``kill -USR1 <pid>``); the handler only sets a
+  flag, the capture starts at the next step boundary.
+
+Each window traces into ``OUT_DIR/profile/gstep_<N>``, then the perfetto
+export is parsed (`obs/traceparse.py`) and a per-op device-time table is
+journaled as a ``profile`` record — the profile-guided-fusion loop without
+leaving the terminal, now also without leaving the run.
+
+The stop path ends with one ``jax.device_get`` on the last window metric so
+the traced steps have actually executed — the same whitelisted-barrier idiom
+as the PRINT_FREQ fetch, paid only when a profile was requested.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+import jax
+
+from distribuuuu_tpu.logging import logger
+from distribuuuu_tpu.obs import traceparse
+from distribuuuu_tpu.runtime import pathio
+
+_sigusr1_requested = threading.Event()
+_sigusr1_installed = False
+
+
+def request_profile() -> None:
+    """Ask for a capture window starting at the next step boundary (the
+    programmatic equivalent of SIGUSR1 — tests and embedding servers)."""
+    _sigusr1_requested.set()
+
+
+def profile_requested() -> bool:
+    return _sigusr1_requested.is_set()
+
+
+def _on_sigusr1(signum, frame) -> None:
+    request_profile()
+
+
+def install_sigusr1_handler() -> bool:
+    """Route SIGUSR1 → `request_profile`. Returns False when not installable
+    (non-main thread, or a platform without SIGUSR1)."""
+    global _sigusr1_installed
+    if not hasattr(signal, "SIGUSR1"):
+        return False
+    try:
+        signal.signal(signal.SIGUSR1, _on_sigusr1)
+    except ValueError:
+        logger.warning("SIGUSR1 profile trigger not installed (not on the main thread)")
+        return False
+    _sigusr1_installed = True
+    return True
+
+
+class ProfilerWindows:
+    """Step-boundary-driven profiler capture for one epoch loop.
+
+    ``maybe_start(gstep)`` before the dispatch, ``after_step(gstep, window)``
+    after it; ``finish(window)`` at loop exit closes a window the epoch cut
+    short. Inert (all no-ops) when constructed with no triggers enabled —
+    the default-off fast path costs two predictable branches per step.
+    """
+
+    def __init__(
+        self,
+        logdir_root: str,
+        *,
+        at_steps=(),
+        num_steps: int = 5,
+        top_ops: int = 20,
+        sigusr1: bool = True,
+        telemetry=None,
+    ):
+        self.logdir_root = logdir_root
+        self.at_steps = {int(s) for s in at_steps}
+        self.num_steps = max(1, int(num_steps))
+        self.top_ops = top_ops
+        self.sigusr1 = sigusr1
+        self._telemetry = telemetry
+        self.active = False
+        self._start_gstep = 0
+        self._steps_done = 0
+        self._logdir = ""
+        self._trigger = ""
+
+    @classmethod
+    def from_cfg(cls, epoch: int, telemetry=None) -> "ProfilerWindows":
+        """Build the epoch's windows from OBS.* (+ the legacy TRAIN.PROFILE
+        epoch-0 window, which keeps its own TRAIN.PROFILE_STEPS length).
+
+        ``OBS.ENABLED`` gates the OBS-side triggers, but NOT the legacy
+        TRAIN.PROFILE knob — that predates the telemetry subsystem and must
+        keep writing its epoch-0 trace (journal-less) when OBS is off.
+        With everything off this returns an inert instance (two cheap
+        branches per step)."""
+        from distribuuuu_tpu.config import cfg
+
+        at: set[int] = set()
+        num = cfg.OBS.PROFILE_STEPS
+        sigusr1 = False
+        if cfg.OBS.ENABLED:
+            at |= {int(s) for s in cfg.OBS.PROFILE_AT_STEPS}
+            sigusr1 = cfg.OBS.PROFILE_SIGUSR1
+        if cfg.TRAIN.PROFILE and epoch == 0:
+            at.add(int(cfg.TRAIN.PROFILE_START))
+            num = cfg.TRAIN.PROFILE_STEPS
+        return cls(
+            pathio.join(cfg.OUT_DIR, "profile"),
+            at_steps=at,
+            num_steps=num,
+            top_ops=cfg.OBS.PROFILE_TOP_OPS,
+            sigusr1=sigusr1,
+            telemetry=telemetry,
+        )
+
+    # -- step-boundary hooks -------------------------------------------------
+
+    def maybe_start(self, gstep: int) -> None:
+        """Open a capture when this step is a configured start or a SIGUSR1
+        request is pending. Called immediately before the step dispatch."""
+        if self.active:
+            return
+        trigger = ""
+        if gstep in self.at_steps:
+            trigger = "config"
+        elif self.sigusr1 and _sigusr1_requested.is_set():
+            _sigusr1_requested.clear()
+            trigger = "sigusr1"
+        if not trigger:
+            return
+        self._logdir = pathio.join(self.logdir_root, f"gstep_{gstep:06d}")
+        try:
+            jax.profiler.start_trace(self._logdir)
+        except Exception as exc:  # a second concurrent trace, or no backend
+            logger.warning(f"profiler window at gstep {gstep} failed to start: {exc!r}")
+            return
+        self.active = True
+        self._trigger = trigger
+        self._start_gstep = gstep
+        self._steps_done = 0
+        logger.info(
+            f"profiler window [{trigger}]: tracing {self.num_steps} step(s) "
+            f"from gstep {gstep} -> {self._logdir}"
+        )
+
+    def after_step(self, gstep: int, window: list) -> None:
+        """Count a dispatched step; close the capture once the window is full.
+        ``window`` is the trainer's list of un-fetched step metrics — its tail
+        is the sync target that proves the traced steps ran."""
+        if not self.active:
+            return
+        self._steps_done += 1
+        if self._steps_done >= self.num_steps:
+            self._stop(window)
+
+    def finish(self, window: list) -> None:
+        """Close a window the epoch ended inside (short epoch)."""
+        if self.active:
+            self._stop(window)
+
+    # -- internals -----------------------------------------------------------
+
+    def _stop(self, window: list) -> None:
+        if window:
+            # barrier: the traced dispatches must have executed before the
+            # trace closes (bare fetch, value discarded — the DT001 idiom)
+            jax.device_get(window[-1])
+        try:
+            jax.profiler.stop_trace()
+        except Exception as exc:
+            logger.warning(f"profiler stop_trace failed: {exc!r}")
+            self.active = False
+            return
+        self.active = False
+        table = traceparse.op_table(self._logdir, self._steps_done, self.top_ops)
+        logger.info(
+            f"profiler window done: {self._steps_done} step(s) -> {self._logdir}"
+            + (
+                f" ({table['device_ms_per_step']:.2f} device-ms/step)"
+                if table["device_ms_per_step"]
+                else ""
+            )
+        )
+        if self._telemetry is not None:
+            self._telemetry.event(
+                "profile",
+                gstep=self._start_gstep,
+                steps=self._steps_done,
+                logdir=str(self._logdir),
+                trigger=self._trigger,
+                **table,
+            )

@@ -1,0 +1,163 @@
+"""Render a run report from a metrics journal.
+
+``python -m distribuuuu_tpu.obs summarize <journal>`` — the human view of
+the machine-readable record: throughput per epoch, MFU, goodput, compile
+and transfer counters, fault/resume history, checkpoint cadence, and the
+hottest device ops from the last profiler window. Pure function of the
+journal (reads nothing else), so it works on a laptop against a journal
+scp'd off a pod.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Iterable
+
+from distribuuuu_tpu.obs.journal import read_journal
+from distribuuuu_tpu.obs.monitors import BACKEND_COMPILE_EVENT
+
+
+def _fmt_s(seconds: float) -> str:
+    seconds = float(seconds)
+    if seconds >= 3600:
+        return f"{seconds / 3600:.1f}h"
+    if seconds >= 60:
+        return f"{seconds / 60:.1f}m"
+    return f"{seconds:.1f}s"
+
+
+def _median(vals: list[float]) -> float:
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    return s[len(s) // 2]
+
+
+def render(records: Iterable[dict]) -> str:
+    """The report text for a record stream (exercised by the golden test)."""
+    records = list(records)
+    by_kind: dict[str, list[dict]] = defaultdict(list)
+    for r in records:
+        by_kind[r.get("kind", "?")].append(r)
+
+    lines: list[str] = []
+    out = lines.append
+    out("== distribuuuu-tpu run report ==")
+
+    start = by_kind["run_start"][-1] if by_kind["run_start"] else {}
+    if start:
+        out(
+            f"run {start.get('run_id', '?')}: {start.get('arch', '?')} on "
+            f"{start.get('devices', '?')}x{start.get('device_kind', '?')} "
+            f"({start.get('hosts', '?')} host(s)), global batch "
+            f"{start.get('global_batch', '?')}, config {start.get('config_fingerprint', '?')}"
+        )
+    end = by_kind["run_end"][-1] if by_kind["run_end"] else {}
+    if end:
+        out(
+            f"result: best Acc@1 {end.get('best_acc1', 0.0):.3f} over "
+            f"{end.get('epochs', '?')} epoch(s) in {_fmt_s(end.get('wall_s', 0.0))}, "
+            f"goodput {100.0 * end.get('goodput', 0.0):.1f}%, "
+            f"{'clean exit' if end.get('clean') else 'DIRTY EXIT'}"
+        )
+
+    # -- per-epoch throughput table -----------------------------------------
+    windows_by_epoch: dict[int, list[dict]] = defaultdict(list)
+    for w in by_kind["window"]:
+        windows_by_epoch[w["epoch"]].append(w)
+    if windows_by_epoch:
+        out("")
+        out("epoch | steps | imgs/s (p50) | step_time p50/p90 | MFU p50 | skipped")
+        out("------|-------|--------------|-------------------|---------|--------")
+        for epoch in sorted(windows_by_epoch):
+            ws = [w for w in windows_by_epoch[epoch] if not w.get("warmup")]
+            ws = ws or windows_by_epoch[epoch]
+            ips = _median([w["imgs_per_sec"] for w in ws])
+            p50 = _median([w["step_time"] for w in ws])
+            p90 = _median([w.get("step_time_p90", w["step_time"]) for w in ws])
+            mfus = [w["mfu"] for w in ws if w.get("mfu") is not None]
+            mfu_s = f"{100.0 * _median(mfus):6.2f}%" if mfus else "    n/a"
+            skipped = sum(w["skipped"] for w in windows_by_epoch[epoch])
+            out(
+                f"{epoch:5d} | {sum(w['steps'] for w in windows_by_epoch[epoch]):5d} "
+                f"| {ips:12.1f} | {p50:.4f}s / {p90:.4f}s | {mfu_s} | {skipped:7d}"
+            )
+
+    # -- eval ----------------------------------------------------------------
+    if by_kind["eval"]:
+        out("")
+        for ev in by_kind["eval"]:
+            ep = ev.get("epoch")
+            out(
+                f"eval{f'[{ep}]' if ep is not None else ''}: "
+                f"Acc@1 {ev['acc1']:.3f}  Acc@k {ev['acck']:.3f}  "
+                f"({_fmt_s(ev['wall_s'])}, {ev['samples']:.0f} samples)"
+            )
+
+    # -- counters ------------------------------------------------------------
+    run_counters = [c for c in by_kind["counters"] if c.get("scope") == "run"]
+    if run_counters:
+        c = run_counters[-1]
+        compile_d = c["durations"].get(BACKEND_COMPILE_EVENT, {})
+        out("")
+        out(
+            f"compiles: {compile_d.get('count', 0)} backend compile(s), "
+            f"{compile_d.get('total_s', 0.0):.1f}s total"
+        )
+        waits = c.get("waits", {})
+        if waits:
+            out(
+                "host waits: "
+                + ", ".join(f"{k}={_fmt_s(v)}" for k, v in sorted(waits.items()))
+            )
+
+    # -- resilience ----------------------------------------------------------
+    n_skip = sum(r["count"] for r in by_kind["fault_skipped_steps"])
+    n_emergency = sum(
+        1 for r in by_kind["checkpoint"] if r.get("ckpt_kind") == "emergency"
+    )
+    parts = [
+        f"skipped_steps={n_skip}",
+        f"emergency_ckpts={n_emergency}",
+        f"preempts={len(by_kind['preempt'])}",
+        f"resumes={len(by_kind['resume'])}",
+        f"aborts={len(by_kind['fault_abort'])}",
+    ]
+    out("")
+    out("faults: " + "  ".join(parts))
+
+    # -- checkpoints ---------------------------------------------------------
+    saves = [r for r in by_kind["checkpoint"] if r.get("ckpt_kind") != "emergency"]
+    if saves or by_kind["restore"]:
+        avg = sum(r["wall_s"] for r in saves) / len(saves) if saves else 0.0
+        out(
+            f"checkpoints: {len(saves)} save(s) (avg dispatch {avg:.2f}s), "
+            f"{len(by_kind['restore'])} restore(s)"
+        )
+
+    # -- memory --------------------------------------------------------------
+    if by_kind["memory"]:
+        m = by_kind["memory"][-1]
+        out(
+            f"memory (last epoch): {m['live_arrays']} live arrays, "
+            f"{m['live_bytes'] / 1e6:.1f} MB"
+        )
+
+    # -- profiler ------------------------------------------------------------
+    if by_kind["profile"]:
+        p = by_kind["profile"][-1]
+        out("")
+        out(
+            f"profile @ gstep {p['gstep']} ({p['steps']} step(s), "
+            f"trigger={p.get('trigger', '?')}): {p['logdir']}"
+        )
+        if p.get("device_ms_per_step"):
+            out(f"device op time: {p['device_ms_per_step']:.2f} ms/step")
+        for op in p.get("top_ops", [])[:10]:
+            out(f"  {op['pct']:5.1f}%  {op['ms_per_step']:8.3f} ms  {op['op']}")
+
+    return "\n".join(lines) + "\n"
+
+
+def summarize_file(path: str) -> str:
+    return render(read_journal(path))

@@ -184,6 +184,31 @@ def retry(
 _preempt_flag = threading.Event()
 _preempt_signum: int | None = None
 _prev_handlers: dict[int, Any] = {}
+_preemption_hooks: list[Callable[[], None]] = []
+
+
+def register_preemption_hook(fn: Callable[[], None]) -> None:
+    """Run ``fn`` when preemption is first requested (durability hooks:
+    commit the remote log object, commit the telemetry journal — things an
+    atexit would also do, except a preempted pod may be SIGKILLed before
+    atexit ever runs). Hooks must be fast and exception-safe-ish: failures
+    are swallowed so one broken hook cannot eat the preemption itself.
+    Registering the same callable twice is a no-op."""
+    if fn not in _preemption_hooks:
+        _preemption_hooks.append(fn)
+
+
+def unregister_preemption_hook(fn: Callable[[], None]) -> None:
+    if fn in _preemption_hooks:
+        _preemption_hooks.remove(fn)
+
+
+def _run_preemption_hooks() -> None:
+    for fn in list(_preemption_hooks):
+        try:
+            fn()
+        except Exception as exc:
+            logger.warning(f"preemption hook {fn!r} failed: {exc!r}")
 
 
 def request_preemption(reason: str = "signal", signum: int | None = None) -> None:
@@ -193,9 +218,14 @@ def request_preemption(reason: str = "signal", signum: int | None = None) -> Non
     global _preempt_signum
     if signum is not None:
         _preempt_signum = signum
-    if not _preempt_flag.is_set():
+    first = not _preempt_flag.is_set()
+    if first:
         logger.warning(f"Preemption requested ({reason}); will checkpoint at the next step boundary")
     _preempt_flag.set()
+    if first:
+        # durability hooks fire exactly once, after the flag is set, so a
+        # hook that itself checks preemption_requested() sees the truth
+        _run_preemption_hooks()
 
 
 def preemption_requested() -> bool:

@@ -49,9 +49,31 @@ def rmtree(path: str) -> None:
         p.rmtree()
 
 
+def exists(path: str) -> bool:
+    return epath.Path(path).exists()
+
+
 def open_write(path: str) -> IO[str]:
     """Open ``path`` for text writing. On object stores the content becomes
     visible at ``close()`` (no partial writes), which is exactly right for
     provenance dumps; callers that stream (the log handler) flush best-effort
     and rely on close for durability."""
     return epath.Path(path).open("w")
+
+
+def open_next_part(base: str) -> tuple[IO[str], int]:
+    """Open ``base`` if absent, else the lowest absent ``base.partN`` (N≥1).
+
+    The append-less object-store idiom shared by the telemetry journal and
+    the remote log writer (docs/OBSERVABILITY.md): each durability commit
+    closes the current object and continues into the next part, and a
+    relaunch into the same OUT_DIR must continue the sequence rather than
+    truncate what an earlier launch committed. Returns ``(stream, N)`` with
+    N == 0 for ``base`` itself. Readers reassemble parts in order.
+    """
+    part = 0
+    target = base
+    while exists(target):
+        part += 1
+        target = f"{base}.part{part}"
+    return open_write(target), part

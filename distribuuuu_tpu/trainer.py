@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distribuuuu_tpu import checkpoint as ckpt
+from distribuuuu_tpu import obs
 from distribuuuu_tpu import optim
 from distribuuuu_tpu import resilience
 from distribuuuu_tpu.config import cfg, dump_cfg
@@ -395,8 +396,14 @@ def train_epoch(
         max_epoch=cfg.OPTIM.MAX_EPOCH,
     )
 
-    profile = cfg.TRAIN.PROFILE and epoch == 0 and is_primary
-    trace_active = False
+    tel = obs.current()
+    tel.epoch_start(epoch)
+    # profiler windows (OBS.PROFILE_AT_STEPS / SIGUSR1 / legacy TRAIN.PROFILE)
+    # are primary-only, like the journal they report into; from_cfg applies
+    # the OBS.ENABLED gating (legacy TRAIN.PROFILE stays independent of it)
+    prof = obs.ProfilerWindows.from_cfg(epoch, telemetry=tel) if is_primary else None
+    # per optimizer step the fleet consumes this many samples
+    step_imgs = cfg.TRAIN.BATCH_SIZE * cfg.TRAIN.ACCUM_STEPS * jax.device_count()
     steps_per_epoch = len(loader)
     max_consec = cfg.FAULT.MAX_CONSECUTIVE_SKIPS
     epoch_skipped = 0
@@ -432,6 +439,8 @@ def train_epoch(
             except Exception as exc:  # a failure here must not eat Preempted
                 logger.error(f"async save wait during preemption failed: {exc!r}")
             resilience.RUN_STATS.preempted_at = (epoch, it)
+            tel.event("preempt", epoch=epoch, step=it, path=path)
+            tel.commit()  # durable now — the hard deadline may SIGKILL us
             logger.warning(
                 f"Preempted at epoch {epoch} step {it}: emergency checkpoint "
                 f"{path} committed; exiting"
@@ -441,39 +450,47 @@ def train_epoch(
             batch = resilience.poison_batch_nan(batch)
             if is_primary:
                 logger.warning(f"FAULT INJECTION: NaN batch at global step {gstep}")
-        if profile and not trace_active and it == cfg.TRAIN.PROFILE_START:
-            jax.profiler.start_trace(f"{cfg.OUT_DIR}/profile")
-            trace_active = True
-        if trace_active and it >= cfg.TRAIN.PROFILE_START + cfg.TRAIN.PROFILE_STEPS:
-            if window:  # un-fetched steps remain (a PRINT_FREQ fetch clears it)
-                jax.device_get(window[-1])
-            jax.profiler.stop_trace()
-            logger.info(f"Wrote profiler trace to {cfg.OUT_DIR}/profile")
-            trace_active = False
         # two-level fold: no collisions however long the epoch runs
         step_rng = jax.random.fold_in(jax.random.fold_in(rng, epoch), it)
+        if tel.wants_step_cost:
+            # one-shot analytical step pricing for MFU: LOWERS the jitted
+            # step (tracing only — no compile, CompileGuard stays exact)
+            tel.capture_step_cost(train_step, state, batch, lr_arr, step_rng)
+        if prof is not None:
+            prof.maybe_start(gstep)
         state, m = train_step(state, batch, lr_arr, step_rng)
         window.append(m)
+        if prof is not None:
+            prof.after_step(gstep, window)
         if it % cfg.TRAIN.PRINT_FREQ == 0 or it == len(loader) - 1:
             # device_get is the sync point (block_until_ready is unreliable on
             # some transports); fetch BEFORE timestamping the window
             vals = jax.device_get(window)
             now = time.time()
+            win_wall = now - t_window
+            win_steps = len(window)
+            was_warmup = first_window
             if first_window:
                 # first window = compile + autotune: show it as .val but keep
                 # it out of the running Time average (honest steady-state avg)
-                batch_time.val = (now - t_window) / len(window)
+                batch_time.val = win_wall / win_steps
                 first_window = False
             else:
-                batch_time.update((now - t_window) / len(window), n=len(window))
+                batch_time.update(win_wall / win_steps, n=win_steps)
             t_window = now
             # non-finite-guard accounting: per-epoch skipped_steps plus an
             # abort when skips run back-to-back (divergence, not a blip)
+            win_skipped = 0
             for v in vals:
                 if v.get("skipped", 0.0) >= 0.5:
-                    epoch_skipped += 1
+                    win_skipped += 1
                     consec_skipped += 1
                     if consec_skipped >= max_consec:
+                        tel.event(
+                            "fault_abort", epoch=epoch, step=it,
+                            consecutive=consec_skipped,
+                        )
+                        tel.commit()
                         raise resilience.NonFiniteDivergence(
                             f"{consec_skipped} consecutive non-finite steps at "
                             f"epoch {epoch} step {it} — aborting (loss/grads "
@@ -482,20 +499,30 @@ def train_epoch(
                         )
                 else:
                     consec_skipped = 0
+            epoch_skipped += win_skipped
             n = sum(v["n"] for v in vals)
+            win_loss = win_acc1 = win_acck = None
             if n > 0:  # a window of all-skipped steps has nothing to average
-                losses.update(float(sum(v["loss_sum"] for v in vals) / n), n=int(n))
-                top1.update(float(100.0 * sum(v["correct1"] for v in vals) / n), n=int(n))
-                topk_m.update(
-                    float(100.0 * sum(v[f"correct{topk}"] for v in vals) / n), n=int(n)
-                )
+                win_loss = float(sum(v["loss_sum"] for v in vals) / n)
+                win_acc1 = float(100.0 * sum(v["correct1"] for v in vals) / n)
+                win_acck = float(100.0 * sum(v[f"correct{topk}"] for v in vals) / n)
+                losses.update(win_loss, n=int(n))
+                top1.update(win_acc1, n=int(n))
+                topk_m.update(win_acck, n=int(n))
             window.clear()
+            # journal the window from the values fetched above — telemetry
+            # adds no sync of its own (docs/OBSERVABILITY.md)
+            tel.window(
+                epoch=epoch, step=it, gstep=gstep, steps=win_steps,
+                skipped=win_skipped, lr=lr, wall_s=win_wall,
+                data_time=data_time.avg, imgs=win_steps * step_imgs,
+                warmup=was_warmup, loss=win_loss, acc1=win_acc1, acck=win_acck,
+            )
             if is_primary:
                 progress.display(it)
         t_end = time.time()
-    if trace_active:  # epoch shorter than PROFILE_START+STEPS
-        jax.profiler.stop_trace()
-        logger.info(f"Wrote profiler trace to {cfg.OUT_DIR}/profile (short epoch)")
+    if prof is not None:  # epoch ended inside a capture window (short epoch)
+        prof.finish(window)
     resilience.RUN_STATS.skipped_steps[epoch] = epoch_skipped
     if epoch_skipped and is_primary:
         logger.warning(
@@ -503,20 +530,28 @@ def train_epoch(
             f"left params/optimizer state untouched"
         )
     steps_run = len(loader) - start_step
-    if is_primary and steps_run > 0:
-        imgs = cfg.TRAIN.BATCH_SIZE * cfg.TRAIN.ACCUM_STEPS * jax.device_count() * steps_run
-        wall = time.time() - epoch_start
-        if wall > 0:
+    wall = time.time() - epoch_start
+    if steps_run > 0 and wall > 0:
+        imgs = step_imgs * steps_run
+        if is_primary:
             logger.info(
                 f"Epoch[{epoch}] done: {wall:.1f}s, {imgs / wall:.0f} img/s "
                 f"({imgs / wall / jax.device_count():.0f}/chip)"
             )
+        tel.epoch_end(
+            epoch=epoch, steps=steps_run, skipped=epoch_skipped,
+            wall_s=wall, imgs=imgs,
+        )
     return state
 
 
-def validate(loader, mesh, eval_step, state, is_primary: bool, print_freq=None, prefix="Test: "):
+def validate(
+    loader, mesh, eval_step, state, is_primary: bool, print_freq=None,
+    prefix="Test: ", epoch: int | None = None,
+):
     topk = cfg.TRAIN.TOPK
     print_freq = print_freq or cfg.TEST.PRINT_FREQ
+    eval_tic = time.time()
     batch_time, data_time, losses, top1, topk_m, progress = construct_meters(
         len(loader), prefix=prefix, topk=topk
     )
@@ -560,6 +595,11 @@ def validate(loader, mesh, eval_step, state, is_primary: bool, print_freq=None, 
     acck = float(100.0 * vals[f"correct{topk}"] / n)
     if is_primary:
         logger.info(f" * Acc@1 {acc1:.3f} Acc@{topk} {acck:.3f}")
+    obs.current().event(
+        "eval", epoch=epoch, acc1=acc1, acck=acck,
+        loss=float(vals["loss_sum"] / n), wall_s=round(time.time() - eval_tic, 3),
+        samples=float(vals["n"]),
+    )
     return acc1, acck
 
 
@@ -620,13 +660,22 @@ def train_model():
     key = setup_seed(cfg.RNG_SEED, info.process_index)
     if info.is_primary:
         dump_cfg()
-    setup_logger(cfg.OUT_DIR, info.process_index)
+    setup_logger(
+        cfg.OUT_DIR,
+        info.process_index,
+        journal_path=obs.journal_path(cfg.OUT_DIR) if cfg.OBS.ENABLED else None,
+    )
     resilience.reset_run_stats()
     # a stale flag from an earlier preempted run in this process must not
     # immediately re-preempt the relaunch
     resilience.clear_preemption()
     if cfg.FAULT.HANDLE_SIGNALS:
         resilience.install_preemption_handler()
+    # telemetry opens before any compile so the monitoring bridge sees the
+    # init/step compiles too; non-primary processes get the no-op handle
+    obs.start_run(cfg.OUT_DIR, is_primary=info.is_primary)
+    if cfg.OBS.ENABLED and cfg.OBS.PROFILE_SIGUSR1 and info.is_primary:
+        obs.install_sigusr1_handler()
     injector = resilience.FaultInjector()
     if injector.active:
         logger.warning(
@@ -683,6 +732,10 @@ def train_model():
                 # otherwise desync the replay of the in-progress epoch)
                 dropout_key = jnp.asarray(rng_key)
             resumed = True
+            obs.current().event(
+                "resume", path=path, epoch=start_epoch, step=start_step,
+                best_acc1=float(best_acc1),
+            )
             logger.info(
                 f"Resumed from {path} (epoch {start_epoch}, step {start_step}, "
                 f"best {best_acc1:.3f})"
@@ -709,7 +762,9 @@ def train_model():
                 start_step=start_step if epoch == start_epoch else 0,
                 best_acc1=best_acc1, injector=injector,
             )
-            acc1, _ = validate(val_loader, mesh, eval_step, state, info.is_primary)
+            acc1, _ = validate(
+                val_loader, mesh, eval_step, state, info.is_primary, epoch=epoch
+            )
             is_best = acc1 > best_acc1
             best_acc1 = max(acc1, best_acc1)
             path = ckpt.save_checkpoint(cfg.OUT_DIR, epoch, state, best_acc1, is_best)
@@ -724,12 +779,21 @@ def train_model():
         primary_exc = sys.exc_info()[0] is not None
         saves_durable = True
         try:
-            ckpt.wait_for_saves()
-        except Exception as exc:
-            saves_durable = False
-            if not primary_exc:
-                raise
-            logger.error(f"final checkpoint wait failed: {exc!r}")
+            try:
+                ckpt.wait_for_saves()
+            except Exception as exc:
+                saves_durable = False
+                if not primary_exc:
+                    raise
+                logger.error(f"final checkpoint wait failed: {exc!r}")
+        finally:
+            # the journal gets its run_end (and closes) on every exit path —
+            # clean, preempted, diverged or crashed
+            obs.end_run(
+                best_acc1=best_acc1,
+                epochs=cfg.OPTIM.MAX_EPOCH,
+                clean=not primary_exc and saves_durable,
+            )
     if saves_durable:
         # completed run with every epoch checkpoint durable: any leftover
         # emergency checkpoint is strictly dominated — clean it up. (If the

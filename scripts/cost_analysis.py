@@ -50,12 +50,17 @@ def main():
     lr = jnp.asarray(0.1, jnp.float32)
     key = jax.random.PRNGKey(1)
 
-    compiled = step.lower(state, batch, lr, key).compile()
-    costs = compiled.cost_analysis()
-    if isinstance(costs, list):  # older jax returns one dict per device program
-        costs = costs[0]
-    flops = costs.get("flops", float("nan"))
-    bytes_acc = costs.get("bytes accessed", float("nan"))
+    # shared cost-model plumbing with the in-run MFU accounting
+    # (obs/flops.py journals the *lowered* cost per window; this script
+    # compiles for the emitter's per-device numbers)
+    from distribuuuu_tpu.obs import flops as obs_flops
+
+    cost = obs_flops.compiled_step_cost(step, state, batch, lr, key)
+    if cost is None:
+        print("cost analysis unavailable on this backend/jax version", file=sys.stderr)
+        raise SystemExit(1)
+    flops = cost["flops"]
+    bytes_acc = cost["bytes_accessed"]
     # the compiled module is the per-DEVICE SPMD program: it processes
     # batch/device_count images, so normalize by the per-device batch
     per_dev_imgs = args.batch / jax.device_count()
@@ -68,6 +73,10 @@ def main():
     if bytes_acc:
         print(f"  arithmetic intensity:    {flops / bytes_acc:.1f} flops/byte")
     print(f"  (at R img/s/chip, effective TFLOPs/chip = R * {per_img:.3e} / 1e12)")
+    peak = obs_flops.peak_flops_per_device()
+    if peak:
+        print(f"  device peak (table):     {peak / 1e12:.1f} TFLOP/s "
+              f"-> MFU = R * {per_img:.3e} / {peak:.3e}")
 
 
 if __name__ == "__main__":

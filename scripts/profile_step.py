@@ -1,28 +1,28 @@
 """Profile the SPMD train step and print a per-op device-time breakdown.
 
 TensorBoard isn't available on headless pods, so this parses the
-`jax.profiler` trace export (perfetto/chrome JSON inside
-`plugins/profile/<run>/*.trace.json.gz`) directly and aggregates complete
-('X') events on device tracks by op name — the profile-guided-fusion loop
-(VERDICT round-1 #1) without leaving the terminal.
+`jax.profiler` trace export directly via ``distribuuuu_tpu.obs.traceparse``
+(the shared perfetto parser the in-run profiler windows journal through —
+see docs/OBSERVABILITY.md) — the profile-guided-fusion loop (VERDICT
+round-1 #1) without leaving the terminal.
 
     python scripts/profile_step.py [--arch resnet50] [--batch 512] [--steps 5]
 
 The benched configuration matches bench.py's shipped-best arm (bf16 BN
 boundaries, s2d stem on resnet/botnet families); the same env opt-outs
-apply (DTPU_BENCH_BNF32=1, DTPU_BENCH_S2D=0).
+apply (DTPU_BENCH_BNF32=1, DTPU_BENCH_S2D=0). For profiling a *live
+training run* instead of this synthetic loop, use OBS.PROFILE_AT_STEPS or
+send the run SIGUSR1.
 """
 
 import argparse
-import glob
-import gzip
-import json
 import os
 import sys
 import tempfile
-from collections import defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distribuuuu_tpu.obs.traceparse import load_trace_events, summarize_device_ops
 
 
 def run_and_trace(per_chip_batch: int, steps: int, logdir: str) -> str:
@@ -57,49 +57,6 @@ def run_and_trace(per_chip_batch: int, steps: int, logdir: str) -> str:
     return arch
 
 
-def load_trace_events(logdir: str):
-    paths = sorted(
-        glob.glob(os.path.join(logdir, "plugins", "profile", "*", "*.trace.json.gz"))
-    )
-    if not paths:
-        raise FileNotFoundError(f"no trace.json.gz under {logdir}")
-    with gzip.open(paths[-1], "rt") as f:
-        return json.load(f)["traceEvents"]
-
-
-def summarize(events, top: int):
-    # pid -> process (track) name from metadata events
-    track = {}
-    for e in events:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
-            track[e["pid"]] = e.get("args", {}).get("name", "")
-
-    def is_device(pid) -> bool:
-        name = track.get(pid, "").lower()
-        return ("tpu" in name or "device" in name or "xla ops" in name) and (
-            "host" not in name
-        )
-
-    by_op = defaultdict(float)
-    by_cat = defaultdict(float)
-    total = 0.0
-    for e in events:
-        if e.get("ph") != "X" or not is_device(e.get("pid")) or "dur" not in e:
-            continue
-        name = e["name"]
-        # skip the whole-module envelope and the step-number marker tracks —
-        # they overlap the individual op executions and would double-count
-        if name.startswith("jit_") or name.isdigit():
-            continue
-        by_op[name] += e["dur"]
-        # category = fusion kind without the ".N" instance suffix
-        by_cat[name.split(".", 1)[0]] += e["dur"]
-        total += e["dur"]
-    rows = sorted(by_op.items(), key=lambda kv: -kv[1])[:top]
-    cats = sorted(by_cat.items(), key=lambda kv: -kv[1])[:top]
-    return rows, cats, total, sorted(set(track.values()))
-
-
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--arch", default=None, help="override DTPU_BENCH_ARCH")
@@ -114,7 +71,7 @@ def main() -> None:
     logdir = args.logdir or tempfile.mkdtemp(prefix="dtpu_profile_")
     arch = run_and_trace(args.batch, args.steps, logdir)
     events = load_trace_events(logdir)
-    rows, cats, total, tracks = summarize(events, args.top)
+    rows, cats, total, tracks = summarize_device_ops(events, args.top)
 
     print(f"tracks: {tracks}")
     print(
