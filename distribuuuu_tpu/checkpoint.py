@@ -27,11 +27,39 @@ checkpoints (``ckpt_mid_ep_{E:03d}_it_{S:06d}``, written on preemption and
 pruned once a durable epoch checkpoint dominates them), `restore_latest`
 (resume-position ranking across both kinds, with corrupt-checkpoint
 fallback), and retry-with-backoff around the Orbax save/restore dispatch.
+
+Elastic & integrity extensions (this layer's distributed-failure story):
+
+- **Elastic restore**: restores are *target-sharding-driven* — every leaf is
+  restored with explicit ``ArrayRestoreArgs(sharding=...)`` taken from the
+  caller's state templates, so a run saved on an N-device mesh restores onto
+  an M-device mesh (Orbax's default resurrects the SAVED mesh from the
+  ``_sharding`` file, which breaks the moment the topology changes).
+  Checkpoint payloads record the saving topology (``devices``) and, for
+  mid-epoch checkpoints, the fleet-wide ``global_samples`` consumed in the
+  in-progress epoch plus the ``samples_per_step`` they were consumed at —
+  `load_mid_checkpoint` remaps the resume step from the sample offset so a
+  2→4 device resume consumes the exact same sample stream.
+- **Integrity manifests**: after each save commits, a per-file sha256
+  manifest (``dtpu_manifest.json``, covering every serialized array shard)
+  is written into the checkpoint directory on a background thread and
+  journaled via `obs`. `verify_checkpoint` re-hashes at restore time; a
+  failed verify QUARANTINES the directory (rename to ``corrupt_*``, typed
+  ``ckpt_quarantined`` journal event) and `restore_latest` falls back to the
+  next-oldest candidate. ``python -m distribuuuu_tpu.checkpoint verify
+  <dir>`` runs the same check offline.
+- **Prune/restore race guard**: the checkpoint a restore has selected is
+  registered in-flight and `prune_mid_checkpoints` will not delete it out
+  from under the restore.
 """
 
 from __future__ import annotations
 
+import contextlib
+import hashlib
+import json
 import re
+import threading
 import time
 from typing import Any
 
@@ -47,6 +75,15 @@ _NAME_PREFIX = "ckpt_ep_"
 _DIR_NAME = "checkpoints"
 _BEST_NAME = "best"
 _MID_FMT = "ckpt_mid_ep_{epoch:03d}_it_{step:06d}"
+_MANIFEST_NAME = "dtpu_manifest.json"
+_CORRUPT_PREFIX = "corrupt_"
+
+
+class ElasticResumeError(RuntimeError):
+    """A mid-epoch checkpoint's sample offset cannot be represented on the
+    new topology (offset not divisible by the new fleet samples-per-step).
+    `restore_latest` skips the checkpoint and falls back — epoch-boundary
+    checkpoints are always topology-safe (offset 0)."""
 
 
 def get_checkpoint_dir(out_dir: str) -> str:
@@ -115,6 +152,204 @@ def get_last_checkpoint(out_dir: str) -> str:
     return ckpts[-1][1]
 
 
+# ---------------------------------------------------------------------------
+# Integrity manifests (per-file checksums over the serialized checkpoint)
+# ---------------------------------------------------------------------------
+
+def manifest_path(ckpt_path: str) -> str:
+    return pathio.join(ckpt_path, _MANIFEST_NAME)
+
+
+def _hash_file(path: str) -> tuple[int, str]:
+    # streamed, not slurped: OCDBT data shards are multi-GB on real runs and
+    # this runs on a background thread beside training (host RAM is shared
+    # with the input pipeline's prefetch buffers)
+    h = hashlib.sha256()
+    n = 0
+    with pathio.open_bytes(path) as f:
+        while True:
+            chunk = f.read(4 * 1024 * 1024)
+            if not chunk:
+                break
+            h.update(chunk)
+            n += len(chunk)
+    return n, h.hexdigest()
+
+
+def write_manifest(ckpt_path: str) -> dict:
+    """Hash every file of a committed checkpoint directory into
+    ``dtpu_manifest.json`` (excluding the manifest itself). Returns the
+    manifest dict. The entries are per *file*, which covers every serialized
+    array shard (OCDBT data files, metadata, sharding descriptors) — a
+    byte-flip anywhere in the directory fails the verify."""
+    tic = time.time()
+    files: dict[str, dict] = {}
+    total = 0
+    for rel in pathio.walk_files(ckpt_path):
+        if rel == _MANIFEST_NAME or rel.endswith(f"/{_MANIFEST_NAME}"):
+            continue
+        n, digest = _hash_file(pathio.join(ckpt_path, rel))
+        files[rel] = {"bytes": n, "sha256": digest}
+        total += n
+    manifest = {"version": 1, "algo": "sha256", "files": files}
+    pathio.write_text(manifest_path(ckpt_path), json.dumps(manifest, sort_keys=True))
+    obs.current().event(
+        "manifest", path=str(ckpt_path), files=len(files), bytes=total,
+        wall_s=round(time.time() - tic, 4),
+    )
+    return manifest
+
+
+def verify_checkpoint(ckpt_path: str) -> tuple[str, list[str]]:
+    """Re-hash a checkpoint directory against its manifest.
+
+    Returns ``(status, errors)`` with status ``"ok"`` (manifest present,
+    every file matches), ``"unverified"`` (no manifest — pre-manifest
+    checkpoint or the async manifest write hasn't landed yet; NOT an error)
+    or ``"corrupt"`` (manifest present but unreadable, a file is missing,
+    sized differently, or hashes differently; ``errors`` says which).
+    Extra files beyond the manifest are tolerated: Orbax may add metadata
+    across versions, and an addition cannot corrupt restored bytes.
+    """
+    mpath = manifest_path(ckpt_path)
+    if not pathio.exists(mpath):
+        return "unverified", []
+    try:
+        manifest = json.loads(pathio.read_bytes(mpath).decode("utf-8"))
+        entries = manifest["files"]
+    except Exception as exc:
+        return "corrupt", [f"unreadable manifest: {exc!r}"]
+    errors: list[str] = []
+    for rel, want in sorted(entries.items()):
+        fpath = pathio.join(ckpt_path, rel)
+        if not pathio.exists(fpath):
+            errors.append(f"{rel}: missing")
+            continue
+        try:
+            n, digest = _hash_file(fpath)
+        except OSError as exc:
+            errors.append(f"{rel}: unreadable ({exc!r})")
+            continue
+        if n != want.get("bytes"):
+            errors.append(f"{rel}: size {n} != manifest {want.get('bytes')}")
+        elif digest != want.get("sha256"):
+            errors.append(f"{rel}: sha256 mismatch")
+    return ("corrupt", errors) if errors else ("ok", [])
+
+
+def quarantine_checkpoint(ckpt_path: str, errors: list[str]) -> str | None:
+    """Move a corrupt checkpoint aside (``corrupt_<name>``) so no later scan
+    retries it, with a typed journal event and a rank-0-visible error. The
+    exact-name resume regexes never match the prefix, so a quarantined
+    directory is invisible to auto-resume even if the rename target varies.
+    Returns the quarantine path, or None when the rename itself failed (the
+    caller must still skip the checkpoint)."""
+    parent, name = str(ckpt_path).rstrip("/").rsplit("/", 1)
+    target = pathio.join(parent, f"{_CORRUPT_PREFIX}{name}")
+    n = 0
+    while pathio.exists(target):  # repeated corruption of a recycled name
+        n += 1
+        target = pathio.join(parent, f"{_CORRUPT_PREFIX}{name}.{n}")
+    try:
+        pathio.rename(str(ckpt_path), target)
+    except Exception as exc:
+        logger.error(f"could not quarantine corrupt checkpoint {ckpt_path}: {exc!r}")
+        target = None
+    logger.error(
+        f"Checkpoint {ckpt_path} FAILED integrity verification "
+        f"({len(errors)} error(s), first: {errors[0] if errors else '?'}); "
+        + (f"quarantined to {target}" if target else "quarantine rename failed")
+    )
+    obs.current().event(
+        "ckpt_quarantined", path=str(ckpt_path),
+        quarantine_path=str(target) if target else "",
+        errors=errors[:8],
+    )
+    return target
+
+
+# Manifest writes for ASYNC saves ride a small background thread that waits
+# for Orbax's commit (the rename of the tmp dir is its last act, so once the
+# final directory exists its contents are complete). (thread, path) pairs are
+# tracked so wait_for_saves can make manifests durable too — but a thread
+# whose directory never appeared (failed background write) is skipped, not
+# waited out.
+_MANIFEST_THREADS: list[tuple[threading.Thread, str]] = []
+_manifest_threads_lock = threading.Lock()
+
+
+def _manifest_after_commit(path: str, deadline_s: float = 900.0) -> None:
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            if pathio.isdir(path):
+                # same transient-I/O policy as the save that produced the
+                # checkpoint: one object-store 503 must not leave the
+                # directory permanently unverifiable
+                resilience.retry(
+                    write_manifest, path, retry_on=(OSError,),
+                    desc=f"manifest write {path}",
+                )
+                return
+        except Exception as exc:
+            logger.warning(f"manifest write for {path} failed: {exc!r}")
+            return
+        time.sleep(0.05)
+    logger.warning(f"manifest writer gave up waiting for {path} to commit")
+
+
+def _spawn_manifest_writer(path: str) -> None:
+    t = threading.Thread(
+        target=_manifest_after_commit, args=(path,), daemon=True,
+        name="dtpu-ckpt-manifest",
+    )
+    with _manifest_threads_lock:
+        _MANIFEST_THREADS[:] = [(x, p) for x, p in _MANIFEST_THREADS if x.is_alive()]
+        _MANIFEST_THREADS.append((t, path))
+    t.start()
+
+
+def _join_manifest_writers() -> None:
+    with _manifest_threads_lock:
+        pending = list(_MANIFEST_THREADS)
+    for t, path in pending:
+        if t.is_alive() and pathio.isdir(path):
+            t.join(timeout=120.0)
+
+
+# ---------------------------------------------------------------------------
+# Prune/restore race guard
+# ---------------------------------------------------------------------------
+
+# Paths a restore has selected and not yet finished reading, with nesting
+# counts (restore_latest holds the guard around verify+load, and the inner
+# _restore re-enters it). prune_mid_checkpoints consults this so the
+# checkpoint under an in-flight restore is never deleted mid-read.
+_inflight_lock = threading.Lock()
+_restores_in_flight: dict[str, int] = {}
+
+
+@contextlib.contextmanager
+def restore_guard(path: str):
+    path = str(path)
+    with _inflight_lock:
+        _restores_in_flight[path] = _restores_in_flight.get(path, 0) + 1
+    try:
+        yield
+    finally:
+        with _inflight_lock:
+            n = _restores_in_flight.get(path, 1) - 1
+            if n <= 0:
+                _restores_in_flight.pop(path, None)
+            else:
+                _restores_in_flight[path] = n
+
+
+def restore_in_flight(path: str) -> bool:
+    with _inflight_lock:
+        return _restores_in_flight.get(str(path), 0) > 0
+
+
 # Two async checkpointers so an epoch save and a ``best`` refresh can be in
 # flight concurrently; each serializes with itself (wait before next save).
 _CKPTRS: dict[str, ocp.AsyncCheckpointer] = {}
@@ -127,9 +362,25 @@ def _checkpointer(which: str = "epoch") -> ocp.AsyncCheckpointer:
 
 
 def wait_for_saves() -> None:
-    """Block until every in-flight async save is committed to disk."""
+    """Block until every in-flight async save is committed to disk (and its
+    integrity manifest, when the commit landed, is written)."""
     for c in _CKPTRS.values():
         c.wait_until_finished()
+    _join_manifest_writers()
+
+
+def _state_device_count(state: Any) -> int:
+    """Fleet device count the state is committed on (the saving topology
+    recorded into checkpoint metadata). Falls back to the process-global
+    count for host-resident trees (unit-test states)."""
+    for leaf in jax.tree.leaves(state.params):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None:
+            try:
+                return len(sharding.device_set)
+            except Exception:
+                break
+    return jax.device_count()
 
 
 def save_checkpoint(out_dir: str, epoch: int, state: Any, best_acc1: float, is_best: bool) -> str:
@@ -143,6 +394,10 @@ def save_checkpoint(out_dir: str, epoch: int, state: Any, best_acc1: float, is_b
         "batch_stats": state.batch_stats,
         "opt_state": state.opt_state,
         "best_acc1": np.float32(best_acc1),
+        # saving topology: informational for epoch checkpoints (their resume
+        # offset is 0, which every topology can represent), load-bearing for
+        # the elastic remap in mid-epoch ones
+        "devices": np.int32(_state_device_count(state)),
     }
     path = get_checkpoint_path(out_dir, epoch + 1)
     ckptr = _checkpointer("epoch")
@@ -168,6 +423,7 @@ def save_checkpoint(out_dir: str, epoch: int, state: Any, best_acc1: float, is_b
         "checkpoint", ckpt_kind="epoch", path=path, epoch=epoch,
         wall_s=round(time.time() - tic, 4), synchronous=False,
     )
+    _spawn_manifest_writer(path)
     if is_best:
         best = _checkpointer("best")
         _wait_tolerating_failure(best, "previous best checkpoint")
@@ -183,6 +439,7 @@ def save_checkpoint(out_dir: str, epoch: int, state: Any, best_acc1: float, is_b
             "checkpoint", ckpt_kind="best", path=get_best_path(out_dir),
             epoch=epoch, wall_s=round(time.time() - tic, 4), synchronous=False,
         )
+        _spawn_manifest_writer(get_best_path(out_dir))
     return path
 
 
@@ -218,7 +475,8 @@ def _wait_tolerating_failure(ckptr: ocp.AsyncCheckpointer, what: str) -> bool:
 
 
 def save_mid_checkpoint(
-    out_dir: str, epoch: int, step: int, state: Any, best_acc1: float, rng_key: Any
+    out_dir: str, epoch: int, step: int, state: Any, best_acc1: float, rng_key: Any,
+    samples_per_step: int | None = None,
 ) -> str:
     """Emergency mid-epoch checkpoint for graceful preemption.
 
@@ -226,6 +484,12 @@ def save_mid_checkpoint(
     the ``step`` (batches of that epoch already consumed — resume skips
     exactly that many) and the host ``rng_key`` (the trainer's dropout key,
     so runs with ``RNG_SEED None`` resume with the same stream).
+
+    ``samples_per_step`` (fleet-wide samples one optimizer step consumes:
+    ``BATCH_SIZE × ACCUM_STEPS × mesh devices``) additionally records the
+    topology-independent resume position ``global_samples = step ×
+    samples_per_step`` — what elastic restore remaps the fast-forward from
+    when the relaunch has a different device count.
 
     Synchronous, unlike the epoch save: the process is about to exit, and
     the retry must cover the *whole* write — a transient failure in the
@@ -240,7 +504,11 @@ def save_mid_checkpoint(
         "opt_state": state.opt_state,
         "best_acc1": np.float32(best_acc1),
         "rng_key": np.asarray(jax.device_get(rng_key)),
+        "devices": np.int32(_state_device_count(state)),
     }
+    if samples_per_step is not None and samples_per_step > 0:
+        payload["samples_per_step"] = np.int32(samples_per_step)
+        payload["global_samples"] = np.int64(int(step) * int(samples_per_step))
     path = get_mid_checkpoint_path(out_dir, epoch, step)
     ckptr = _checkpointer("mid")
     _wait_tolerating_failure(ckptr, "previous emergency checkpoint")
@@ -261,6 +529,26 @@ def save_mid_checkpoint(
         "checkpoint", ckpt_kind="emergency", path=path, epoch=epoch, step=step,
         wall_s=round(time.time() - tic, 4), synchronous=True,
     )
+    # inline, not on the background thread: the process is exiting, and the
+    # relaunch must be able to integrity-verify this checkpoint
+    try:
+        write_manifest(path)
+    except Exception as exc:
+        logger.warning(f"manifest write for emergency checkpoint failed: {exc!r}")
+    # Older mid checkpoints of the SAME epoch are strictly dominated by this
+    # one (the run that wrote it resumed from at-or-past them), so drop them
+    # now. Load-bearing after a topology change: restore_latest ranks mids
+    # by raw step number, and steps are incomparable across topologies — a
+    # stale pre-resize mid with a bigger step number would otherwise outrank
+    # this strictly-more-advanced one on every future relaunch.
+    for e2, s2, old in _mid_checkpoints(out_dir):
+        if e2 == epoch and old != path:
+            if restore_in_flight(old):
+                continue  # next save or epoch-boundary prune gets it
+            try:
+                pathio.rmtree(old)
+            except Exception as exc:
+                logger.warning(f"could not prune superseded emergency checkpoint {old}: {exc!r}")
     return path
 
 
@@ -272,6 +560,14 @@ def prune_mid_checkpoints(out_dir: str, before_epoch: int) -> None:
     failed cleanup must never kill the save path that invoked it."""
     for e, s, path in _mid_checkpoints(out_dir):
         if e < before_epoch:
+            if restore_in_flight(path):
+                # another thread (or a relaunch helper) is mid-restore from
+                # this checkpoint: deleting it now would fail that restore.
+                # Skip — the next prune pass gets it once the restore ends.
+                logger.warning(
+                    f"not pruning {path}: a restore from it is in flight"
+                )
+                continue
             try:
                 pathio.rmtree(path)
             except Exception as exc:
@@ -282,23 +578,61 @@ def _as_template(tree):
     return jax.tree.map(lambda x: ocp.utils.to_shape_dtype_struct(x), tree)
 
 
+def _restore_args_for(template):
+    """Explicit per-leaf restore args carrying the TARGET sharding.
+
+    This is what makes restore elastic: without it Orbax resurrects the
+    sharding recorded at save time from the ``_sharding`` file ("unsafe when
+    restoring on a different topology than the checkpoint was saved with",
+    per its own warning) — i.e. a checkpoint written on an N-device mesh
+    would come back pinned to those N devices. With the caller's templates
+    as the source of truth, restored arrays land directly on the new mesh.
+    Non-array template leaves (np scalars, host rng keys) restore as numpy.
+    """
+
+    def one(t):
+        sharding = getattr(t, "sharding", None)
+        if sharding is not None:
+            return ocp.ArrayRestoreArgs(
+                sharding=sharding, global_shape=t.shape, dtype=t.dtype
+            )
+        return ocp.RestoreArgs(restore_type=np.ndarray)
+
+    return jax.tree.map(one, template)
+
+
 def _restore(path: str, template: dict):
-    """Retryable restore: transient object-store hiccups are retried; a
-    genuinely corrupt directory exhausts the retries and raises (callers that
-    can fall back catch it — see restore_latest)."""
+    """Retryable target-sharding-driven restore: transient object-store
+    hiccups are retried; a genuinely corrupt directory exhausts the retries
+    and raises (callers that can fall back catch it — see restore_latest)."""
     ckptr = _checkpointer()
     tic = time.time()
-    restored = resilience.retry(
-        ckptr.restore,
-        path,
-        args=ocp.args.PyTreeRestore(item=template),
-        retry_on=(OSError,),
-        desc=f"checkpoint restore {path}",
-    )
+    with restore_guard(path):
+        restored = resilience.retry(
+            ckptr.restore,
+            path,
+            args=ocp.args.PyTreeRestore(
+                item=template, restore_args=_restore_args_for(template)
+            ),
+            retry_on=(OSError,),
+            desc=f"checkpoint restore {path}",
+        )
     obs.current().event(
         "restore", path=path, wall_s=round(time.time() - tic, 4)
     )
     return restored
+
+
+def _payload_names(path: str) -> set[str]:
+    """Top-level payload key names of a checkpoint, across orbax metadata
+    generations: the modern CheckpointMetadata wrapper, the bare tree
+    object, or (oldest) a plain dict tree."""
+    meta = _checkpointer().metadata(path)
+    if hasattr(meta, "item_metadata"):
+        return set(meta.item_metadata.tree.keys())
+    if hasattr(meta, "tree"):
+        return set(meta.tree.keys())
+    return set(meta.keys())
 
 
 def load_checkpoint(path: str, state: Any, load_opt: bool = True):
@@ -308,20 +642,12 @@ def load_checkpoint(path: str, state: Any, load_opt: bool = True):
     mirroring the reference's graceful weights-only fallback (`utils.py:391-410`).
     ``load_opt=False`` skips optimizer state (the TRAIN.LOAD_OPT warm-start
     knob, reference `trainer.py:147-149`). Restored arrays adopt the sharding
-    of the templates in ``state``.
+    of the templates in ``state`` — including onto a mesh with a different
+    device count than the one that saved them (elastic restore; epoch
+    boundaries are always topology-safe because their resume offset is 0).
     """
     wait_for_saves()  # the path may be a save still committing in background
-    ckptr = _checkpointer()
-    meta = ckptr.metadata(path)
-    # top-level payload key names across orbax metadata generations: the
-    # modern CheckpointMetadata wrapper, the bare tree object, or (oldest)
-    # a plain dict tree
-    if hasattr(meta, "item_metadata"):
-        names = set(meta.item_metadata.tree.keys())
-    elif hasattr(meta, "tree"):
-        names = set(meta.tree.keys())
-    else:
-        names = set(meta.keys())
+    names = _payload_names(path)
 
     template = {"params": _as_template(state.params), "batch_stats": _as_template(state.batch_stats)}
     full = {"epoch", "opt_state", "best_acc1"} <= names
@@ -333,6 +659,8 @@ def load_checkpoint(path: str, state: Any, load_opt: bool = True):
                 "best_acc1": np.float32(0.0),
             }
         )
+    if "devices" in names:
+        template["devices"] = np.int32(0)
     restored = _restore(path, template)
     new_state = state.replace(params=restored["params"], batch_stats=restored["batch_stats"])
     if full:
@@ -342,11 +670,23 @@ def load_checkpoint(path: str, state: Any, load_opt: bool = True):
     return new_state, 0, 0.0
 
 
-def load_mid_checkpoint(path: str, state: Any):
+def load_mid_checkpoint(path: str, state: Any, samples_per_step: int | None = None):
     """Restore an emergency checkpoint: (state, epoch, step, best_acc1,
     rng_key). ``epoch`` is the in-progress 0-based epoch to re-enter and
-    ``step`` the number of its batches already consumed."""
+    ``step`` the number of its batches already consumed *at this run's
+    topology*.
+
+    Elastic remap: when the checkpoint recorded a ``global_samples`` offset
+    and the caller passes its own ``samples_per_step``, the returned step is
+    ``global_samples // samples_per_step`` — the relaunch fast-forwards past
+    the exact samples the interrupted run consumed even when its device
+    count (and therefore its per-step appetite) changed. An offset the new
+    topology cannot hit exactly (not divisible) raises `ElasticResumeError`:
+    replaying or skipping a partial step would silently change the sample
+    stream, so `restore_latest` falls back to an older checkpoint instead.
+    """
     wait_for_saves()
+    names = _payload_names(path)
     template = {
         "epoch": np.int32(0),
         "step": np.int32(0),
@@ -356,16 +696,50 @@ def load_mid_checkpoint(path: str, state: Any):
         "best_acc1": np.float32(0.0),
         "rng_key": np.zeros((2,), np.uint32),
     }
+    for name, zero in (
+        ("devices", np.int32(0)),
+        ("samples_per_step", np.int32(0)),
+        ("global_samples", np.int64(0)),
+    ):
+        if name in names:
+            template[name] = zero
     restored = _restore(path, template)
     new_state = state.replace(
         params=restored["params"],
         batch_stats=restored["batch_stats"],
         opt_state=restored["opt_state"],
     )
+    saved_step = int(restored["step"])
+    step = saved_step
+    saved_sps = int(restored.get("samples_per_step", 0))
+    if samples_per_step and saved_sps and samples_per_step != saved_sps:
+        global_samples = int(restored["global_samples"])
+        if global_samples % samples_per_step != 0:
+            raise ElasticResumeError(
+                f"checkpoint {path} was saved at sample offset {global_samples} "
+                f"({saved_step} steps × {saved_sps} samples/step); the new "
+                f"topology consumes {samples_per_step} samples/step, which "
+                f"cannot land on that offset exactly"
+            )
+        step = global_samples // samples_per_step
+        saved_devices = int(restored.get("devices", 0))
+        logger.info(
+            f"Elastic resume: remapped step {saved_step} "
+            f"(@{saved_sps} samples/step"
+            + (f", {saved_devices} devices" if saved_devices else "")
+            + f") -> step {step} (@{samples_per_step} samples/step) at sample "
+            f"offset {global_samples}"
+        )
+        obs.current().event(
+            "elastic_resume", path=path, global_samples=global_samples,
+            saved_step=saved_step, saved_samples_per_step=saved_sps,
+            step=step, samples_per_step=int(samples_per_step),
+            saved_devices=saved_devices,
+        )
     return (
         new_state,
         int(restored["epoch"]),
-        int(restored["step"]),
+        step,
         float(restored["best_acc1"]),
         np.asarray(restored["rng_key"]),
     )
@@ -378,6 +752,8 @@ def restore_latest(
     step_granular: bool = True,
     skip_corrupt: bool = True,
     load_opt: bool = True,
+    verify_integrity: bool = True,
+    samples_per_step: int | None = None,
 ):
     """Resume from the most-advanced restorable checkpoint in ``out_dir``.
 
@@ -385,10 +761,27 @@ def restore_latest(
     ``(N, 0)``) and — when ``step_granular`` — mid-epoch emergency
     checkpoints (position ``(epoch, step)``). The highest resume position
     wins; at an equal position a complete epoch checkpoint is preferred over
-    an emergency one. With ``skip_corrupt``, a candidate that fails to
-    restore (corrupt or partial — e.g. the node died while Orbax was
-    finalizing) is skipped with a warning and the next-highest is tried, so
-    one bad directory can never wedge the restart loop.
+    an emergency one.
+
+    Robustness, per candidate (each emits a typed journal event plus a
+    rank-0-visible warning — a skipped checkpoint is never silent):
+
+    - ``verify_integrity``: the checksum manifest is re-verified first; a
+      corrupt candidate is QUARANTINED (renamed ``corrupt_*``,
+      ``ckpt_quarantined`` event) and the next-highest tried.
+    - ``skip_corrupt``: a candidate that fails to restore anyway (partial
+      write the manifest couldn't see — e.g. no manifest yet) is skipped
+      (``ckpt_skipped`` event), so one bad directory can never wedge the
+      restart loop.
+    - Elastic: ``samples_per_step`` (the new topology's fleet-wide samples
+      per optimizer step) remaps mid-epoch resume positions from the saved
+      sample offset; a position the new topology cannot hit exactly skips
+      that candidate (``ckpt_skipped``, reason ``elastic``) and falls back —
+      typically to the epoch-boundary checkpoint, which is always safe.
+
+    The selected candidate is held in a `restore_guard` for the whole
+    verify+restore, so a concurrent `prune_mid_checkpoints` cannot delete
+    it mid-read.
 
     Returns ``(state, start_epoch, start_step, best_acc1, rng_key | None,
     path)``, or ``None`` when nothing is restorable.
@@ -400,17 +793,112 @@ def restore_latest(
         candidates += [((e, s, 0), "mid", p) for e, s, p in _mid_checkpoints(out_dir)]
     candidates.sort(key=lambda c: c[0], reverse=True)
     for _, kind, path in candidates:
-        try:
-            if kind == "epoch":
-                st, start_epoch, best = load_checkpoint(path, state, load_opt=load_opt)
-                return st, start_epoch, 0, best, None, path
-            st, epoch, step, best, rng_key = load_mid_checkpoint(path, state)
-            return st, epoch, step, best, rng_key, path
-        except Exception as exc:
-            if not skip_corrupt:
-                raise
-            logger.warning(
-                f"Checkpoint {path} failed to restore ({exc!r}); "
-                f"falling back to the next-highest checkpoint"
-            )
+        with restore_guard(path):
+            if verify_integrity:
+                status, errors = verify_checkpoint(path)
+                if status == "corrupt":
+                    quarantine_checkpoint(path, errors)  # warns + journals
+                    continue
+            try:
+                if kind == "epoch":
+                    st, start_epoch, best = load_checkpoint(path, state, load_opt=load_opt)
+                    return st, start_epoch, 0, best, None, path
+                st, epoch, step, best, rng_key = load_mid_checkpoint(
+                    path, state, samples_per_step=samples_per_step
+                )
+                return st, epoch, step, best, rng_key, path
+            except ElasticResumeError as exc:
+                # not corruption: the checkpoint is fine, the new topology
+                # just can't express its resume offset. Always fall back.
+                logger.warning(
+                    f"Checkpoint {path} skipped for elastic resume ({exc}); "
+                    f"falling back to the next-highest checkpoint"
+                )
+                obs.current().event(
+                    "ckpt_skipped", path=path, reason="elastic", error=str(exc)
+                )
+            except Exception as exc:
+                if not skip_corrupt:
+                    raise
+                logger.warning(
+                    f"Checkpoint {path} failed to restore ({exc!r}); "
+                    f"falling back to the next-highest checkpoint"
+                )
+                obs.current().event(
+                    "ckpt_skipped", path=path, reason="restore_failed",
+                    error=repr(exc),
+                )
     return None
+
+
+# ---------------------------------------------------------------------------
+# CLI: offline integrity verification
+# ---------------------------------------------------------------------------
+
+def _looks_like_checkpoint(path: str) -> bool:
+    return pathio.exists(manifest_path(path)) or pathio.exists(
+        pathio.join(path, "_CHECKPOINT_METADATA")
+    )
+
+
+def _cli_targets(path: str) -> list[str]:
+    """Checkpoint directories named by a CLI path: a single checkpoint dir,
+    a ``checkpoints/`` dir, or an OUT_DIR containing one."""
+    if _looks_like_checkpoint(path):
+        return [path]
+    scan = path
+    if pathio.isdir(pathio.join(path, _DIR_NAME)):
+        scan = get_checkpoint_dir(path)
+    if not pathio.isdir(scan):
+        return []
+    out = []
+    for name in sorted(pathio.listdir(scan)):
+        child = pathio.join(scan, name)
+        if (_CKPT_RE.match(name) or _MID_RE.match(name) or name == _BEST_NAME) and pathio.isdir(child):
+            out.append(child)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    """``python -m distribuuuu_tpu.checkpoint verify <dir>`` — re-hash one
+    checkpoint (or every checkpoint under an OUT_DIR) against its integrity
+    manifest. Exit 0 when nothing is corrupt, 1 otherwise. ``--quarantine``
+    additionally moves corrupt directories aside the way `restore_latest`
+    would."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m distribuuuu_tpu.checkpoint",
+        description="Checkpoint integrity tools (docs/FAULT_TOLERANCE.md)",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    v = sub.add_parser("verify", help="verify checksum manifests")
+    v.add_argument("path", help="checkpoint dir, checkpoints/ dir, or OUT_DIR")
+    v.add_argument(
+        "--quarantine", action="store_true",
+        help="rename corrupt checkpoints to corrupt_* (what auto-resume does)",
+    )
+    args = parser.parse_args(argv)
+
+    targets = _cli_targets(args.path)
+    if not targets:
+        print(f"no checkpoints found under {args.path}")
+        return 1
+    n_corrupt = 0
+    for t in targets:
+        status, errors = verify_checkpoint(t)
+        print(f"{status.upper():10s} {t}")
+        for e in errors:
+            print(f"           - {e}")
+        if status == "corrupt":
+            n_corrupt += 1
+            if args.quarantine:
+                q = quarantine_checkpoint(t, errors)
+                if q:
+                    print(f"           quarantined -> {q}")
+    print(f"{len(targets)} checkpoint(s), {n_corrupt} corrupt")
+    return 1 if n_corrupt else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

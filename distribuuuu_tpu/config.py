@@ -149,12 +149,22 @@ _C.FAULT.RETRY_MAX_DELAY = 2.0
 _C.FAULT.DEGRADE = True
 # Install the SIGTERM/SIGINT → graceful-preemption handler in train_model.
 _C.FAULT.HANDLE_SIGNALS = True
+# Distributed watchdog (docs/FAULT_TOLERANCE.md): seconds without step-loop
+# progress before a rank dumps all-thread stacks, journals a ``hang`` event
+# and exits nonzero (resilience.HANG_EXIT_CODE) — turning a dead peer in a
+# collective into a bounded-time, diagnosed failure instead of a silent
+# stall. 0 disables. Must comfortably exceed the first-step compile time.
+_C.FAULT.HANG_TIMEOUT_S = 0.0
 # Deterministic fault injection (test-only; DTPU_FAULT_* env vars override —
 # see resilience.FaultInjector). All inert at these defaults.
 _C.FAULT.INJECT_IO_INDICES = []
 _C.FAULT.INJECT_IO_FAILURES = 1
 _C.FAULT.INJECT_NAN_STEPS = []
 _C.FAULT.INJECT_PREEMPT_STEP = -1
+# Chaos modes: simulate a stalled step (sleep forever — the watchdog's prey)
+# or a hard rank death (SIGKILL, no cleanup) exactly before this global step.
+_C.FAULT.INJECT_HANG_STEP = -1
+_C.FAULT.INJECT_KILL_STEP = -1
 
 # Observability (TPU addition; docs/OBSERVABILITY.md). The structured
 # telemetry subsystem: rank-0 JSONL metrics journal, MFU/goodput accounting,
@@ -192,6 +202,10 @@ _C.RESUME.STEP_GRANULAR = True
 # A corrupt/partial highest checkpoint is skipped with a warning (fall back
 # to the next-highest) instead of crashing the restart loop.
 _C.RESUME.SKIP_CORRUPT = True
+# Verify the per-file checksum manifest before restoring a checkpoint; a
+# failed verify QUARANTINES the directory (rename to ``corrupt_*``, typed
+# journal event) and restore_latest falls back to the next-oldest.
+_C.RESUME.VERIFY_INTEGRITY = True
 
 # Output directory
 _C.OUT_DIR = "./exp"

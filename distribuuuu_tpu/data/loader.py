@@ -112,8 +112,20 @@ class HostDataLoader:
         ``start_batch`` fast-forwards the epoch for step-granular resume: the
         producer starts at that batch index without decoding the skipped
         samples (the shuffle and per-slot augmentation seeds are pure
-        functions of (seed, epoch, index), so the replay is exact).
+        functions of (seed, epoch, index), so the replay is exact). On an
+        elastic resume the trainer derives it from the checkpoint's *global
+        sample offset* (fleet samples consumed this epoch ÷ this topology's
+        samples per step, `checkpoint.load_mid_checkpoint`), so the batch
+        index is already in THIS topology's units — the loader never needs
+        to know the saving topology. An offset past the epoch means the
+        remap went wrong; fail loudly rather than silently yield an empty
+        epoch.
         """
+        if not 0 <= start_batch <= self.num_batches:
+            raise ValueError(
+                f"set_epoch(start_batch={start_batch}) outside this "
+                f"topology's epoch of {self.num_batches} batches"
+            )
         self.epoch = epoch
         self.start_batch = start_batch
 
@@ -373,13 +385,24 @@ class DummyLoader:
             yield self._batch
 
 
-def _topology():
-    return jax.process_index(), jax.process_count(), jax.local_device_count(), jax.device_count()
+def _topology(mesh=None):
+    """(process_index, process_count, local devices, global devices) — from
+    the mesh actually being trained on when given, so a submesh run (elastic
+    resume onto fewer devices than the host has, `runtime.mesh.data_mesh`)
+    sizes its host batches by the mesh, not the whole fleet."""
+    if mesh is None:
+        return jax.process_index(), jax.process_count(), jax.local_device_count(), jax.device_count()
+    return (
+        jax.process_index(),
+        jax.process_count(),
+        int(mesh.local_mesh.devices.size),
+        int(mesh.devices.size),
+    )
 
 
-def construct_train_loader():
+def construct_train_loader(mesh=None):
     """Train loader (reference `construct_train_loader`, `utils.py:121-152`)."""
-    proc, nproc, local_dev, global_dev = _topology()
+    proc, nproc, local_dev, global_dev = _topology(mesh)
     # per optimizer step each device consumes BATCH_SIZE × ACCUM_STEPS samples
     step_batch = cfg.TRAIN.BATCH_SIZE * cfg.TRAIN.ACCUM_STEPS
     host_batch = step_batch * local_dev
@@ -408,7 +431,7 @@ def construct_train_loader():
     )
 
 
-def construct_val_loader():
+def construct_val_loader(mesh=None):
     """Val loader (reference `construct_val_loader`, `utils.py:155-184`)."""
     if cfg.TEST.CROP_SIZE > cfg.TEST.IM_SIZE:
         # resize_shorter makes the shorter side exactly IM_SIZE; a larger crop
@@ -417,7 +440,7 @@ def construct_val_loader():
             f"TEST.CROP_SIZE ({cfg.TEST.CROP_SIZE}) must be <= TEST.IM_SIZE "
             f"({cfg.TEST.IM_SIZE})"
         )
-    proc, nproc, local_dev, global_dev = _topology()
+    proc, nproc, local_dev, global_dev = _topology(mesh)
     host_batch = cfg.TEST.BATCH_SIZE * local_dev
     if cfg.MODEL.DUMMY_INPUT:
         return DummyLoader(

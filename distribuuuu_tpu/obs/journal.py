@@ -115,10 +115,40 @@ SCHEMA: dict[str, tuple[dict[str, tuple], dict[str, tuple]]] = {
         {"path": _STR, "epoch": _INT, "step": _INT, "best_acc1": _NUM},
         {},
     ),
+    # integrity manifest written for a committed checkpoint
+    "manifest": (
+        {"path": _STR, "files": _INT, "bytes": _INT, "wall_s": _NUM},
+        {},
+    ),
+    # a resume candidate was skipped (failed restore / elastic mismatch)
+    "ckpt_skipped": ({"path": _STR, "reason": _STR}, {"error": _STR}),
+    # a resume candidate failed integrity verification and was moved aside
+    "ckpt_quarantined": (
+        {"path": _STR, "quarantine_path": _STR},
+        {"errors": _LIST},
+    ),
+    # a mid-epoch resume position was remapped onto a new topology
+    "elastic_resume": (
+        {
+            "path": _STR,
+            "global_samples": _INT,
+            "saved_step": _INT,
+            "saved_samples_per_step": _INT,
+            "step": _INT,
+            "samples_per_step": _INT,
+        },
+        {"saved_devices": _INT},
+    ),
     # resilience ----------------------------------------------------------
     "preempt": ({"epoch": _INT, "step": _INT, "path": _STR}, {}),
     "fault_skipped_steps": ({"epoch": _INT, "count": _INT}, {}),
     "fault_abort": ({"epoch": _INT, "step": _INT, "consecutive": _INT}, {}),
+    # the watchdog detected a stalled step loop (dead peer / wedged rank):
+    # written (and committed) just before the process hard-exits
+    "hang": (
+        {"timeout_s": _NUM, "stalled_s": _NUM, "phase": _STR},
+        {"gstep": _NUM_OR_NONE},
+    ),
     # counters / memory / profiler ---------------------------------------
     "counters": (
         {"scope": _STR, "counters": _DICT, "durations": _DICT, "waits": _DICT},

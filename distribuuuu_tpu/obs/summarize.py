@@ -123,6 +123,16 @@ def render(records: Iterable[dict]) -> str:
         f"resumes={len(by_kind['resume'])}",
         f"aborts={len(by_kind['fault_abort'])}",
     ]
+    # distributed-failure kinds: only shown when something actually happened
+    # (most runs have none, and the line stays stable for the golden test)
+    for label, kind in (
+        ("hangs", "hang"),
+        ("quarantined_ckpts", "ckpt_quarantined"),
+        ("skipped_ckpts", "ckpt_skipped"),
+        ("elastic_resumes", "elastic_resume"),
+    ):
+        if by_kind[kind]:
+            parts.append(f"{label}={len(by_kind[kind])}")
     out("")
     out("faults: " + "  ".join(parts))
 

@@ -21,12 +21,21 @@ Pieces, all config-driven via the ``FAULT`` section:
   decode, object-store checkpoint writes) in exponential backoff with full
   jitter. Callers that can degrade gracefully (the data loader) substitute a
   masked sample after the last attempt instead of failing the run.
+- **Distributed watchdog**: `Watchdog` is a heartbeat thread armed by the
+  trainer (``FAULT.HANG_TIMEOUT_S``) and beaten at every step boundary. A
+  rank whose step loop stops making progress — most commonly because a peer
+  died and this rank is stuck in a collective that will never complete —
+  dumps all-thread stacks via ``faulthandler`` into its rank log, journals a
+  typed ``hang`` event, and hard-exits with `HANG_EXIT_CODE` so the
+  scheduler can relaunch the whole job instead of burning the slice on a
+  silent stall (the MegaScale/OPT-logbook failure mode).
 - **Fault injection**: `FaultInjector` deterministically injects I/O errors
-  at chosen dataset indices, NaN batches at chosen global steps, and a
-  simulated SIGTERM at a chosen step — driven by cfg keys or ``DTPU_FAULT_*``
-  env vars so subprocess CLI runs can be fault-tested too. This is what
-  makes the whole layer exercisable by tier-1 CPU tests
-  (`tests/test_resilience.py`).
+  at chosen dataset indices, NaN batches at chosen global steps, a simulated
+  SIGTERM at a chosen step, plus chaos modes — a hung step
+  (``hang_at_step``) and a hard SIGKILL rank death (``kill_at_step``) —
+  driven by cfg keys or ``DTPU_FAULT_*`` env vars so subprocess CLI runs can
+  be fault-tested too. This is what makes the whole layer exercisable by
+  tier-1 CPU tests (`tests/test_resilience.py`, `tests/test_chaos.py`).
 - **RunStats**: host-side counters (skipped steps per epoch, substituted
   samples, retries, preemption point) — the observable surface the trainer
   logs and tests assert on.
@@ -34,9 +43,11 @@ Pieces, all config-driven via the ``FAULT`` section:
 
 from __future__ import annotations
 
+import faulthandler
 import os
 import random
 import signal
+import sys
 import threading
 import time
 from typing import Any, Callable
@@ -333,6 +344,178 @@ def uninstall_preemption_handler() -> None:
 
 
 # ---------------------------------------------------------------------------
+# Distributed watchdog (hang detection)
+# ---------------------------------------------------------------------------
+
+# GNU timeout's "command timed out" code: recognizable to supervisors, and
+# distinct from Preempted's 128+signum family.
+HANG_EXIT_CODE = 124
+
+
+def dump_all_stacks(reason: str = "") -> None:
+    """Write all-thread stack traces to stderr (→ the rank log, since rank
+    logs capture stderr). Best-effort: diagnostics must never raise."""
+    try:
+        if reason:
+            print(f"\n==== distribuuuu_tpu stack dump ({reason}) ====", file=sys.stderr, flush=True)
+        faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+        sys.stderr.flush()
+    except Exception:
+        pass
+
+
+class Watchdog:
+    """Step-progress watchdog: detects a stalled rank and kills it loudly.
+
+    The trainer calls `beat(gstep)` at every step boundary (train and eval).
+    A monitor thread checks the beat age; past ``timeout_s`` it dumps
+    all-thread stacks to the rank log (the hung collective's frame included),
+    journals a typed ``hang`` event, commits the journal + log, and
+    hard-exits via ``os._exit(HANG_EXIT_CODE)`` — `os._exit` because the
+    main thread is wedged inside a collective and will never run normal
+    exception unwinding. A dead peer thus becomes a bounded-time, diagnosed
+    failure on every surviving rank instead of an indefinite silent stall.
+
+    ``_exit_fn``/``_dump_fn`` are injectable for tests (a real fire inside
+    pytest would kill the test runner).
+    """
+
+    def __init__(
+        self,
+        timeout_s: float,
+        *,
+        poll_s: float | None = None,
+        _exit_fn: Callable[[int], None] = os._exit,
+        _dump_fn: Callable[[str], None] = dump_all_stacks,
+    ):
+        self.timeout_s = float(timeout_s)
+        self.poll_s = poll_s if poll_s is not None else max(0.05, min(1.0, self.timeout_s / 4.0))
+        self._exit_fn = _exit_fn
+        self._dump_fn = _dump_fn
+        self._last_beat = time.monotonic()
+        self._last_step: int | None = None
+        self._phase = "startup"
+        self._stop = threading.Event()
+        self._fired = threading.Event()
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "Watchdog":
+        if self.timeout_s <= 0:
+            return self  # disabled: beat()/stop() stay cheap no-ops
+        self._last_beat = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._monitor, daemon=True, name="dtpu-watchdog"
+        )
+        self._thread.start()
+        return self
+
+    def beat(self, gstep: int | None = None, phase: str = "train") -> None:
+        """Record step-loop progress (cheap: one clock read + two stores)."""
+        with self._lock:
+            self._last_beat = time.monotonic()
+            if gstep is not None:
+                self._last_step = gstep
+            self._phase = phase
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+
+    @property
+    def fired(self) -> bool:
+        return self._fired.is_set()
+
+    def _monitor(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            with self._lock:
+                age = time.monotonic() - self._last_beat
+                step, phase = self._last_step, self._phase
+            if age >= self.timeout_s:
+                self._fire(age, step, phase)
+                return
+
+    # diagnostics budget once the watchdog fires: the journal/log commits
+    # below can themselves block on dead storage (or on a lock the wedged
+    # main thread holds), and the bounded-time-exit promise outranks
+    # complete diagnostics
+    FIRE_DEADLINE_S = 20.0
+
+    def _fire(self, age: float, step: int | None, phase: str) -> None:
+        self._fired.set()
+        # armed FIRST: if any diagnostic below wedges (journal RLock held by
+        # the stalled main thread, hung NFS/GCS write), the process still
+        # exits within FIRE_DEADLINE_S
+        fallback = threading.Timer(
+            self.FIRE_DEADLINE_S, lambda: self._exit_fn(HANG_EXIT_CODE)
+        )
+        fallback.daemon = True
+        fallback.start()
+        logger.error(
+            f"WATCHDOG: no step progress for {age:.1f}s (timeout "
+            f"{self.timeout_s:.1f}s, last {phase} step "
+            f"{step if step is not None else '<none>'}) — a peer is likely "
+            f"dead and this rank is wedged in a collective; dumping stacks "
+            f"and exiting {HANG_EXIT_CODE}"
+        )
+        self._dump_fn(f"watchdog: stalled {age:.1f}s at {phase} step {step}")
+        try:
+            from distribuuuu_tpu import obs
+
+            tel = obs.current()
+            tel.event(
+                "hang",
+                timeout_s=round(self.timeout_s, 3),
+                stalled_s=round(age, 3),
+                phase=phase,
+                gstep=step,
+            )
+            tel.commit()
+        except Exception:
+            pass
+        try:
+            from distribuuuu_tpu.logging import commit_logs
+
+            commit_logs()
+        except Exception:
+            pass
+        fallback.cancel()  # diagnostics completed; exit on the normal path
+        self._exit_fn(HANG_EXIT_CODE)
+
+
+_watchdog: Watchdog | None = None
+
+
+def start_watchdog(timeout_s: float) -> Watchdog | None:
+    """Arm the process watchdog (replacing any previous one). No-op handle
+    when ``timeout_s <= 0``."""
+    global _watchdog
+    stop_watchdog()
+    if timeout_s <= 0:
+        return None
+    _watchdog = Watchdog(timeout_s).start()
+    return _watchdog
+
+
+def stop_watchdog() -> None:
+    global _watchdog
+    if _watchdog is not None:
+        _watchdog.stop()
+        _watchdog = None
+
+
+def watchdog_beat(gstep: int | None = None, phase: str = "train") -> None:
+    """Record step progress on the armed watchdog (no-op when disarmed) —
+    the unconditional-call-site pattern obs.current() uses."""
+    wd = _watchdog
+    if wd is not None:
+        wd.beat(gstep, phase)
+
+
+# ---------------------------------------------------------------------------
 # Deterministic fault injection (test-only)
 # ---------------------------------------------------------------------------
 
@@ -359,6 +542,12 @@ class FaultInjector:
       exactly *before* this global step runs (−1 = disabled). Equality, not
       ``>=``: a resumed run that starts past the step will not re-fire, but
       tests should still clear the knob for the relaunch.
+    - ``INJECT_HANG_STEP`` / ``DTPU_FAULT_HANG_STEP``: stall the step loop
+      forever right before this global step (sleep loop) — the watchdog's
+      deterministic prey (`tests/test_chaos.py`).
+    - ``INJECT_KILL_STEP`` / ``DTPU_FAULT_KILL_STEP``: hard rank death —
+      ``SIGKILL`` this process right before this global step (no cleanup, no
+      emergency checkpoint; the surviving peers' watchdogs must catch it).
 
     Global step is ``epoch * steps_per_epoch + it`` — stable across
     preempt/resume, which is what makes kill-at-step-k tests deterministic.
@@ -370,6 +559,8 @@ class FaultInjector:
         io_failures: int | None = None,
         nan_steps: list[int] | None = None,
         preempt_step: int | None = None,
+        hang_step: int | None = None,
+        kill_step: int | None = None,
     ):
         fc = _fault_cfg()
         env = os.environ
@@ -393,16 +584,34 @@ class FaultInjector:
                 preempt_step = int(env["DTPU_FAULT_PREEMPT_STEP"])
             else:
                 preempt_step = fc.INJECT_PREEMPT_STEP if fc is not None else -1
+        if hang_step is None:
+            if "DTPU_FAULT_HANG_STEP" in env:
+                hang_step = int(env["DTPU_FAULT_HANG_STEP"])
+            else:
+                hang_step = fc.INJECT_HANG_STEP if fc is not None and "INJECT_HANG_STEP" in fc else -1
+        if kill_step is None:
+            if "DTPU_FAULT_KILL_STEP" in env:
+                kill_step = int(env["DTPU_FAULT_KILL_STEP"])
+            else:
+                kill_step = fc.INJECT_KILL_STEP if fc is not None and "INJECT_KILL_STEP" in fc else -1
         self.io_indices = frozenset(int(i) for i in io_indices)
         self.io_failures = int(io_failures)
         self.nan_steps = frozenset(int(s) for s in nan_steps)
         self.preempt_step = int(preempt_step)
+        self.hang_step = int(hang_step)
+        self.kill_step = int(kill_step)
         self._io_counts: dict[int, int] = {}
         self._lock = threading.Lock()
 
     @property
     def active(self) -> bool:
-        return bool(self.io_indices or self.nan_steps or self.preempt_step >= 0)
+        return bool(
+            self.io_indices
+            or self.nan_steps
+            or self.preempt_step >= 0
+            or self.hang_step >= 0
+            or self.kill_step >= 0
+        )
 
     def maybe_fail_io(self, idx: int) -> None:
         """Raise `InjectedIOError` for a configured index (counted per index,
@@ -421,6 +630,27 @@ class FaultInjector:
 
     def should_preempt(self, global_step: int) -> bool:
         return self.preempt_step >= 0 and global_step == self.preempt_step
+
+    def should_hang(self, global_step: int) -> bool:
+        return self.hang_step >= 0 and global_step == self.hang_step
+
+    def should_kill(self, global_step: int) -> bool:
+        return self.kill_step >= 0 and global_step == self.kill_step
+
+    def hang_now(self) -> None:  # pragma: no cover - only exits via SIGKILL
+        """Stall this thread forever (chaos mode): the authentic dead-peer
+        scenario for every OTHER rank, and the watchdog's prey on this one."""
+        logger.warning("FAULT INJECTION: hanging this rank's step loop forever")
+        while True:
+            time.sleep(3600.0)
+
+    def kill_now(self) -> None:  # pragma: no cover - process dies here
+        """Hard rank death: SIGKILL self. No cleanup runs — exactly what a
+        kernel OOM-kill or host failure looks like to the rest of the job."""
+        logger.warning("FAULT INJECTION: SIGKILL self (hard rank death)")
+        dump_all_stacks("pre-SIGKILL (injected rank death)")
+        os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(60.0)  # never reached: the signal is not catchable
 
 
 def poison_batch_nan(batch: dict) -> dict:

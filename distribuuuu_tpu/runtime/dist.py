@@ -24,8 +24,10 @@ Environment autodetection mirrors the reference's dual Slurm/launcher logic:
 
 from __future__ import annotations
 
+import faulthandler
 import os
 import re
+import signal
 import subprocess
 from dataclasses import dataclass
 
@@ -75,11 +77,47 @@ def _first_slurm_hostname(nodelist: str) -> str:
     return prefix if first_idx is None else f"{prefix}{first_idx}"
 
 
+def _install_stack_dump_signal() -> None:
+    """SIGUSR2 → all-thread stack dump to stderr (the rank log).
+
+    The always-on half of the hang story (docs/TROUBLESHOOTING.md): even
+    with the watchdog disabled, ``kill -USR2 <pid>`` makes any wedged rank
+    print every thread's stack — including the frame stuck in a collective —
+    without killing it. ``chain`` must stay False: SIGUSR2's previous
+    disposition is almost always SIG_DFL (terminate), and chaining would
+    dump and THEN kill the process — the opposite of "diagnose without
+    killing". (SIGUSR1 is left alone for obs' profiler trigger.)
+    Best-effort: not installable off the main thread or on platforms
+    without SIGUSR2.
+    """
+    try:
+        faulthandler.register(signal.SIGUSR2, all_threads=True, chain=False)
+    except (AttributeError, ValueError, OSError):
+        pass
+
+
+def _enable_cpu_collectives() -> None:
+    """Multi-process CPU runs need the gloo cross-host collectives backend
+    ("Multiprocess computations aren't implemented on the CPU backend"
+    otherwise) — the transport the 2-proc CPU tests, including the rank-kill
+    chaos tier, ride. Must be set before first backend use; harmless and
+    skipped on real TPU/GPU jobs."""
+    try:
+        if jax.config.jax_platforms and "cpu" not in str(jax.config.jax_platforms):
+            return
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older/newer runtime without the knob: keep the default
+
+
 def setup_distributed(port: int | None = None) -> DistInfo:
     """Initialize multi-host JAX if the environment calls for it; return topology.
 
     Idempotent per process. Safe to call in single-process runs (no-op).
+    Also registers the SIGUSR2 stack-dump handler on every rank, so a hung
+    process is externally diagnosable whatever the watchdog config.
     """
+    _install_stack_dump_signal()
     env = os.environ
     coordinator = None
     num_processes = 1
@@ -98,6 +136,7 @@ def setup_distributed(port: int | None = None) -> DistInfo:
 
     global _initialized
     if num_processes > 1 and not _initialized:
+        _enable_cpu_collectives()
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,

@@ -17,14 +17,19 @@ import numpy as np
 from jax.sharding import Mesh
 
 
-def create_mesh(axes: dict[str, int]) -> Mesh:
+def create_mesh(axes: dict[str, int], devices=None) -> Mesh:
     """Build a Mesh from ordered ``{axis_name: size}``; one size may be -1.
 
     -1 is inferred from the remaining device count (like a reshape wildcard).
     Uses `mesh_utils.create_device_mesh` for ICI-aware device ordering on real
     TPU topologies, falling back to the flat device list (CPU meshes).
+
+    ``devices`` (default: all of `jax.devices()`) lets callers build a mesh
+    over an explicit subset — how `data_mesh` realizes an undersized
+    ``MESH.DATA`` for elastic-resume runs and tests.
     """
-    devices = jax.devices()
+    if devices is None:
+        devices = jax.devices()
     n = len(devices)
     sizes = dict(axes)
     wildcards = [k for k, v in sizes.items() if v == -1]
@@ -43,12 +48,40 @@ def create_mesh(axes: dict[str, int]) -> Mesh:
     try:
         from jax.experimental import mesh_utils
 
-        dev_array = mesh_utils.create_device_mesh(shape)
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
     except Exception:
         dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, tuple(sizes.keys()))
 
 
 def data_mesh(data: int = -1) -> Mesh:
-    """The framework's default 1-D data-parallel mesh (cfg.MESH.DATA)."""
+    """The framework's default 1-D data-parallel mesh (cfg.MESH.DATA).
+
+    ``data=-1`` (the default) spans all visible devices. An explicit size
+    smaller than the fleet builds a mesh over the first ``data`` devices —
+    the elastic-restore affordance (resume a run saved on N devices onto an
+    M-device submesh of this host, see docs/FAULT_TOLERANCE.md) and the CPU
+    test harness's way of emulating differently-sized slices. Deliberately
+    loud: leaving chips idle is only ever intentional.
+    """
+    devices = jax.devices()
+    if 0 < data < len(devices):
+        from distribuuuu_tpu.logging import logger
+
+        if jax.process_count() > 1:
+            # devices[:data] would leave some hosts with zero local mesh
+            # devices and the loader dividing by a zero host batch — fail
+            # here with the real story instead
+            raise ValueError(
+                f"MESH.DATA={data} < {len(devices)} devices is only "
+                f"supported on single-host runs: a submesh over the first "
+                f"{data} devices would leave some of the "
+                f"{jax.process_count()} hosts with no mesh-local devices. "
+                f"Relaunch with a host count matching the target topology."
+            )
+        logger.warning(
+            f"MESH.DATA={data} uses {data} of {len(devices)} visible devices "
+            f"(submesh; the rest stay idle)"
+        )
+        return create_mesh({"data": data}, devices=devices[:data])
     return create_mesh({"data": data})

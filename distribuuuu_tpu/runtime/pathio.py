@@ -53,6 +53,57 @@ def exists(path: str) -> bool:
     return epath.Path(path).exists()
 
 
+def walk_files(path: str) -> list[str]:
+    """All file paths under a directory tree, as ``/``-joined paths relative
+    to ``path``, sorted. The checkpoint-manifest enumeration: stable order on
+    every backend so two walks of identical content hash identically."""
+    root = epath.Path(path)
+    out: list[str] = []
+
+    def _walk(p: "epath.Path", rel: str) -> None:
+        for child in p.iterdir():
+            child_rel = f"{rel}/{child.name}" if rel else child.name
+            if child.is_dir():
+                _walk(child, child_rel)
+            else:
+                out.append(child_rel)
+
+    _walk(root, "")
+    return sorted(out)
+
+
+def read_bytes(path: str) -> bytes:
+    return epath.Path(path).read_bytes()
+
+
+def open_bytes(path: str):
+    """Open a file for streamed binary reading (checkpoint-manifest hashing:
+    the files can be multi-GB, so callers read chunked, never slurp)."""
+    return epath.Path(path).open("rb")
+
+
+def write_text(path: str, text: str) -> None:
+    """Atomic-enough small-file write: object stores commit at close; local
+    filesystems get a same-directory temp file + rename so a reader never
+    sees a torn manifest."""
+    if is_remote(path):
+        with open_write(path) as f:
+            f.write(text)
+        return
+    import os as _os
+
+    tmp = f"{path}.tmp.{_os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+    _os.replace(tmp, path)
+
+
+def rename(src: str, dst: str) -> None:
+    """Rename/move a file or directory tree (quarantine path). Local: one
+    ``os.replace``-style rename. Object stores: epath's copy+delete."""
+    epath.Path(src).rename(dst)
+
+
 def open_write(path: str) -> IO[str]:
     """Open ``path`` for text writing. On object stores the content becomes
     visible at ``close()`` (no partial writes), which is exactly right for

@@ -402,8 +402,9 @@ def train_epoch(
     # are primary-only, like the journal they report into; from_cfg applies
     # the OBS.ENABLED gating (legacy TRAIN.PROFILE stays independent of it)
     prof = obs.ProfilerWindows.from_cfg(epoch, telemetry=tel) if is_primary else None
-    # per optimizer step the fleet consumes this many samples
-    step_imgs = cfg.TRAIN.BATCH_SIZE * cfg.TRAIN.ACCUM_STEPS * jax.device_count()
+    # per optimizer step the fleet consumes this many samples — sized by the
+    # mesh actually training (a submesh run leaves the other chips idle)
+    step_imgs = cfg.TRAIN.BATCH_SIZE * cfg.TRAIN.ACCUM_STEPS * int(mesh.devices.size)
     steps_per_epoch = len(loader)
     max_consec = cfg.FAULT.MAX_CONSECUTIVE_SKIPS
     epoch_skipped = 0
@@ -418,6 +419,13 @@ def train_epoch(
     ):
         data_time.update(time.time() - t_end)
         gstep = epoch * steps_per_epoch + it
+        # step-progress heartbeat: the armed watchdog turns a wedged step
+        # (dead peer in a collective) into a bounded-time loud failure
+        resilience.watchdog_beat(gstep)
+        if injector is not None and injector.should_kill(gstep):
+            injector.kill_now()  # SIGKILL self: hard rank death, no cleanup
+        if injector is not None and injector.should_hang(gstep):
+            injector.hang_now()  # stall forever: the watchdog's prey
         if injector is not None and injector.should_preempt(gstep):
             # injection keys off gstep, identical on every host — safe to
             # stop without the multi-host agreement below
@@ -430,9 +438,12 @@ def train_epoch(
             stop_here = resilience.preemption_stop_requested(gstep)
         if stop_here:
             # state reflects exactly `it` consumed batches of this epoch;
-            # commit it (with step + RNG) before giving the slice back
+            # commit it (with step + RNG + the fleet sample offset, so an
+            # elastic relaunch can remap the position) before giving the
+            # slice back
             path = ckpt.save_mid_checkpoint(
-                cfg.OUT_DIR, epoch, it, state, best_acc1, rng
+                cfg.OUT_DIR, epoch, it, state, best_acc1, rng,
+                samples_per_step=step_imgs,
             )
             try:  # drain older async epoch saves; the emergency save above
                 ckpt.wait_for_saves()  # is already durable (synchronous), so
@@ -562,6 +573,7 @@ def validate(
     vals = None  # last boundary fetch; the final iteration is always a boundary
     for it, batch in enumerate(prefetch_to_device(loader, mesh, cfg.TRAIN.PREFETCH)):
         data_time.update(time.time() - t_end)
+        resilience.watchdog_beat(it, phase="eval")
         totals = eval_step(state, batch, totals)
         window_n += 1
         # Boundary fetches exist to feed the progress display, so only the
@@ -684,10 +696,13 @@ def train_model():
             f"{sorted(injector.nan_steps)}, preempt_step={injector.preempt_step}"
         )
     mesh = data_mesh(cfg.MESH.DATA)
+    # fleet-wide samples one optimizer step consumes — the unit elastic
+    # resume remaps checkpointed sample offsets with
+    samples_per_step = cfg.TRAIN.BATCH_SIZE * cfg.TRAIN.ACCUM_STEPS * int(mesh.devices.size)
     logger.info(
         f"Devices: {info.global_device_count} ({info.process_count} hosts), "
         f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))}, "
-        f"global batch={cfg.TRAIN.BATCH_SIZE * info.global_device_count * cfg.TRAIN.ACCUM_STEPS}"
+        f"global batch={samples_per_step}"
         + (f" (accum x{cfg.TRAIN.ACCUM_STEPS})" if cfg.TRAIN.ACCUM_STEPS > 1 else "")
     )
 
@@ -708,8 +723,8 @@ def train_model():
     logger.info(f"Model:\n{cfg.MODEL.ARCH}")
     logger.info(f"Params(M): {count_parameters(state.params):.3f}")
 
-    train_loader = construct_train_loader()
-    val_loader = construct_val_loader()
+    train_loader = construct_train_loader(mesh)
+    val_loader = construct_val_loader(mesh)
     train_step = make_train_step(
         model, tx, mesh, cfg.TRAIN.TOPK, accum_steps=cfg.TRAIN.ACCUM_STEPS
     )
@@ -723,6 +738,8 @@ def train_model():
             state,
             step_granular=cfg.RESUME.STEP_GRANULAR,
             skip_corrupt=cfg.RESUME.SKIP_CORRUPT,
+            verify_integrity=cfg.RESUME.VERIFY_INTEGRITY,
+            samples_per_step=samples_per_step,
         )
         if res is not None:
             state, start_epoch, start_step, best_acc1, rng_key, path = res
@@ -754,6 +771,11 @@ def train_model():
         state = _recommit_state(state, mesh)
 
     run_tic = time.time()
+    # distributed watchdog: armed for the whole epoch loop (train + eval
+    # collectives both hang when a peer dies), beaten at every step
+    # boundary. The first beat window includes the step compile —
+    # FAULT.HANG_TIMEOUT_S must comfortably exceed it (docs/FAULT_TOLERANCE.md).
+    resilience.start_watchdog(cfg.FAULT.HANG_TIMEOUT_S)
     try:
         for epoch in range(start_epoch, cfg.OPTIM.MAX_EPOCH):
             state = train_epoch(
@@ -767,9 +789,13 @@ def train_model():
             )
             is_best = acc1 > best_acc1
             best_acc1 = max(acc1, best_acc1)
+            resilience.watchdog_beat(phase="checkpoint")  # long saves ≠ hangs
             path = ckpt.save_checkpoint(cfg.OUT_DIR, epoch, state, best_acc1, is_best)
             logger.info(f"Saving checkpoint (async): {path} (best Acc@1 {best_acc1:.3f})")
     finally:
+        # disarm BEFORE the final waits: a completed (or crashed) run must
+        # never be hard-killed by its own watchdog while draining saves
+        resilience.stop_watchdog()
         # runs on success, preemption AND any mid-epoch exception: never
         # abandon an in-flight async Orbax write (a partial directory would
         # poison the next auto-resume scan). Guarded so a failed background
@@ -820,6 +846,6 @@ def test_model():
     elif cfg.MODEL.PRETRAINED:
         state, _, _ = ckpt.load_checkpoint(_pretrained_path(), state, load_opt=False)
         logger.info(f"Loaded pretrained weights ({cfg.MODEL.ARCH})")
-    val_loader = construct_val_loader()
+    val_loader = construct_val_loader(mesh)
     eval_step = make_eval_step(model, mesh, cfg.TRAIN.TOPK)
     return validate(val_loader, mesh, eval_step, state, info.is_primary)

@@ -1,21 +1,28 @@
 #!/usr/bin/env python
-"""Standalone kill-at-step-k / resume check (debugging aid for the
-fault-tolerance layer, docs/FAULT_TOLERANCE.md).
+"""Standalone resilience scenario checks (debugging aid for the
+fault-tolerance layer, docs/FAULT_TOLERANCE.md), outside pytest with the
+phases spelled out and timed so a failing resume can be bisected
+interactively.
 
-Runs the same scenario as
-tests/test_resilience.py::test_kill_at_step_k_resume_is_bitwise_identical but
-outside pytest, with the phases spelled out and timed, so a failing resume
-can be bisected interactively:
+    python scripts/run_resilience_check.py [--scenario basic|elastic|corrupt|all]
 
-    python scripts/run_resilience_check.py [--preempt-step N] [--epochs E]
+Scenarios:
 
-Phase 1: uninterrupted tiny DUMMY_INPUT run  → reference params
-Phase 2: identical run, injected SIGTERM at global step N → emergency ckpt
-Phase 3: relaunch with auto-resume            → must match phase 1 bitwise
+- **basic** (default; the same contract as tests/test_resilience.py::
+  test_kill_at_step_k_resume_is_bitwise_identical):
+  1. uninterrupted tiny DUMMY_INPUT run → reference params
+  2. identical run, injected SIGTERM at global step N → emergency ckpt
+  3. relaunch with auto-resume → must match phase 1 bitwise
+- **elastic** (tests/test_elastic.py): save mid-epoch on a 2-device mesh,
+  resume onto 1- and 4-device meshes at fixed global batch — every step
+  must run exactly once (same sample stream) and final params must agree
+  to float32-reduction tolerance.
+- **corrupt** (tests/test_integrity.py): byte-flip the newest checkpoint;
+  restore_latest must quarantine it (corrupt_*) and fall back to the
+  previous one.
 
-Exit code 0 iff final params are bitwise identical and checkpoint names
-match. Self-pins to a virtual 8-device CPU mesh (cpu_mesh_run-style
-bootstrap), so it runs anywhere.
+Exit code 0 iff every requested scenario passes. Self-pins to a virtual
+8-device CPU mesh (cpu_mesh_run-style bootstrap), so it runs anywhere.
 """
 
 import argparse
@@ -63,18 +70,19 @@ if "resil_check_tiny" not in list_models():
         return _Tiny(num_classes=num_classes)
 
 
-def configure(out_dir: str, epochs: int) -> None:
+def configure(out_dir: str, epochs: int, mesh_size: int = -1, batch_size: int = 2) -> None:
     config.reset_cfg()
     c = config.cfg
     c.MODEL.ARCH = "resil_check_tiny"
     c.MODEL.NUM_CLASSES = 4
     c.MODEL.DTYPE = "float32"
     c.MODEL.DUMMY_INPUT = True
-    c.TRAIN.BATCH_SIZE = 2
+    c.MESH.DATA = mesh_size
+    c.TRAIN.BATCH_SIZE = batch_size
     c.TRAIN.IM_SIZE = 8
     c.TEST.IM_SIZE = 8
     c.TEST.CROP_SIZE = 8
-    c.TEST.BATCH_SIZE = 2
+    c.TEST.BATCH_SIZE = batch_size
     c.TRAIN.DUMMY_EPOCH_SAMPLES = 64  # 4 steps/epoch on 8 devices
     c.TRAIN.PRINT_FREQ = 1
     c.OPTIM.MAX_EPOCH = epochs
@@ -88,53 +96,159 @@ def leaves(state):
     return [np.asarray(x) for x in jax.tree.leaves(jax.device_get(state.params))]
 
 
+def check_basic(scratch: str, preempt_step: int, epochs: int) -> bool:
+    out_a, out_b = os.path.join(scratch, "a"), os.path.join(scratch, "b")
+    t0 = time.time()
+    configure(out_a, epochs)
+    state_a, best_a = trainer.train_model()
+    print(f"[1/3] uninterrupted run done in {time.time() - t0:.1f}s "
+          f"(best {best_a:.2f})")
+
+    t0 = time.time()
+    configure(out_b, epochs)
+    config.cfg.FAULT.INJECT_PREEMPT_STEP = preempt_step
+    try:
+        trainer.train_model()
+        print("ERROR: run completed without being preempted "
+              f"(is --preempt-step {preempt_step} within the run?)")
+        return False
+    except SystemExit as e:
+        print(f"[2/3] preempted (exit {e.code}) at "
+              f"{resilience.RUN_STATS.preempted_at} in {time.time() - t0:.1f}s; "
+              f"mid ckpts: {[(ep, s) for ep, s, _ in ckpt._mid_checkpoints(out_b)]}")
+
+    t0 = time.time()
+    configure(out_b, epochs)
+    state_b, best_b = trainer.train_model()
+    print(f"[3/3] resumed run done in {time.time() - t0:.1f}s (best {best_b:.2f})")
+
+    mismatches = sum(
+        not np.array_equal(a, b) for a, b in zip(leaves(state_a), leaves(state_b))
+    )
+    names_a = sorted(os.listdir(os.path.join(out_a, "checkpoints")))
+    names_b = sorted(os.listdir(os.path.join(out_b, "checkpoints")))
+    if mismatches == 0 and names_a == names_b:
+        print(f"PASS basic: params bitwise identical, checkpoint names match ({names_a})")
+        return True
+    print(f"FAIL basic: {mismatches} param leaves differ; "
+          f"names a={names_a} b={names_b}")
+    return False
+
+
+def _journal_gsteps(out_dir: str) -> list[int]:
+    from distribuuuu_tpu import obs
+
+    return sorted(
+        r["gstep"]
+        for r in obs.read_journal(os.path.join(out_dir, "telemetry.jsonl"))
+        if r.get("kind") == "window"
+    )
+
+
+def check_elastic(scratch: str, epochs: int) -> bool:
+    """Save mid-epoch on a 2-device mesh, resume onto 1- and 4-device meshes
+    at fixed global batch 8 — the tests/test_elastic.py scenario, timed."""
+    global_batch = 8
+    steps_per_epoch = 64 // global_batch
+    total = epochs * steps_per_epoch
+    out_a = os.path.join(scratch, "el_a")
+
+    t0 = time.time()
+    configure(out_a, epochs, mesh_size=2, batch_size=global_batch // 2)
+    state_a, _ = trainer.train_model()
+    print(f"[1/3] 2-device reference done in {time.time() - t0:.1f}s")
+
+    out_save = os.path.join(scratch, "el_save")
+    configure(out_save, epochs, mesh_size=2, batch_size=global_batch // 2)
+    config.cfg.FAULT.INJECT_PREEMPT_STEP = steps_per_epoch + 3  # mid epoch 1
+    try:
+        trainer.train_model()
+        print("ERROR: elastic phase was not preempted")
+        return False
+    except SystemExit:
+        print(f"[2/3] preempted at {resilience.RUN_STATS.preempted_at}")
+
+    ok = True
+    for mesh_size in (1, 4):
+        out_m = os.path.join(scratch, f"el_resume{mesh_size}")
+        shutil.copytree(out_save, out_m)
+        t0 = time.time()
+        configure(out_m, epochs, mesh_size=mesh_size,
+                  batch_size=global_batch // mesh_size)
+        state_m, _ = trainer.train_model()
+        gsteps = _journal_gsteps(out_m)
+        stream_ok = gsteps == list(range(total))
+        close = all(
+            np.allclose(a, b, rtol=1e-3, atol=2e-5)
+            for a, b in zip(leaves(state_a), leaves(state_m))
+        )
+        verdict = "PASS" if (stream_ok and close) else "FAIL"
+        ok = ok and stream_ok and close
+        print(f"[3/3] {verdict} elastic 2->{mesh_size} dev in "
+              f"{time.time() - t0:.1f}s (stream_ok={stream_ok}, params_close={close})")
+    return ok
+
+
+def check_corrupt(scratch: str, epochs: int) -> bool:
+    """Byte-flip the newest checkpoint; restore_latest must quarantine it
+    and fall back to the previous one (tests/test_integrity.py), and the
+    relaunch must complete."""
+    out = os.path.join(scratch, "corrupt")
+    configure(out, epochs)
+    trainer.train_model()
+
+    top = ckpt.get_last_checkpoint(out)
+    victims = []
+    for root, _, files in os.walk(top):
+        for f in files:
+            if f != "dtpu_manifest.json":
+                p = os.path.join(root, f)
+                victims.append((os.path.getsize(p), p))
+    size, victim = max(victims)
+    with open(victim, "rb+") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    print(f"[1/2] byte-flipped {victim}")
+
+    # one more epoch: auto-resume must quarantine the corrupt checkpoint,
+    # fall back, and still finish
+    configure(out, epochs + 1)
+    trainer.train_model()
+    names = sorted(os.listdir(os.path.join(out, "checkpoints")))
+    quarantined = any(n.startswith("corrupt_") for n in names)
+    refreshed = os.path.basename(top) in names
+    if quarantined and refreshed:
+        print(f"[2/2] PASS corrupt: quarantined + resumed from fallback ({names})")
+        return True
+    print(f"[2/2] FAIL corrupt: names={names}")
+    return False
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--scenario", choices=("basic", "elastic", "corrupt", "all"),
+                    default="basic")
     ap.add_argument("--preempt-step", type=int, default=5,
-                    help="global step to inject the simulated SIGTERM before")
+                    help="global step to inject the simulated SIGTERM before (basic)")
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--keep", action="store_true", help="keep scratch OUT_DIRs")
     args = ap.parse_args()
 
     scratch = tempfile.mkdtemp(prefix="dtpu_resilience_check_")
-    out_a, out_b = os.path.join(scratch, "a"), os.path.join(scratch, "b")
-    rc = 1
+    checks = {
+        "basic": lambda: check_basic(scratch, args.preempt_step, args.epochs),
+        "elastic": lambda: check_elastic(scratch, args.epochs),
+        "corrupt": lambda: check_corrupt(scratch, args.epochs),
+    }
+    selected = list(checks) if args.scenario == "all" else [args.scenario]
+    rc = 0
     try:
-        t0 = time.time()
-        configure(out_a, args.epochs)
-        state_a, best_a = trainer.train_model()
-        print(f"[1/3] uninterrupted run done in {time.time() - t0:.1f}s "
-              f"(best {best_a:.2f})")
-
-        t0 = time.time()
-        configure(out_b, args.epochs)
-        config.cfg.FAULT.INJECT_PREEMPT_STEP = args.preempt_step
-        try:
-            trainer.train_model()
-            print("ERROR: run completed without being preempted "
-                  f"(is --preempt-step {args.preempt_step} within the run?)")
-            return 1
-        except SystemExit as e:
-            print(f"[2/3] preempted (exit {e.code}) at "
-                  f"{resilience.RUN_STATS.preempted_at} in {time.time() - t0:.1f}s; "
-                  f"mid ckpts: {[(ep, s) for ep, s, _ in ckpt._mid_checkpoints(out_b)]}")
-
-        t0 = time.time()
-        configure(out_b, args.epochs)
-        state_b, best_b = trainer.train_model()
-        print(f"[3/3] resumed run done in {time.time() - t0:.1f}s (best {best_b:.2f})")
-
-        mismatches = sum(
-            not np.array_equal(a, b) for a, b in zip(leaves(state_a), leaves(state_b))
-        )
-        names_a = sorted(os.listdir(os.path.join(out_a, "checkpoints")))
-        names_b = sorted(os.listdir(os.path.join(out_b, "checkpoints")))
-        if mismatches == 0 and names_a == names_b:
-            print(f"PASS: params bitwise identical, checkpoint names match ({names_a})")
-            rc = 0
-        else:
-            print(f"FAIL: {mismatches} param leaves differ; "
-                  f"names a={names_a} b={names_b}")
+        for name in selected:
+            print(f"=== scenario: {name} ===")
+            if not checks[name]():
+                rc = 1
     finally:
         if args.keep:
             print(f"scratch kept at {scratch}")

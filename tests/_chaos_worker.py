@@ -1,0 +1,94 @@
+"""Rank worker for the chaos tests (tests/test_chaos.py) — NOT a pytest module.
+
+Runs a tiny DUMMY_INPUT `train_model` over the real RANK/WORLD_SIZE
+rendezvous contract (each process is a 1-device CPU "host"), so SIGKILLing
+one rank leaves the survivor wedged in a genuine cross-process collective —
+the scenario the distributed watchdog exists for.
+
+argv: rank nprocs port out_dir max_epoch
+env:  DTPU_TEST_HANG_TIMEOUT_S  -> cfg.FAULT.HANG_TIMEOUT_S (default 0: off)
+      DTPU_FAULT_KILL_STEP / DTPU_FAULT_HANG_STEP -> FaultInjector chaos modes
+
+Prints ``CHAOS DIGEST <sha256>`` of the final params and ``CHAOS OK
+rank=<r>`` on a clean finish — the bitwise-resume oracle for the test.
+"""
+
+import hashlib
+import os
+import sys
+
+rank, nprocs, port, out_dir, max_epoch = sys.argv[1:6]
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+if int(nprocs) > 1:
+    os.environ.update(
+        RANK=rank, WORLD_SIZE=nprocs, MASTER_ADDR="127.0.0.1", MASTER_PORT=port
+    )
+else:
+    for k in ("RANK", "WORLD_SIZE", "MASTER_ADDR", "MASTER_PORT"):
+        os.environ.pop(k, None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distribuuuu_tpu.runtime.compile_cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+
+import flax.linen as nn  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from distribuuuu_tpu import config, trainer  # noqa: E402
+from distribuuuu_tpu.models import list_models, register_model  # noqa: E402
+
+if "chaos_tiny" not in list_models():
+
+    class _ChaosTiny(nn.Module):
+        num_classes: int = 4
+
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = nn.Conv(4, (3, 3), use_bias=False, dtype=jnp.float32)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            return nn.Dense(self.num_classes)(nn.relu(x).mean(axis=(1, 2)))
+
+    @register_model("chaos_tiny")
+    def chaos_tiny(num_classes, dtype, bn_axis_name=None, remat=False):
+        return _ChaosTiny(num_classes=num_classes)
+
+
+def main() -> int:
+    c = config.cfg
+    c.MODEL.ARCH = "chaos_tiny"
+    c.MODEL.NUM_CLASSES = 4
+    c.MODEL.DTYPE = "float32"
+    c.MODEL.DUMMY_INPUT = True
+    c.TRAIN.BATCH_SIZE = 2
+    c.TRAIN.IM_SIZE = 8
+    c.TEST.IM_SIZE = 8
+    c.TEST.CROP_SIZE = 8
+    c.TEST.BATCH_SIZE = 2
+    c.TRAIN.DUMMY_EPOCH_SAMPLES = 64  # 16 steps/epoch at global batch 4
+    c.TRAIN.PRINT_FREQ = 4
+    c.OPTIM.MAX_EPOCH = int(max_epoch)
+    c.OPTIM.WARMUP_EPOCHS = 0
+    c.RNG_SEED = 5
+    c.FAULT.HANG_TIMEOUT_S = float(os.environ.get("DTPU_TEST_HANG_TIMEOUT_S", "0"))
+    c.FAULT.HANDLE_SIGNALS = False
+    c.OUT_DIR = out_dir
+
+    state, best = trainer.train_model()
+    digest = hashlib.sha256()
+    for leaf in jax.tree.leaves(jax.device_get(state.params)):
+        digest.update(np.ascontiguousarray(leaf).tobytes())
+    print(f"CHAOS DIGEST {digest.hexdigest()}", flush=True)
+    print(f"CHAOS OK rank={rank} best={best:.4f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

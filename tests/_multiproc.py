@@ -1,27 +1,57 @@
 """Shared scaffolding for true multi-process tests — NOT a pytest module.
 
-Used by tests/test_multihost.py and tests/test_multihost_ring.py: launch N
-rank subprocesses with per-rank logs, wait them out, kill stragglers, and
-hand back (rc, log_text) per rank — rc is None when the wait timed out, and
-the log text is always available so a hung rank's output makes it into the
-assertion message instead of being lost.
+Used by tests/test_multihost.py, tests/test_multihost_ring.py and
+tests/test_chaos.py: launch N rank subprocesses with per-rank logs, wait
+them out, kill stragglers, and hand back (rc, log_text) per rank — rc is
+None when the wait timed out, and the log text is always available so a
+hung rank's output makes it into the assertion message instead of being
+lost.
+
+`launch_ranks` is the entry point: it owns the rendezvous port AND retries
+the whole launch on a rendezvous-bind failure. `pick_port` releases its
+probe socket before the coordinator binds the port, so a parallel process
+on the machine can steal it in between; that used to surface as a flaky
+"Address already in use" test failure that relied on the outer test rerun.
+Now the launcher detects the bind-race signature in the rank logs and
+relaunches every rank on a fresh port.
 """
 
 import socket
 import subprocess
 
+# What a stolen rendezvous port looks like in a rank log: the coordinator
+# fails to bind, or (rarer) every client times out against whoever DID own
+# the port. Matched case-insensitively against each rank's full log.
+RENDEZVOUS_FAILURE_MARKERS = (
+    "address already in use",
+    "failed to bind",
+    "could not bind",
+    "bind address",
+)
+
 
 def pick_port() -> int:
-    """Ephemeral rendezvous port. Best-effort: the port is released before
-    the workers bind it, so a parallel process could steal it in between —
-    in that case the workers fail loudly at rendezvous and the test reruns."""
+    """Ephemeral rendezvous port. Best-effort by construction: the port is
+    released before the workers bind it, so a parallel process can steal it
+    in between — `launch_ranks` detects that and relaunches on a new port."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
 
 
+def _looks_like_rendezvous_race(results) -> bool:
+    if all(rc == 0 for rc, _ in results):
+        return False
+    return any(
+        marker in text.lower()
+        for _, text in results
+        for marker in RENDEZVOUS_FAILURE_MARKERS
+    )
+
+
 def run_ranks(tmp_path, n, make_cmd, make_env, cwd, timeout):
-    """Run ``make_cmd(rank)`` for each rank; returns [(rc, log_text)]."""
+    """Single launch attempt: run ``make_cmd(rank)`` for each rank; returns
+    [(rc, log_text)]. Prefer `launch_ranks`, which adds the port-race retry."""
     procs = []
     try:
         for rank in range(n):
@@ -59,3 +89,32 @@ def run_ranks(tmp_path, n, make_cmd, make_env, cwd, timeout):
         (rc, open(tmp_path / f"rank{rank}.log").read())
         for rank, rc in enumerate(rcs)
     ]
+
+
+def launch_ranks(tmp_path, n, make_cmd, make_env, cwd, timeout, attempts=3):
+    """Launch ``n`` ranks rendezvousing on a fresh `pick_port`; retry the
+    WHOLE launch (new port, all ranks) when the logs show the port was
+    stolen between probe and bind. ``make_cmd(rank, port)`` /
+    ``make_env(rank, port)`` receive the attempt's port. Each attempt logs
+    into its own ``attemptK/`` subdirectory so a retried failure stays
+    inspectable; returns the final attempt's [(rc, log_text)]."""
+    results = None
+    for attempt in range(attempts):
+        port = pick_port()
+        attempt_dir = tmp_path / f"attempt{attempt}"
+        attempt_dir.mkdir(parents=True, exist_ok=True)
+        results = run_ranks(
+            attempt_dir,
+            n,
+            lambda rank: make_cmd(rank, port),
+            lambda rank: make_env(rank, port),
+            cwd,
+            timeout,
+        )
+        if not _looks_like_rendezvous_race(results):
+            return results
+        print(
+            f"[_multiproc] rendezvous bind race on port {port} "
+            f"(attempt {attempt + 1}/{attempts}); relaunching all ranks"
+        )
+    return results

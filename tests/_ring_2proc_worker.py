@@ -20,6 +20,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# cross-process CPU collectives need the gloo backend (same knob
+# runtime/dist.setup_distributed sets for trainer runs)
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 jax.distributed.initialize(
     coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=rank
 )
@@ -27,6 +30,10 @@ jax.distributed.initialize(
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+from distribuuuu_tpu.runtime.compat import ensure_jax_compat  # noqa: E402
+
+ensure_jax_compat()  # older runtimes: alias jax.shard_map (used below)
 
 from distribuuuu_tpu.parallel import ring_attention  # noqa: E402
 

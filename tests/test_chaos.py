@@ -1,0 +1,152 @@
+"""Chaos tests: rank death and hang detection (docs/FAULT_TOLERANCE.md).
+
+The acceptance scenario for the distributed watchdog, run over real
+processes:
+
+- ``hang_at_step`` (1 process): a stalled step loop is detected within
+  ``FAULT.HANG_TIMEOUT_S``; the rank dumps all-thread stacks into its log,
+  journals a typed ``hang`` event, and exits `resilience.HANG_EXIT_CODE`.
+- ``kill_at_step`` (2 processes): SIGKILL one rank mid-epoch; the survivor
+  must die loudly — nonzero, within the deadline plus grace, with
+  diagnostics in its log — instead of silently stalling in a collective
+  forever. Then a full-job restart resumes from the last durable checkpoint
+  and finishes with bitwise-identical params to an uninterrupted run.
+
+Marked slow: these launch subprocess fleets (CI runs them in the dedicated
+``chaos-smoke`` job).
+"""
+
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+from _multiproc import launch_ranks
+
+from distribuuuu_tpu import obs, resilience
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_chaos_worker.py")
+
+
+def _make_cmd(nprocs, out_dir, max_epoch):
+    def make_cmd(rank, port):
+        return [sys.executable, WORKER, str(rank), str(nprocs), str(port),
+                str(out_dir), str(max_epoch)]
+
+    return make_cmd
+
+
+def _base_env(rank, extra=None):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)  # worker pins its own 1-device count
+    for k in ("DTPU_FAULT_KILL_STEP", "DTPU_FAULT_HANG_STEP",
+              "DTPU_TEST_HANG_TIMEOUT_S"):
+        env.pop(k, None)
+    env.update(extra or {})
+    return env
+
+
+def _hang_events(out_dir):
+    path = os.path.join(str(out_dir), "telemetry.jsonl")
+    if not os.path.exists(path):
+        return []
+    return [r for r in obs.read_journal(path) if r.get("kind") == "hang"]
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_hung_rank_is_killed_by_watchdog_with_diagnostics(tmp_path):
+    """Injected stall at global step 5: the watchdog must turn it into a
+    bounded-time, diagnosed `HANG_EXIT_CODE` failure."""
+    out_dir = tmp_path / "out"
+    timeout_s = 10.0
+
+    def make_env(rank, port):
+        return _base_env(rank, {
+            "DTPU_FAULT_HANG_STEP": "5",
+            "DTPU_TEST_HANG_TIMEOUT_S": str(timeout_s),
+        })
+
+    tic = time.time()
+    results = launch_ranks(
+        tmp_path, 1, _make_cmd(1, out_dir, 2), make_env, REPO, timeout=300
+    )
+    wall = time.time() - tic
+    rc, log = results[0]
+    assert rc == resilience.HANG_EXIT_CODE, f"rc={rc}\n{log[-3000:]}"
+    # bounded: stall + timeout + generous slack for imports/compile
+    assert wall < 240, f"watchdog took {wall:.0f}s to fire"
+    assert "WATCHDOG" in log and "no step progress" in log
+    # faulthandler's all-thread dump landed in the rank log
+    assert "Current thread" in log or "Thread 0x" in log, log[-3000:]
+    # ...and the typed journal event was committed before the hard exit
+    events = _hang_events(out_dir)
+    assert len(events) == 1, events
+    assert events[0]["gstep"] == 5 and events[0]["phase"] == "train"
+    assert events[0]["stalled_s"] >= timeout_s
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_rank_kill_makes_survivor_die_loudly_and_restart_resumes_bitwise(tmp_path):
+    """SIGKILL rank 1 mid-epoch-1 of a 2-proc run: rank 0 must exit nonzero
+    within the hang deadline (+grace) with diagnostics, and a full-job
+    restart must finish bitwise-identical to a never-interrupted run."""
+    timeout_s = 12.0
+    kill_step = 20  # epoch 1, step 4 of 16: epoch-0 checkpoint is durable
+
+    # Phase A: uninterrupted 2-proc reference
+    out_a = tmp_path / "a"
+    results = launch_ranks(
+        tmp_path / "pa", 2, _make_cmd(2, out_a, 2),
+        lambda rank, port: _base_env(rank), REPO, timeout=420,
+    )
+    for rank, (rc, log) in enumerate(results):
+        assert rc == 0, f"phase A rank {rank} rc={rc}:\n{log[-3000:]}"
+    digest_a = [ln for ln in results[0][1].splitlines() if "CHAOS DIGEST" in ln]
+    assert digest_a, results[0][1][-2000:]
+
+    # Phase B: same run, rank 1 hard-dies at global step 20
+    out_b = tmp_path / "b"
+
+    def make_env_b(rank, port):
+        extra = {"DTPU_TEST_HANG_TIMEOUT_S": str(timeout_s)}
+        if rank == 1:
+            extra["DTPU_FAULT_KILL_STEP"] = str(kill_step)
+        return _base_env(rank, extra)
+
+    results = launch_ranks(
+        tmp_path / "pb", 2, _make_cmd(2, out_b, 2), make_env_b, REPO,
+        timeout=420,
+    )
+    (rc0, log0), (rc1, log1) = results
+    assert rc1 == -signal.SIGKILL, f"rank 1 rc={rc1}:\n{log1[-2000:]}"
+    # the survivor died LOUDLY, within the deadline (the launcher timeout
+    # never tripped: rc is not None), not a silent stall
+    assert rc0 is not None and rc0 != 0, f"rank 0 rc={rc0}:\n{log0[-3000:]}"
+    # ...with diagnosable output: either the watchdog fired (stack dump +
+    # journal event) or the runtime surfaced the dead peer as an error
+    watchdogged = rc0 == resilience.HANG_EXIT_CODE
+    if watchdogged:
+        assert "WATCHDOG" in log0
+        assert "Current thread" in log0 or "Thread 0x" in log0
+        assert _hang_events(out_b), "watchdog fired but no hang journal event"
+    else:
+        assert "Error" in log0 or "error" in log0, log0[-3000:]
+
+    # Phase C: full-job restart (injection cleared) resumes and matches A
+    results = launch_ranks(
+        tmp_path / "pc", 2, _make_cmd(2, out_b, 2),
+        lambda rank, port: _base_env(rank), REPO, timeout=420,
+    )
+    for rank, (rc, log) in enumerate(results):
+        assert rc == 0, f"phase C rank {rank} rc={rc}:\n{log[-3000:]}"
+    assert "Resumed from" in results[0][1], results[0][1][-3000:]
+    digest_c = [ln for ln in results[0][1].splitlines() if "CHAOS DIGEST" in ln]
+    assert digest_c and digest_c[-1].split()[-1] == digest_a[-1].split()[-1], (
+        f"restart params diverged: {digest_a} vs {digest_c}"
+    )

@@ -1,0 +1,243 @@
+"""Checkpoint integrity manifests, quarantine, the verify CLI, and the
+prune-vs-restore race guard (docs/FAULT_TOLERANCE.md)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distribuuuu_tpu import checkpoint as ckpt
+from distribuuuu_tpu import obs
+from distribuuuu_tpu.trainer import TrainState
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def tiny_state():
+    params = {"w": jnp.arange(4.0), "b": jnp.zeros((2,))}
+    opt_state = {"momentum": {"w": jnp.ones(4), "b": jnp.zeros(2)}}
+    return TrainState(params=params, batch_stats={"m": jnp.zeros(3)}, opt_state=opt_state)
+
+
+def _flip_one_byte(ckpt_path: str) -> str:
+    """Corrupt the largest data file of a committed checkpoint by one byte."""
+    candidates = []
+    for root, _, files in os.walk(ckpt_path):
+        for f in files:
+            if f == "dtpu_manifest.json":
+                continue
+            p = os.path.join(root, f)
+            candidates.append((os.path.getsize(p), p))
+    size, victim = max(candidates)
+    assert size > 0
+    with open(victim, "rb+") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return victim
+
+
+class _RecordingTelemetry(obs.NullTelemetry):
+    def __init__(self):
+        self.events = []
+
+    def event(self, kind, **fields):
+        self.events.append((kind, fields))
+
+
+@pytest.fixture()
+def recorded_events():
+    tel = _RecordingTelemetry()
+    obs.set_current(tel)
+    yield tel.events
+    obs.set_current(None)
+
+
+# ---------------------------------------------------------------------------
+# Manifest write + verify
+# ---------------------------------------------------------------------------
+
+def test_epoch_save_writes_manifest_and_verifies_ok(tmp_path, tiny_state):
+    out = str(tmp_path)
+    path = ckpt.save_checkpoint(out, 0, tiny_state, best_acc1=1.0, is_best=True)
+    ckpt.wait_for_saves()  # joins the async manifest writer too
+    for target in (path, ckpt.get_best_path(out)):
+        mpath = ckpt.manifest_path(target)
+        assert os.path.exists(mpath), f"no manifest at {target}"
+        manifest = json.loads(open(mpath).read())
+        assert manifest["algo"] == "sha256" and manifest["files"]
+        # every real file is covered (manifest itself excluded)
+        on_disk = {
+            os.path.relpath(os.path.join(r, f), target).replace(os.sep, "/")
+            for r, _, fs in os.walk(target)
+            for f in fs
+        } - {"dtpu_manifest.json"}
+        assert set(manifest["files"]) == on_disk
+        status, errors = ckpt.verify_checkpoint(target)
+        assert (status, errors) == ("ok", [])
+
+
+def test_mid_save_writes_manifest_inline(tmp_path, tiny_state):
+    path = ckpt.save_mid_checkpoint(
+        str(tmp_path), epoch=0, step=2, state=tiny_state, best_acc1=0.0,
+        rng_key=jax.random.PRNGKey(0), samples_per_step=8,
+    )
+    # synchronous save: the manifest is durable the moment save returns (the
+    # preempted process exits right after)
+    assert os.path.exists(ckpt.manifest_path(path))
+    assert ckpt.verify_checkpoint(path)[0] == "ok"
+
+
+def test_verify_detects_byte_flip_and_missing_file(tmp_path, tiny_state):
+    out = str(tmp_path)
+    path = ckpt.save_checkpoint(out, 0, tiny_state, best_acc1=0.0, is_best=False)
+    ckpt.wait_for_saves()
+    victim = _flip_one_byte(path)
+    status, errors = ckpt.verify_checkpoint(path)
+    assert status == "corrupt"
+    assert any("sha256 mismatch" in e or "size" in e for e in errors), errors
+
+    os.remove(victim)
+    status, errors = ckpt.verify_checkpoint(path)
+    assert status == "corrupt" and any("missing" in e for e in errors)
+
+
+def test_verify_unverified_without_manifest(tmp_path, tiny_state):
+    out = str(tmp_path)
+    path = ckpt.save_checkpoint(out, 0, tiny_state, best_acc1=0.0, is_best=False)
+    ckpt.wait_for_saves()
+    os.remove(ckpt.manifest_path(path))
+    assert ckpt.verify_checkpoint(path) == ("unverified", [])
+    # and restore_latest treats it as restorable (pre-manifest checkpoints)
+    blank = jax.tree.map(jnp.zeros_like, tiny_state)
+    res = ckpt.restore_latest(out, blank)
+    assert res is not None and res[5] == path
+
+
+# ---------------------------------------------------------------------------
+# Quarantine + fallback (the acceptance scenario's second half)
+# ---------------------------------------------------------------------------
+
+def test_byte_flipped_checkpoint_is_quarantined_and_run_falls_back(
+    tmp_path, tiny_state, recorded_events
+):
+    out = str(tmp_path)
+    ckpt.save_checkpoint(out, 0, tiny_state, best_acc1=7.0, is_best=False)
+    top = ckpt.save_checkpoint(out, 1, tiny_state, best_acc1=8.0, is_best=False)
+    ckpt.wait_for_saves()
+    _flip_one_byte(top)
+
+    blank = jax.tree.map(jnp.zeros_like, tiny_state)
+    res = ckpt.restore_latest(out, blank)
+    assert res is not None
+    st, epoch, step, best, _, used = res
+    # fell back to the previous, healthy checkpoint
+    assert used.endswith("ckpt_ep_001") and (epoch, step, best) == (1, 0, 7.0)
+    np.testing.assert_array_equal(np.asarray(st.params["w"]), np.arange(4.0))
+    # the corrupt one was moved aside, never to be scanned again
+    names = sorted(os.listdir(os.path.join(out, "checkpoints")))
+    assert "ckpt_ep_002" not in names
+    assert any(n.startswith("corrupt_ckpt_ep_002") for n in names), names
+    # typed journal event (satellite: skips/quarantines are never silent)
+    quarantined = [f for k, f in recorded_events if k == "ckpt_quarantined"]
+    assert len(quarantined) == 1
+    assert quarantined[0]["path"] == top and quarantined[0]["quarantine_path"]
+    # a second restore scan no longer sees the corrupt candidate at all
+    res2 = ckpt.restore_latest(out, blank)
+    assert res2 is not None and res2[5].endswith("ckpt_ep_001")
+
+
+def test_verify_cli_reports_and_quarantines(tmp_path, tiny_state):
+    out = str(tmp_path)
+    ok_path = ckpt.save_checkpoint(out, 0, tiny_state, best_acc1=0.0, is_best=False)
+    bad_path = ckpt.save_checkpoint(out, 1, tiny_state, best_acc1=0.0, is_best=False)
+    ckpt.wait_for_saves()
+    _flip_one_byte(bad_path)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "distribuuuu_tpu.checkpoint", "verify", out],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout and "CORRUPT" in proc.stdout
+    assert os.path.basename(ok_path) in proc.stdout
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "distribuuuu_tpu.checkpoint", "verify", out, "--quarantine"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300,
+    )
+    assert proc.returncode == 1
+    names = os.listdir(os.path.join(out, "checkpoints"))
+    assert any(n.startswith("corrupt_ckpt_ep_002") for n in names)
+
+    # all clean now: exit 0
+    proc = subprocess.run(
+        [sys.executable, "-m", "distribuuuu_tpu.checkpoint", "verify", out],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Prune vs in-flight restore (satellite)
+# ---------------------------------------------------------------------------
+
+def test_prune_never_deletes_checkpoint_under_inflight_restore(tmp_path, tiny_state):
+    out = str(tmp_path)
+    rng = jax.random.PRNGKey(0)
+    path = ckpt.save_mid_checkpoint(out, 0, 3, tiny_state, 0.0, rng, samples_per_step=8)
+    ckpt.wait_for_saves()
+
+    with ckpt.restore_guard(path):
+        assert ckpt.restore_in_flight(path)
+        ckpt.prune_mid_checkpoints(out, before_epoch=99)
+        assert os.path.isdir(path), "pruned out from under an in-flight restore"
+    assert not ckpt.restore_in_flight(path)
+    ckpt.prune_mid_checkpoints(out, before_epoch=99)
+    assert not os.path.isdir(path)  # prunable again once the restore ended
+
+
+def test_prune_racing_threaded_restore(tmp_path, tiny_state, monkeypatch):
+    """End-to-end shape of the race: restore_latest holds the guard across
+    verify+load, so a concurrent prune (epoch save completing on another
+    thread) cannot delete the selected mid checkpoint mid-read."""
+    out = str(tmp_path)
+    rng = jax.random.PRNGKey(0)
+    path = ckpt.save_mid_checkpoint(out, 1, 2, tiny_state, 0.0, rng, samples_per_step=8)
+    ckpt.wait_for_saves()
+    blank = jax.tree.map(jnp.zeros_like, tiny_state)
+
+    in_verify = threading.Event()
+    release = threading.Event()
+    real_verify = ckpt.verify_checkpoint
+
+    def slow_verify(p):
+        in_verify.set()
+        assert release.wait(timeout=30)
+        return real_verify(p)
+
+    monkeypatch.setattr(ckpt, "verify_checkpoint", slow_verify)
+    result = {}
+
+    def do_restore():
+        result["res"] = ckpt.restore_latest(out, blank)
+
+    t = threading.Thread(target=do_restore)
+    t.start()
+    assert in_verify.wait(timeout=30)
+    # an epoch-2 save completing now would prune every mid ckpt below it
+    ckpt.prune_mid_checkpoints(out, before_epoch=2)
+    assert os.path.isdir(path), "prune deleted the checkpoint being restored"
+    release.set()
+    t.join(timeout=60)
+    res = result["res"]
+    assert res is not None and res[5] == path and (res[1], res[2]) == (1, 2)

@@ -12,17 +12,16 @@ import sys
 
 import pytest
 
-from _multiproc import pick_port, run_ranks
+from _multiproc import launch_ranks
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.slow
 def test_two_process_training(tmp_path):
-    port = pick_port()
     out_dir = tmp_path / "out"
 
-    def make_cmd(rank):
+    def make_cmd(rank, port):
         return [
             sys.executable,
             os.path.join(REPO, "scripts", "cpu_mesh_run.py"),
@@ -43,7 +42,7 @@ def test_two_process_training(tmp_path):
             "OUT_DIR", str(out_dir),
         ]
 
-    def make_env(rank):
+    def make_env(rank, port):
         env = dict(
             os.environ,
             RANK=str(rank),
@@ -57,7 +56,7 @@ def test_two_process_training(tmp_path):
         env.pop("JAX_PLATFORMS", None)
         return env
 
-    results = run_ranks(tmp_path, 2, make_cmd, make_env, REPO, timeout=540)
+    results = launch_ranks(tmp_path, 2, make_cmd, make_env, REPO, timeout=540)
     for rank, (rc, text) in enumerate(results):
         assert rc == 0, f"rank {rank} rc={rc}:\n{text[-3000:]}"
     r0 = results[0][1]

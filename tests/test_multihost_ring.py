@@ -12,29 +12,27 @@ import sys
 
 import pytest
 
-from _multiproc import pick_port, run_ranks
+from _multiproc import launch_ranks
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.slow
 def test_two_process_ring(tmp_path):
-    port = pick_port()
-
-    def make_cmd(rank):
+    def make_cmd(rank, port):
         return [
             sys.executable,
             os.path.join(REPO, "tests", "_ring_2proc_worker.py"),
             str(rank), str(port),
         ]
 
-    def make_env(rank):
+    def make_env(rank, port):
         env = dict(os.environ)
         env.pop("JAX_PLATFORMS", None)
         env.pop("XLA_FLAGS", None)  # worker pins its own 4-device count
         return env
 
-    results = run_ranks(tmp_path, 2, make_cmd, make_env, REPO, timeout=420)
+    results = launch_ranks(tmp_path, 2, make_cmd, make_env, REPO, timeout=420)
     for rank, (rc, text) in enumerate(results):
         assert rc == 0, f"rank {rank} rc={rc}:\n{text[-3000:]}"
         assert f"RING2PROC OK rank={rank}" in text, text[-2000:]

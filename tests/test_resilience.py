@@ -12,6 +12,7 @@ paths are each pinned separately.
 import os
 import signal
 import threading
+import time
 
 import flax.linen as nn
 import jax
@@ -202,6 +203,96 @@ def test_handler_not_installable_off_main_thread():
     t.start()
     t.join()
     assert results == [False]
+
+
+# ---------------------------------------------------------------------------
+# Watchdog (unit level; the subprocess fire path lives in tests/test_chaos.py)
+# ---------------------------------------------------------------------------
+
+def test_watchdog_fires_after_stall_with_dump_and_exit():
+    fired = {}
+
+    wd = resilience.Watchdog(
+        0.15,
+        poll_s=0.02,
+        _exit_fn=lambda code: fired.setdefault("code", code),
+        _dump_fn=lambda reason: fired.setdefault("dump", reason),
+    ).start()
+    try:
+        wd.beat(7, phase="train")
+        # wait for the exit hook, not just the fired flag: _fire sets the
+        # flag first and records the exit code last (after the dumps), and
+        # a loaded box can stretch that gap
+        deadline = time.monotonic() + 30.0
+        while "code" not in fired and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert wd.fired
+        assert fired["code"] == resilience.HANG_EXIT_CODE == 124
+        assert "step 7" in fired["dump"]
+    finally:
+        wd.stop()
+
+
+def test_watchdog_does_not_fire_while_beaten():
+    fired = {}
+    wd = resilience.Watchdog(
+        0.2, poll_s=0.02,
+        _exit_fn=lambda code: fired.setdefault("code", code),
+        _dump_fn=lambda reason: None,
+    ).start()
+    try:
+        for i in range(8):
+            wd.beat(i)
+            time.sleep(0.05)  # total 0.4s > timeout, but beats keep it quiet
+        assert not wd.fired and "code" not in fired
+    finally:
+        wd.stop()
+    # stop() disarms for good: no late fire after the run ends
+    time.sleep(0.3)
+    assert "code" not in fired
+
+
+def test_watchdog_module_wiring_is_noop_when_disarmed():
+    resilience.watchdog_beat(3)  # must not raise with no watchdog armed
+    assert resilience.start_watchdog(0.0) is None  # disabled by timeout<=0
+    wd = resilience.start_watchdog(30.0)
+    try:
+        assert wd is not None
+        resilience.watchdog_beat(5, phase="eval")
+        assert wd._last_step == 5 and wd._phase == "eval"
+    finally:
+        resilience.stop_watchdog()
+    resilience.watchdog_beat(6)  # disarmed again: no-op
+
+
+@pytest.mark.faultinject
+def test_injector_hang_and_kill_knobs(fresh_cfg, monkeypatch):
+    inj = resilience.FaultInjector(hang_step=4, kill_step=9)
+    assert inj.active
+    assert inj.should_hang(4) and not inj.should_hang(5)
+    assert inj.should_kill(9) and not inj.should_kill(8)
+
+    monkeypatch.setenv("DTPU_FAULT_HANG_STEP", "2")
+    monkeypatch.setenv("DTPU_FAULT_KILL_STEP", "3")
+    env_inj = resilience.FaultInjector()
+    assert env_inj.hang_step == 2 and env_inj.kill_step == 3 and env_inj.active
+
+    fresh_cfg.FAULT.INJECT_KILL_STEP = 7
+    monkeypatch.delenv("DTPU_FAULT_HANG_STEP")
+    monkeypatch.delenv("DTPU_FAULT_KILL_STEP")
+    cfg_inj = resilience.FaultInjector()
+    assert cfg_inj.kill_step == 7 and cfg_inj.hang_step == -1
+
+
+def test_sigusr2_stack_dump_registered_by_setup_distributed(capfd):
+    from distribuuuu_tpu.runtime import setup_distributed
+
+    setup_distributed()  # single-process no-op apart from the signal hooks
+    os.kill(os.getpid(), signal.SIGUSR2)
+    # faulthandler writes synchronously from the C handler; give it a beat
+    time.sleep(0.2)
+    err = capfd.readouterr().err
+    assert "Current thread" in err or "Thread 0x" in err, err[-2000:]
 
 
 # ---------------------------------------------------------------------------
