@@ -1,0 +1,821 @@
+"""dtpu-agent: per-host in-job supervisor (docs/FAULT_TOLERANCE.md).
+
+PRs 1 and 4 built the *detection* half of fault tolerance: the watchdog
+turns a dead peer into a bounded-time exit 124, corrupt checkpoints are
+quarantined, preemption and non-finite-divergence aborts are typed journal
+events. But every one of those failures still ended the run and waited for
+a human. This module is the *recovery* half — the torchelastic-style agent
+for the JAX stack: it launches the training worker(s) as child processes,
+multiplexes their rank logs, heartbeats off the obs journal, and turns each
+failure class into a bounded-time automated recovery:
+
+- **hang** (exit `resilience.HANG_EXIT_CODE`, 124 — the in-process watchdog
+  fired, or the agent's own journal heartbeat stalled): immediate relaunch;
+  auto-resume re-enters from the last durable checkpoint (elastic, so a
+  resized relaunch works too).
+- **preemption** (143/130): relaunch and resume — unless the *agent itself*
+  was signaled, in which case it forwards the signal to the workers (they
+  emergency-checkpoint), waits them out, and exits with the same code so
+  the cluster scheduler sees an ordinary preempted job.
+- **transient crash** (anything else, SIGKILL'd ranks included): relaunch
+  with exponential backoff + full jitter, under a crash-loop budget —
+  ``AGENT.MAX_RESTARTS`` restarts inside a sliding
+  ``AGENT.RESTART_WINDOW_S`` window, so ancient failures age out instead of
+  eventually bricking a week-long run.
+- **poison** (exit `resilience.POISON_EXIT_CODE`, 117 — the worker aborted
+  on persistent non-finite steps): restarting would replay the same
+  divergence, so the agent escalates a **rollback** instead: each poison
+  exit bumps ``DTPU_RESUME_ROLLBACK``, making auto-resume skip one more of
+  the most-advanced *known-good* (integrity-verified) checkpoints, until
+  the run escapes the poison basin or ``AGENT.MAX_ROLLBACKS``/the candidate
+  list is exhausted — at which point the agent gives up with a typed
+  ``supervisor_verdict`` journal record instead of looping forever.
+
+Before every (re)launch a **preflight gate** runs: device probe (in a
+subprocess, so the agent process never claims the accelerators its workers
+need), free-disk threshold, integrity verification of the resume target
+(corrupt candidates are quarantined right there, not discovered mid-restore)
+and rendezvous-port liveness. A failed preflight is journaled and counts
+against the restart budget — a host that can't pass preflight is a failing
+host, not an excuse to spin.
+
+Everything the agent does is a typed ``supervisor_*`` record in the same
+telemetry journal the workers write (`obs/journal.py`), so one
+``python -m distribuuuu_tpu.obs summarize`` shows the whole supervised
+history: attempts, recoveries, rollbacks, verdict.
+
+CLI (same config contract as train_net.py)::
+
+    python -m distribuuuu_tpu.agent --cfg config/resnet50.yaml [KEY VALUE ...]
+    python scripts/dtpu_agent.py    --cfg ...   # identical
+
+The default worker is ``python -m distribuuuu_tpu.agent --worker <same
+argv>``, which runs `trainer.train_model` with the exit-code taxonomy
+applied (`resilience.classify_exit_code`); ``AGENT.CMD`` substitutes any
+other command — recovery state rides env vars (``DTPU_RESUME_ROLLBACK``,
+``DTPU_AGENT_ATTEMPT``), never argv.
+
+The supervisor process never *initializes* an accelerator backend (no
+device-touching jax call; the device probe runs in a throwaway subprocess),
+so the chips stay free for its workers; heavyweight modules
+(checkpoint/orbax, trainer) load lazily, only when a preflight or worker
+mode needs them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import os
+import random
+import re
+import shlex
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Callable
+
+from distribuuuu_tpu import resilience
+from distribuuuu_tpu.config import cfg, load_cfg_fom_args
+from distribuuuu_tpu.logging import logger
+from distribuuuu_tpu.obs.journal import Journal, _journal_parts, validate_record
+
+# Env keys of the chaos injections (transient machine faults by
+# construction): disarmed in relaunched workers when
+# AGENT.DISARM_CHAOS_ON_RESTART, because a gstep-keyed injection re-fires
+# on every replay and would turn one injected fault into a crash loop.
+# INJECT_NAN_STEPS is deliberately NOT here: data poison is persistent, and
+# replaying it is exactly what exercises the rollback escalation.
+_CHAOS_ENV_DISARM = {
+    "DTPU_FAULT_KILL_STEP": "-1",
+    "DTPU_FAULT_HANG_STEP": "-1",
+    "DTPU_FAULT_PREEMPT_STEP": "-1",
+}
+
+# Jittered like resilience.retry, and seeded for the same reason: two
+# identical supervisions log identical backoff schedules (delays influence
+# wall time only, never numerics).
+_backoff_rng = random.Random(0xA6E7)
+
+
+# ---------------------------------------------------------------------------
+# Recovery policy pieces (pure host-side logic; unit-tested without jax)
+# ---------------------------------------------------------------------------
+
+class RestartBudget:
+    """Sliding-window crash-loop budget.
+
+    ``try_spend()`` succeeds while fewer than ``max_restarts`` restarts
+    happened inside the trailing ``window_s`` seconds; older spends age out.
+    A run that crashes five times in its first hour and then trains cleanly
+    for a week has a full budget again when the flaky switch port acts up.
+    """
+
+    def __init__(
+        self,
+        max_restarts: int,
+        window_s: float,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.max_restarts = int(max_restarts)
+        self.window_s = float(window_s)
+        self._clock = clock
+        self._spent: collections.deque[float] = collections.deque()
+
+    def _prune(self) -> None:
+        now = self._clock()
+        while self._spent and now - self._spent[0] > self.window_s:
+            self._spent.popleft()
+
+    def in_window(self) -> int:
+        self._prune()
+        return len(self._spent)
+
+    def try_spend(self) -> bool:
+        self._prune()
+        if len(self._spent) >= self.max_restarts:
+            return False
+        self._spent.append(self._clock())
+        return True
+
+
+def backoff_delay(
+    consecutive: int, base_s: float, max_s: float, rng: random.Random | None = None
+) -> float:
+    """Full-jitter exponential backoff: ``uniform(0, min(max, base·2^n))``
+    — the same shape as `resilience.retry`, at supervisor timescales."""
+    rng = rng or _backoff_rng
+    return rng.uniform(0.0, min(float(max_s), float(base_s) * (2.0 ** max(0, consecutive))))
+
+
+# Merge precedence for a fleet's per-rank exits: the most actionable
+# classification wins (a SIGKILL'd rank is the root cause; its survivors'
+# watchdog 124s are the symptom).
+_OUTCOME_PRECEDENCE = (
+    resilience.EXIT_POISON,
+    resilience.EXIT_KILLED,
+    resilience.EXIT_CRASH,
+    resilience.EXIT_HANG,
+    resilience.EXIT_PREEMPTED,
+    resilience.EXIT_CLEAN,
+)
+
+
+def merge_outcomes(codes: list[int | None]) -> str:
+    """One fleet-level outcome from per-rank exit codes."""
+    kinds = {resilience.classify_exit_code(c) for c in codes}
+    for kind in _OUTCOME_PRECEDENCE:
+        if kind in kinds:
+            return kind
+    return resilience.EXIT_CLEAN
+
+
+# ---------------------------------------------------------------------------
+# Supervisor journal (typed records into the run's telemetry journal)
+# ---------------------------------------------------------------------------
+
+class SupervisorJournal:
+    """Validated ``supervisor_*`` appends into OUT_DIR's telemetry journal.
+
+    The agent writes only while no worker is mid-record (between attempts,
+    or about to kill a wedged fleet), so sharing the workers' journal file
+    is safe on local filesystems (append-mode line writes). ``path=None``
+    (journaling impossible) degrades every call to a no-op — supervision
+    must never die of observability.
+    """
+
+    def __init__(self, out_dir: str):
+        self.path: str | None = None
+        self._journal: Journal | None = None
+        try:
+            from distribuuuu_tpu.obs.telemetry import journal_path
+
+            self.path = journal_path(out_dir)
+            self._journal = Journal(self.path)
+        except Exception as exc:  # pragma: no cover - defensive
+            logger.warning(f"supervisor journal unavailable: {exc!r}")
+
+    def event(self, kind: str, **fields: Any) -> None:
+        if self._journal is None:
+            return
+        record = {"ts": time.time(), "kind": kind, **fields}
+        errors = validate_record(record)
+        if errors:
+            logger.error(f"agent: invalid {kind!r} record dropped: {errors}")
+            return
+        try:
+            self._journal.append(record)
+        except Exception as exc:  # pragma: no cover - defensive
+            logger.warning(f"supervisor journal append failed: {exc!r}")
+
+    def close(self) -> None:
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+
+
+def _journal_bytes(path: str | None) -> int:
+    """Total bytes across the journal and its ``.partN`` continuations —
+    the heartbeat signal (rank 0 appends a record every PRINT_FREQ window)."""
+    if not path:
+        return 0
+    total = 0
+    for p in _journal_parts(path):
+        try:
+            total += os.path.getsize(p)
+        except OSError:
+            pass
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Preflight gate
+# ---------------------------------------------------------------------------
+
+def preflight_checks(
+    out_dir: str,
+    *,
+    rollback: int,
+    port: int | None,
+    min_free_disk_gb: float,
+    device_probe: bool,
+    device_probe_timeout_s: float,
+    probe_env: dict[str, str] | None = None,
+) -> tuple[bool, list[str], dict[str, Any]]:
+    """Run the launch gate; returns ``(ok, failures, checks)``.
+
+    Checks (each recorded in ``checks``, failures also listed by name):
+
+    - ``free_disk``: OUT_DIR's filesystem has ≥ ``min_free_disk_gb`` free
+      (emergency checkpoints on a full disk fail exactly when they matter).
+    - ``devices``: a throwaway subprocess can initialize the JAX backend and
+      sees ≥ 1 device. Subprocess on purpose — backend init claims the
+      accelerators, which must stay free for the workers.
+    - ``rendezvous_port``: the fleet's MASTER_PORT is bindable (a stale
+      worker still holding it would fail every relaunched rank).
+    - ``resume_target``: the checkpoint auto-resume will pick (at the
+      current rollback depth) passes integrity verification. Corrupt
+      candidates are quarantined here — at preflight, not mid-restore.
+    """
+    failures: list[str] = []
+    checks: dict[str, Any] = {}
+
+    if min_free_disk_gb > 0:
+        probe_dir = out_dir if os.path.isdir(out_dir) else (os.path.dirname(out_dir) or ".")
+        try:
+            free_gb = shutil.disk_usage(probe_dir).free / 2**30
+            checks["free_disk_gb"] = round(free_gb, 2)
+            if free_gb < min_free_disk_gb:
+                failures.append("free_disk")
+        except OSError as exc:
+            checks["free_disk_gb"] = f"unreadable: {exc!r}"
+            failures.append("free_disk")
+
+    if device_probe:
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; print(jax.device_count())"],
+                capture_output=True,
+                text=True,
+                timeout=device_probe_timeout_s,
+                env=probe_env if probe_env is not None else dict(os.environ),
+            )
+            n = int(probe.stdout.strip() or 0) if probe.returncode == 0 else 0
+            checks["devices"] = n
+            if probe.returncode != 0 or n < 1:
+                checks["device_probe_error"] = (probe.stderr or "")[-500:]
+                failures.append("devices")
+        except (subprocess.TimeoutExpired, OSError) as exc:
+            checks["devices"] = 0
+            checks["device_probe_error"] = repr(exc)[:500]
+            failures.append("devices")
+
+    if port is not None:
+        from distribuuuu_tpu.runtime.dist import port_is_free
+
+        checks["rendezvous_port"] = int(port)
+        if not port_is_free(port):
+            failures.append("rendezvous_port")
+
+    target, status = verify_resume_target(out_dir, rollback)
+    checks["resume_target"] = target or "fresh"
+    checks["resume_target_status"] = status
+    if status == "exhausted":  # every candidate was corrupt or rolled past
+        failures.append("resume_target")
+
+    return not failures, failures, checks
+
+
+def verify_resume_target(out_dir: str, rollback: int) -> tuple[str | None, str]:
+    """The checkpoint auto-resume will select at this rollback depth, with
+    its integrity status ("ok" / "unverified" / "fresh"); corrupt candidates
+    encountered on the way are quarantined (so the worker never spends a
+    restart discovering them). Returns ``(None, "fresh")`` when nothing is
+    restorable and ``(None, "exhausted")`` when rollback skipped everything
+    — the signal the poison escalation has run out of history."""
+    # lazy: checkpoint pulls in jax/orbax, which the supervisor avoids until
+    # a preflight actually needs the scan
+    from distribuuuu_tpu import checkpoint as ckpt
+
+    candidates = ckpt.resume_candidates(out_dir)
+    if not candidates:
+        return None, "fresh"
+    skip = max(0, int(rollback))
+    for _, _, path in candidates:
+        status, errors = ckpt.verify_checkpoint(path)
+        if status == "corrupt":
+            ckpt.quarantine_checkpoint(path, errors)
+            continue
+        if skip > 0:
+            skip -= 1
+            continue
+        return path, status
+    return None, "exhausted"
+
+
+# ---------------------------------------------------------------------------
+# Worker fleet
+# ---------------------------------------------------------------------------
+
+_XLA_HOST_DEVICES_RE = re.compile(r"--xla_force_host_platform_device_count=\d+\s*")
+
+
+class LaunchError(RuntimeError):
+    """A worker process could not be spawned at all (bad AGENT.CMD, missing
+    interpreter, fork limits) — classified as a crash by the recovery loop."""
+
+
+class Worker:
+    """One supervised rank: process handle + log multiplexer thread."""
+
+    def __init__(self, rank: int, cmd: list[str], env: dict[str, str], log_path: str):
+        self.rank = rank
+        self.log_path = log_path
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        self._log = open(log_path, "wb")
+        self.proc = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT
+        )
+        self._pump = threading.Thread(
+            target=self._pump_lines, daemon=True, name=f"dtpu-agent-log-r{rank}"
+        )
+        self._pump.start()
+
+    def _pump_lines(self) -> None:
+        # line-level multiplexing: every rank's output lands in its own log
+        # file AND, prefixed, on the agent's stdout — the operator watches
+        # one stream, the postmortem reads per-rank files
+        prefix = f"[rank {self.rank}] ".encode()
+        stdout = getattr(sys.stdout, "buffer", None)
+        assert self.proc.stdout is not None
+        for line in self.proc.stdout:
+            try:
+                self._log.write(line)
+                self._log.flush()
+                if stdout is not None:
+                    stdout.write(prefix + line)
+                    stdout.flush()
+            except (OSError, ValueError):  # closed mid-shutdown
+                break
+
+    @property
+    def returncode(self) -> int | None:
+        return self.proc.poll()
+
+    def signal(self, signum: int) -> None:
+        try:
+            self.proc.send_signal(signum)
+        except (ProcessLookupError, OSError):
+            pass
+
+    def finish(self) -> None:
+        self._pump.join(timeout=10.0)
+        try:
+            self._log.close()
+        except OSError:
+            pass
+
+
+class Agent:
+    """The supervisor loop. One instance per ``python -m distribuuuu_tpu.agent``."""
+
+    def __init__(self, worker_argv: list[str]):
+        self._worker_argv = list(worker_argv)
+        self._stop = threading.Event()
+        self._stop_signum: int | None = None
+        self._workers: list[Worker] = []
+        a = cfg.AGENT
+        self.nprocs = int(a.NPROCS)
+        self.budget = RestartBudget(a.MAX_RESTARTS, a.RESTART_WINDOW_S)
+        self.journal = SupervisorJournal(cfg.OUT_DIR)
+
+    # -- signals ------------------------------------------------------------
+
+    def _install_signals(self) -> None:
+        def handler(signum, frame):
+            self._stop_signum = signum
+            self._stop.set()
+            # forward: the workers own the emergency-checkpoint machinery
+            for w in self._workers:
+                w.signal(signal.SIGTERM)
+
+        try:
+            signal.signal(signal.SIGTERM, handler)
+            signal.signal(signal.SIGINT, handler)
+        except ValueError:  # not the main thread (embedded agent)
+            logger.warning("agent: signal forwarding not installed (not on main thread)")
+
+    # -- launch -------------------------------------------------------------
+
+    def _worker_cmd(self) -> list[str]:
+        if cfg.AGENT.CMD:
+            return shlex.split(cfg.AGENT.CMD)
+        return [sys.executable, "-m", "distribuuuu_tpu.agent", "--worker", *self._worker_argv]
+
+    def _worker_env(self, rank: int, attempt: int, rollback: int, port: int | None) -> dict[str, str]:
+        env = dict(os.environ)
+        if self.nprocs > 1:
+            env.update(
+                RANK=str(rank),
+                WORLD_SIZE=str(self.nprocs),
+                MASTER_ADDR="127.0.0.1",
+                MASTER_PORT=str(port),
+            )
+        env["DTPU_AGENT_ATTEMPT"] = str(attempt)
+        env["DTPU_RESUME_ROLLBACK"] = str(rollback)
+        if attempt > 1 and cfg.AGENT.DISARM_CHAOS_ON_RESTART:
+            env.update(_CHAOS_ENV_DISARM)
+        n_cpu = int(cfg.AGENT.CPU_DEVICES_PER_WORKER)
+        if n_cpu > 0:
+            flags = _XLA_HOST_DEVICES_RE.sub("", env.get("XLA_FLAGS", "")).strip()
+            env["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={n_cpu}".strip()
+            )
+        return env
+
+    def _launch(self, attempt: int, rollback: int, port: int | None) -> None:
+        """Spawn the fleet; raises ``LaunchError`` (partial fleet reaped) when
+        any rank fails to even start — a bad AGENT.CMD must end in a typed
+        verdict via the restart budget, never an unwound supervisor."""
+        cmd = self._worker_cmd()
+        agent_dir = os.path.join(cfg.OUT_DIR, "agent", f"attempt_{attempt:03d}")
+        self._workers = []
+        try:
+            for rank in range(self.nprocs):
+                self._workers.append(
+                    Worker(
+                        rank,
+                        cmd,
+                        self._worker_env(rank, attempt, rollback, port),
+                        os.path.join(agent_dir, f"rank{rank}.log"),
+                    )
+                )
+        except OSError as exc:  # FileNotFoundError (typo'd cmd), EPERM, ...
+            for w in self._workers:
+                w.signal(signal.SIGKILL)
+                w.finish()
+            self._workers = []
+            raise LaunchError(f"could not spawn {' '.join(cmd)!r}: {exc!r}") from exc
+        self.journal.event(
+            "supervisor_launch",
+            attempt=attempt,
+            nprocs=self.nprocs,
+            rollback=rollback,
+            port=int(port) if port is not None else 0,
+            cmd=" ".join(cmd),
+        )
+        logger.info(
+            f"agent: attempt {attempt}: launched {self.nprocs} worker(s) "
+            f"(rollback={rollback}"
+            + (f", rendezvous 127.0.0.1:{port}" if port is not None else "")
+            + f"): {' '.join(cmd)}"
+        )
+
+    # -- wait / heartbeat / exit barrier -------------------------------------
+
+    def _kill_fleet(self, why: str) -> None:
+        """SIGUSR2 (stack dump into the rank log) → grace → SIGKILL."""
+        logger.error(f"agent: killing worker fleet: {why}")
+        for w in self._workers:
+            if w.returncode is None and hasattr(signal, "SIGUSR2"):
+                w.signal(signal.SIGUSR2)  # diagnose before dying
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline and any(
+            w.returncode is None for w in self._workers
+        ):
+            time.sleep(0.1)
+        for w in self._workers:
+            if w.returncode is None:
+                w.signal(signal.SIGKILL)
+
+    def _wait_fleet(self, poll_s: float = 0.2) -> tuple[list[int | None], bool]:
+        """Block until every worker exited; returns (codes, heartbeat_kill).
+
+        Two supervisor-side timers run while waiting:
+
+        - **journal heartbeat** (``AGENT.HEARTBEAT_TIMEOUT_S``): the fleet is
+          wedged if rank 0's journal stops growing — the backstop for the
+          case the in-process watchdog can't cover (whole process stalled,
+          watchdog thread included).
+        - **exit barrier** (``AGENT.EXIT_BARRIER_S``): once ANY rank exits,
+          the rest get this long to follow before being killed — a dead peer
+          leaves survivors wedged in a collective, and their own watchdogs
+          may be disabled.
+        """
+        hb_timeout = float(cfg.AGENT.HEARTBEAT_TIMEOUT_S)
+        hb_path = self.journal.path
+        hb_size = _journal_bytes(hb_path)
+        hb_t = time.monotonic()
+        barrier_deadline: float | None = None
+        hb_kill = False
+        while True:
+            alive = [w for w in self._workers if w.returncode is None]
+            if not alive:
+                break
+            now = time.monotonic()
+            if len(alive) < len(self._workers):
+                if barrier_deadline is None:
+                    barrier_deadline = now + float(cfg.AGENT.EXIT_BARRIER_S)
+                elif now > barrier_deadline:
+                    self._kill_fleet(
+                        f"{len(alive)} rank(s) still running "
+                        f"{cfg.AGENT.EXIT_BARRIER_S:.0f}s after the first exit"
+                    )
+                    barrier_deadline = None  # killed; loop drains
+            elif hb_timeout > 0:
+                size = _journal_bytes(hb_path)
+                if size != hb_size:
+                    hb_size, hb_t = size, now
+                elif now - hb_t > hb_timeout:
+                    hb_kill = True
+                    self.journal.event(  # journaled BEFORE the kill (the
+                        "hang",  # fleet is wedged, not writing); the single
+                        # supervisor_exit record follows once the fleet drains
+                        timeout_s=hb_timeout,
+                        stalled_s=round(now - hb_t, 3),
+                        phase="supervisor_heartbeat",
+                    )
+                    self._kill_fleet(
+                        f"journal heartbeat stalled {now - hb_t:.0f}s "
+                        f"(timeout {hb_timeout:.0f}s)"
+                    )
+                    hb_t = now  # killed; loop drains
+            self._stop.wait(poll_s)
+        for w in self._workers:
+            w.finish()
+        return [w.returncode for w in self._workers], hb_kill
+
+    # -- the supervision loop ------------------------------------------------
+
+    def run(self) -> int:
+        a = cfg.AGENT
+        self._install_signals()
+        tic = time.time()
+        self.journal.event(
+            "supervisor_start",
+            nprocs=self.nprocs,
+            max_restarts=int(a.MAX_RESTARTS),
+            restart_window_s=float(a.RESTART_WINDOW_S),
+            cmd=" ".join(self._worker_cmd()),
+            out_dir=str(cfg.OUT_DIR),
+        )
+        attempt = 0
+        restarts = 0
+        rollback = int(os.environ.get("DTPU_RESUME_ROLLBACK", cfg.RESUME.ROLLBACK))
+        rollbacks = 0
+        verdict = None
+        reason = ""
+        while verdict is None:
+            if self._stop.is_set():
+                # signaled between fleets (mid-backoff, or during the last
+                # preflight): launching a fresh fleet now would miss the
+                # forwarded signal entirely and blow the kill-grace window
+                verdict, reason = "preempted", f"signal {self._stop_signum}"
+                break
+            attempt += 1
+            self._attempt = attempt
+            port = None
+            if self.nprocs > 1:
+                from distribuuuu_tpu.runtime.dist import pick_rendezvous_port
+
+                port = pick_rendezvous_port()
+
+            pf_tic = time.time()
+            ok, failures, checks = preflight_checks(
+                cfg.OUT_DIR,
+                rollback=rollback,
+                port=port,
+                min_free_disk_gb=float(a.MIN_FREE_DISK_GB),
+                device_probe=bool(a.PREFLIGHT_DEVICE_PROBE),
+                device_probe_timeout_s=float(a.DEVICE_PROBE_TIMEOUT_S),
+                probe_env=self._worker_env(0, attempt, rollback, port),
+            )
+            self.journal.event(
+                "supervisor_preflight",
+                attempt=attempt,
+                ok=ok,
+                failures=failures,
+                checks=checks,
+                wall_s=round(time.time() - pf_tic, 3),
+            )
+            if checks.get("resume_target_status") == "exhausted":
+                # candidates existed but none survived: at rollback > 0 the
+                # poison escalation ran out of history; at rollback 0 every
+                # checkpoint was corrupt — either way, silently restarting
+                # from scratch would discard the run's progress
+                verdict, reason = "gave_up", (
+                    f"rollback {rollback} exhausted the known-good checkpoint "
+                    f"history — nothing older to restore"
+                    if rollback > 0
+                    else "every resume candidate failed integrity verification "
+                    "(quarantined) — refusing to restart from scratch"
+                )
+                break
+            if not ok:
+                logger.error(f"agent: preflight failed ({', '.join(failures)}): {checks}")
+                if self._stop.is_set():
+                    verdict, reason = "preempted", "signal during preflight"
+                    break
+                if not self.budget.try_spend():
+                    verdict, reason = "gave_up", (
+                        f"preflight kept failing ({', '.join(failures)}) with the "
+                        f"restart budget exhausted"
+                    )
+                    break
+                delay = backoff_delay(self.budget.in_window(), a.BACKOFF_BASE_S, a.BACKOFF_MAX_S)
+                self.journal.event(
+                    "supervisor_recovery",
+                    attempt=attempt,
+                    outcome="preflight_failed",
+                    action="restart",
+                    backoff_s=round(delay, 3),
+                    rollback=rollback,
+                    restarts_in_window=self.budget.in_window(),
+                )
+                restarts += 1
+                self._stop.wait(delay)
+                continue
+
+            if self._stop.is_set():  # signaled during a passing preflight
+                verdict, reason = "preempted", f"signal {self._stop_signum}"
+                break
+
+            launch_tic = time.time()
+            try:
+                self._launch(attempt, rollback, port)
+            except LaunchError as exc:
+                logger.error(f"agent: {exc}")
+                if not self.budget.try_spend():
+                    verdict, reason = "gave_up", (
+                        f"worker launch kept failing ({exc}) with the restart "
+                        f"budget exhausted"
+                    )
+                    break
+                delay = backoff_delay(
+                    self.budget.in_window(), a.BACKOFF_BASE_S, a.BACKOFF_MAX_S
+                )
+                restarts += 1
+                self.journal.event(
+                    "supervisor_recovery",
+                    attempt=attempt,
+                    outcome="launch_failed",
+                    action="restart",
+                    backoff_s=round(delay, 3),
+                    rollback=rollback,
+                    restarts_in_window=self.budget.in_window(),
+                )
+                self._stop.wait(delay)
+                continue
+            codes, hb_kill = self._wait_fleet()
+            outcome = resilience.EXIT_HANG if hb_kill else merge_outcomes(codes)
+            self.journal.event(
+                "supervisor_exit",
+                attempt=attempt,
+                outcome=outcome,
+                codes=[c if c is not None else -1 for c in codes],
+                wall_s=round(time.time() - launch_tic, 3),
+                heartbeat_kill=hb_kill,
+            )
+            logger.info(f"agent: attempt {attempt} exited {codes} -> {outcome}")
+
+            if outcome == resilience.EXIT_CLEAN:
+                verdict, reason = "clean", "run completed"
+                break
+            if self._stop.is_set():
+                # the agent itself was preempted; the workers already wrote
+                # their emergency checkpoints on the forwarded SIGTERM
+                verdict, reason = "preempted", f"signal {self._stop_signum}"
+                break
+
+            if outcome == resilience.EXIT_POISON:
+                rollback += 1
+                rollbacks += 1
+                if rollback > int(a.MAX_ROLLBACKS):
+                    verdict, reason = "gave_up", (
+                        f"poison persisted through {a.MAX_ROLLBACKS} rollback(s) "
+                        f"— the divergence is not checkpoint-state; fix the "
+                        f"data/config and relaunch"
+                    )
+                    break
+                action, delay = "rollback", 0.0
+            elif outcome in (resilience.EXIT_HANG, resilience.EXIT_PREEMPTED):
+                # the run stopped at (hang) or committed (preempt) a durable
+                # point; relaunch immediately into elastic resume
+                action, delay = "restart", 0.0
+            else:  # crash / killed: back off against tight crash loops
+                action = "restart"
+                delay = backoff_delay(
+                    self.budget.in_window(), a.BACKOFF_BASE_S, a.BACKOFF_MAX_S
+                )
+
+            if not self.budget.try_spend():
+                verdict, reason = "gave_up", (
+                    f"{self.budget.max_restarts} restarts inside "
+                    f"{self.budget.window_s:.0f}s — crash loop, not a blip"
+                )
+                break
+            restarts += 1
+            self.journal.event(
+                "supervisor_recovery",
+                attempt=attempt,
+                outcome=outcome,
+                action=action,
+                backoff_s=round(delay, 3),
+                rollback=rollback,
+                restarts_in_window=self.budget.in_window(),
+            )
+            logger.warning(
+                f"agent: {outcome} -> {action} (backoff {delay:.1f}s, "
+                f"rollback {rollback}, "
+                f"{self.budget.in_window()}/{self.budget.max_restarts} restarts in window)"
+            )
+            if delay:
+                self._stop.wait(delay)
+
+        self.journal.event(
+            "supervisor_verdict",
+            verdict=verdict,
+            attempts=attempt,
+            restarts=restarts,
+            rollbacks=rollbacks,
+            reason=reason,
+            wall_s=round(time.time() - tic, 3),
+        )
+        (logger.info if verdict == "clean" else logger.error)(
+            f"agent verdict: {verdict} after {attempt} attempt(s), "
+            f"{restarts} restart(s), {rollbacks} rollback(s): {reason}"
+        )
+        self.journal.close()
+        if verdict == "clean":
+            return 0
+        if verdict == "preempted":
+            return 128 + (self._stop_signum or signal.SIGTERM)
+        return 1
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+def worker_main(argv: list[str]) -> int:
+    """The built-in worker: `trainer.train_model` under the exit taxonomy.
+
+    Separated from train_net.py so ``AGENT.CMD ""`` needs no repo-root
+    script on sys.path — `python -m distribuuuu_tpu.agent --worker` works
+    from anywhere the package is installed.
+    """
+    from distribuuuu_tpu import trainer
+
+    load_cfg_fom_args("dtpu-agent supervised training worker.", argv=argv)
+    cfg.freeze()
+    code, _ = resilience.call_with_poison_exit(trainer.train_model)
+    return code
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    parser = argparse.ArgumentParser(
+        prog="python -m distribuuuu_tpu.agent",
+        description="In-job supervisor: launch, watch and recover training "
+        "workers (docs/FAULT_TOLERANCE.md 'Supervised runs').",
+        add_help=False,
+    )
+    parser.add_argument("--worker", action="store_true")
+    known, rest = parser.parse_known_args(argv)
+    if known.worker:
+        return worker_main(rest)
+    # supervisor: load the same config the workers will (AGENT.* lives there)
+    load_cfg_fom_args("dtpu-agent: in-job supervision.", argv=rest)
+    from distribuuuu_tpu.logging import setup_logger
+
+    # stderr only — the rank-0 worker owns OUT_DIR's timestamped log file;
+    # the agent's own narration rides the multiplexed console stream
+    setup_logger(None, 0)
+    return Agent(rest).run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -383,11 +383,33 @@ def _state_device_count(state: Any) -> int:
     return jax.device_count()
 
 
+def _snapshot(tree):
+    """Independent on-device copies of every jax array in ``tree``.
+
+    Mandatory before an ASYNC save of the live train state: Orbax serializes
+    on a background thread while the step loop keeps training, and the jitted
+    step DONATES the state — on CPU, where host reads of a device buffer are
+    zero-copy views, the background writer reads the very memory the next
+    optimizer steps overwrite and commits a *torn* checkpoint (leaves holding
+    later-step or reused-buffer bytes) that still passes its own integrity
+    manifest, since the manifest hashes whatever bytes landed. Multi-host
+    fleets hit this reproducibly: the coordinated commit stretches the write
+    window across many steps (caught by tests/test_agent.py's supervised
+    recovery chaos tests). The copy is async-dispatched device work — no host
+    sync — and, unlike a host-side ``np.asarray`` snapshot, works for
+    non-fully-addressable multi-host shardings too.
+    """
+    return jax.tree.map(
+        lambda x: x.copy() if isinstance(x, jax.Array) else x, tree
+    )
+
+
 def save_checkpoint(out_dir: str, epoch: int, state: Any, best_acc1: float, is_best: bool) -> str:
     """Start an async save of a full training checkpoint; refresh ``best`` on
     improvement. Returns once device arrays are snapshotted (the expensive
     serialize+write happens in the background). ``epoch`` is the 0-based epoch
     just finished; the file is named ``epoch+1`` per the reference contract."""
+    state = _snapshot(state)
     payload = {
         "epoch": np.int32(epoch),
         "params": state.params,
@@ -745,6 +767,23 @@ def load_mid_checkpoint(path: str, state: Any, samples_per_step: int | None = No
     )
 
 
+def resume_candidates(
+    out_dir: str, *, step_granular: bool = True
+) -> list[tuple[tuple[int, int, int], str, str]]:
+    """Every resume candidate in ``out_dir`` as ``(position, kind, path)``,
+    most-advanced first — the ranking `restore_latest` walks and the
+    dtpu-agent's preflight gate verifies. ``position`` is ``(epoch, step,
+    tiebreak)`` with complete epoch checkpoints (``kind == "epoch"``)
+    outranking an emergency checkpoint (``"mid"``) at the same position."""
+    candidates: list[tuple[tuple[int, int, int], str, str]] = [
+        ((n, 0, 1), "epoch", p) for n, p in _complete_checkpoints(out_dir)
+    ]
+    if step_granular:
+        candidates += [((e, s, 0), "mid", p) for e, s, p in _mid_checkpoints(out_dir)]
+    candidates.sort(key=lambda c: c[0], reverse=True)
+    return candidates
+
+
 def restore_latest(
     out_dir: str,
     state: Any,
@@ -754,6 +793,7 @@ def restore_latest(
     load_opt: bool = True,
     verify_integrity: bool = True,
     samples_per_step: int | None = None,
+    rollback: int = 0,
 ):
     """Resume from the most-advanced restorable checkpoint in ``out_dir``.
 
@@ -783,22 +823,40 @@ def restore_latest(
     verify+restore, so a concurrent `prune_mid_checkpoints` cannot delete
     it mid-read.
 
+    ``rollback > 0`` (the dtpu-agent's poison-escalation knob,
+    ``RESUME.ROLLBACK`` / ``DTPU_RESUME_ROLLBACK``) deliberately skips that
+    many of the most-advanced **known-good** candidates — ones that pass the
+    integrity gate; corrupt/quarantined directories never spend rollback
+    budget — and restores the next-older one, journaling every skip
+    (``ckpt_skipped``, reason ``rollback``). A diverged run thus re-enters
+    training from *before* the state that keeps poisoning it, instead of
+    replaying the newest checkpoint into the same abort forever.
+
     Returns ``(state, start_epoch, start_step, best_acc1, rng_key | None,
     path)``, or ``None`` when nothing is restorable.
     """
-    candidates: list[tuple[tuple[int, int, int], str, str]] = [
-        ((n, 0, 1), "epoch", p) for n, p in _complete_checkpoints(out_dir)
-    ]
-    if step_granular:
-        candidates += [((e, s, 0), "mid", p) for e, s, p in _mid_checkpoints(out_dir)]
-    candidates.sort(key=lambda c: c[0], reverse=True)
-    for _, kind, path in candidates:
+    to_roll_back = max(0, int(rollback))
+    for _, kind, path in resume_candidates(out_dir, step_granular=step_granular):
         with restore_guard(path):
             if verify_integrity:
                 status, errors = verify_checkpoint(path)
                 if status == "corrupt":
                     quarantine_checkpoint(path, errors)  # warns + journals
                     continue
+            if to_roll_back > 0:
+                # known-good (it survived the integrity gate) but deliberately
+                # skipped: the supervisor judged everything this advanced to
+                # be inside the poison basin
+                to_roll_back -= 1
+                logger.warning(
+                    f"Rollback: skipping known-good checkpoint {path} "
+                    f"({to_roll_back} more to skip; RESUME.ROLLBACK={rollback})"
+                )
+                obs.current().event(
+                    "ckpt_skipped", path=path, reason="rollback",
+                    error=f"rollback depth {rollback}",
+                )
+                continue
             try:
                 if kind == "epoch":
                     st, start_epoch, best = load_checkpoint(path, state, load_opt=load_opt)

@@ -194,6 +194,59 @@ _C.OBS.PROFILE_TOP_OPS = 20
 # Live-array/HBM snapshot journaled at each epoch boundary.
 _C.OBS.MEMORY_SNAPSHOTS = True
 
+# In-job supervision (TPU addition; docs/FAULT_TOLERANCE.md "Supervised
+# runs"). `python -m distribuuuu_tpu.agent --cfg ...` launches the training
+# worker(s) as child processes and applies the exit-code recovery policy:
+# hang (124) -> immediate restart into elastic resume; preemption/transient
+# crash -> restart with exponential backoff + jitter under the restart
+# budget; poison (117, persistent non-finite divergence) -> rollback
+# escalation through progressively older known-good checkpoints.
+_C.AGENT = CN()
+# Worker processes (ranks) this agent launches on this host. >1 builds an
+# agent-owned localhost rendezvous (RANK/WORLD_SIZE/MASTER_ADDR/MASTER_PORT).
+_C.AGENT.NPROCS = 1
+# Restart budget: give up once this many restarts happened inside the
+# sliding RESTART_WINDOW_S window (failures older than the window age out,
+# so a long-lived run is not killed by crashes it survived hours ago).
+_C.AGENT.MAX_RESTARTS = 5
+_C.AGENT.RESTART_WINDOW_S = 3600.0
+# Exponential backoff (full jitter) between crash restarts; hang and
+# preemption exits relaunch immediately (the run resumes where it stopped).
+_C.AGENT.BACKOFF_BASE_S = 1.0
+_C.AGENT.BACKOFF_MAX_S = 60.0
+# Poison escalation: how many progressively-older known-good checkpoints to
+# roll back through before giving up with a supervisor_verdict record.
+_C.AGENT.MAX_ROLLBACKS = 2
+# Supervisor-side hang detection: kill + restart the fleet when the obs
+# journal stops growing for this long (0 disables). Complements the
+# in-process watchdog (FAULT.HANG_TIMEOUT_S), which cannot fire when the
+# whole process — watchdog thread included — is wedged or swapped out.
+_C.AGENT.HEARTBEAT_TIMEOUT_S = 0.0
+# Preflight gate thresholds (every failed preflight is journaled and counts
+# against the restart budget). MIN_FREE_DISK_GB 0 disables the disk check.
+_C.AGENT.MIN_FREE_DISK_GB = 1.0
+_C.AGENT.PREFLIGHT_DEVICE_PROBE = True
+_C.AGENT.DEVICE_PROBE_TIMEOUT_S = 120.0
+# After the first worker of a fleet exits, how long the others get to follow
+# before the agent kills the stragglers (a dead peer leaves them wedged in a
+# collective; the in-process watchdog usually beats this timer).
+_C.AGENT.EXIT_BARRIER_S = 120.0
+# Disarm the *chaos* fault injections (INJECT_KILL_STEP / INJECT_HANG_STEP /
+# INJECT_PREEMPT_STEP) in relaunched workers: they model transient machine
+# faults, and a gstep-keyed injection would otherwise re-fire on every
+# replay, turning one injected fault into a crash loop. Data-poison
+# injection (INJECT_NAN_STEPS) stays armed — persistent by design, it is
+# what exercises the rollback escalation.
+_C.AGENT.DISARM_CHAOS_ON_RESTART = True
+# Custom worker command (whitespace-split; empty = the built-in worker,
+# which runs trainer.train_model with this same --cfg/overrides argv).
+# The agent appends nothing: rendezvous + recovery state ride env vars.
+_C.AGENT.CMD = ""
+# CPU fleets only: set --xla_force_host_platform_device_count=<N> in each
+# worker's XLA_FLAGS (0 = leave the environment alone). How the CPU chaos
+# tier gives every rank its own single-device "host".
+_C.AGENT.CPU_DEVICES_PER_WORKER = 0
+
 # Resume policy (TPU addition). Epoch checkpoints stay the primary contract;
 # these govern the extra step-granular/robustness behavior on top.
 _C.RESUME = CN()
@@ -206,6 +259,12 @@ _C.RESUME.SKIP_CORRUPT = True
 # failed verify QUARANTINES the directory (rename to ``corrupt_*``, typed
 # journal event) and restore_latest falls back to the next-oldest.
 _C.RESUME.VERIFY_INTEGRITY = True
+# Rollback depth: auto-resume skips this many of the most-advanced
+# *known-good* (integrity-verified) checkpoints and restores an older one.
+# The dtpu-agent's poison escalation drives this via the
+# DTPU_RESUME_ROLLBACK env var (env wins, so the agent never edits YAMLs);
+# operators can set it by hand to back a diverged run out of a bad basin.
+_C.RESUME.ROLLBACK = 0
 
 # Output directory
 _C.OUT_DIR = "./exp"

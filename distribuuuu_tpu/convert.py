@@ -367,6 +367,12 @@ def _convert_vit(sd: Dict[str, np.ndarray]) -> dict:
     the packing ``MultiHeadSelfAttention``'s reshape (b, l, 3, H, hd) reads,
     so the kernel is a plain transpose. timm's separate ``attn.qkv`` Linear
     uses the same packing.
+
+    Keys that match no mapping **raise** (mirroring `verify_against_model`'s
+    flax-side loudness): a qk_norm/head_dist variant checkpoint, a typo'd
+    key, or a schema this table has never seen must fail the conversion with
+    the full list of strays — silently dropping them would hand back a model
+    that loads, runs, and scores garbage.
     """
     params: dict = {}
 
@@ -377,11 +383,14 @@ def _convert_vit(sd: Dict[str, np.ndarray]) -> dict:
         _set(params, path + ["kernel" if name == "weight" else "bias"],
              value.T if name == "weight" else value)
 
-    for key, value in sd.items():
+    def one(key: str, value) -> bool:
+        """Emit one state_dict entry; False = no mapping covers it."""
         parts = key.split(".")
         name = parts[-1]
         top = parts[0]
-        if top == "conv_proj" or (top == "patch_embed" and parts[1] == "proj"):
+        if top == "conv_proj" or (top == "patch_embed" and len(parts) > 2 and parts[1] == "proj"):
+            if name not in ("weight", "bias"):
+                return False
             if name == "weight":
                 _set(params, ["patch_embed", "kernel"], _conv_kernel(value))
             else:
@@ -394,8 +403,11 @@ def _convert_vit(sd: Dict[str, np.ndarray]) -> dict:
             ln(["ln_f"], name, value)
         elif key.startswith("heads.head.") or (top == "head" and len(parts) == 2):
             linear(["head"], name, value)
-        elif top == "encoder" and parts[1] == "layers":
-            i = int(parts[2].removeprefix("encoder_layer_"))
+        elif top == "encoder" and len(parts) > 3 and parts[1] == "layers":
+            try:
+                i = int(parts[2].removeprefix("encoder_layer_"))
+            except ValueError:  # non-index segment: report as a stray key,
+                return False  # not an opaque int() traceback
             block, mod = [f"block{i}"], parts[3]
             if mod in ("ln_1", "ln_2"):
                 ln(block + ["ln" + mod[-1]], name, value)
@@ -403,21 +415,47 @@ def _convert_vit(sd: Dict[str, np.ndarray]) -> dict:
                 if name in ("in_proj_weight", "in_proj_bias"):
                     linear(block + ["attn", "qkv"],
                            "weight" if name.endswith("weight") else "bias", value)
-                else:  # out_proj.{weight,bias}
+                elif len(parts) > 4 and parts[4] == "out_proj":
                     linear(block + ["attn", "proj"], name, value)
-            elif mod == "mlp":
-                fc = {"linear_1": "fc1", "linear_2": "fc2", "0": "fc1", "3": "fc2"}[parts[4]]
+                else:  # e.g. a qk-norm variant's extra attention params
+                    return False
+            elif mod == "mlp" and len(parts) > 4:
+                fc = {"linear_1": "fc1", "linear_2": "fc2", "0": "fc1", "3": "fc2"}.get(parts[4])
+                if fc is None:
+                    return False
                 linear(block + [fc], name, value)
-        elif top == "blocks":
-            i = int(parts[1])
+            else:
+                return False
+        elif top == "blocks" and len(parts) > 3:
+            try:
+                i = int(parts[1])
+            except ValueError:
+                return False
             block, mod = [f"block{i}"], parts[2]
             if mod in ("norm1", "norm2"):
                 ln(block + ["ln" + mod[-1]], name, value)
             elif mod == "attn":
-                linear(block + ["attn", {"qkv": "qkv", "proj": "proj"}[parts[3]]],
-                       name, value)
+                tgt = {"qkv": "qkv", "proj": "proj"}.get(parts[3])
+                if tgt is None:  # timm qk_norm (attn.q_norm/k_norm), etc.
+                    return False
+                linear(block + ["attn", tgt], name, value)
             elif mod == "mlp":
                 linear(block + [parts[3]], name, value)
+            else:
+                return False
+        else:
+            return False
+        return True
+
+    unmatched = [key for key, value in sd.items() if not one(key, value)]
+    if unmatched:
+        raise ValueError(
+            f"ViT conversion: {len(unmatched)} torch state_dict key(s) match "
+            f"no mapping and would be silently dropped: {sorted(unmatched)}. "
+            f"This usually means a model variant beyond the supported "
+            f"torchvision/timm schemas (qk_norm, distilled head, ...) or a "
+            f"typo'd key in a hand-edited checkpoint."
+        )
     return {"params": params, "batch_stats": {}}
 
 

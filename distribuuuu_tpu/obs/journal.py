@@ -149,6 +149,43 @@ SCHEMA: dict[str, tuple[dict[str, tuple], dict[str, tuple]]] = {
         {"timeout_s": _NUM, "stalled_s": _NUM, "phase": _STR},
         {"gstep": _NUM_OR_NONE},
     ),
+    # supervision (dtpu-agent) --------------------------------------------
+    # the agent took over this OUT_DIR: one per `python -m distribuuuu_tpu.agent`
+    "supervisor_start": (
+        {"nprocs": _INT, "max_restarts": _INT},
+        {"cmd": _STR, "out_dir": _STR, "restart_window_s": _NUM},
+    ),
+    # one preflight gate evaluation (before every launch/relaunch); a failed
+    # gate lists which checks failed and counts against the restart budget
+    "supervisor_preflight": (
+        {"attempt": _INT, "ok": _BOOL},
+        {"failures": _LIST, "checks": _DICT, "wall_s": _NUM},
+    ),
+    # a worker fleet was launched (attempt is 1-based across the whole
+    # supervision, rollback is the resume depth the fleet was launched at)
+    "supervisor_launch": (
+        {"attempt": _INT, "nprocs": _INT},
+        {"rollback": _INT, "port": _INT, "cmd": _STR},
+    ),
+    # a fleet finished one way or another: per-rank exit codes + the merged
+    # classification (resilience.classify_exit_code, worst rank wins)
+    "supervisor_exit": (
+        {"attempt": _INT, "outcome": _STR, "codes": _LIST},
+        {"wall_s": _NUM, "heartbeat_kill": _BOOL},
+    ),
+    # the recovery policy's decision for a non-clean exit: action is
+    # restart | rollback | give_up | preempt_exit, with the parameters the
+    # next attempt will use
+    "supervisor_recovery": (
+        {"attempt": _INT, "outcome": _STR, "action": _STR},
+        {"backoff_s": _NUM, "rollback": _INT, "restarts_in_window": _INT},
+    ),
+    # the agent's final word: verdict is clean | gave_up | preempted, with
+    # the whole supervision's totals — the record tests and operators gate on
+    "supervisor_verdict": (
+        {"verdict": _STR, "attempts": _INT, "restarts": _INT},
+        {"rollbacks": _INT, "reason": _STR, "wall_s": _NUM},
+    ),
     # counters / memory / profiler ---------------------------------------
     "counters": (
         {"scope": _STR, "counters": _DICT, "durations": _DICT, "waits": _DICT},

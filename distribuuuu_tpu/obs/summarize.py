@@ -136,6 +136,39 @@ def render(records: Iterable[dict]) -> str:
     out("")
     out("faults: " + "  ".join(parts))
 
+    # -- supervision (dtpu-agent) -------------------------------------------
+    # only present for supervised runs (python -m distribuuuu_tpu.agent);
+    # the section is omitted entirely otherwise so unsupervised reports (and
+    # the golden test) are unchanged
+    if by_kind["supervisor_start"] or by_kind["supervisor_verdict"]:
+        out("")
+        n_recover = len(by_kind["supervisor_recovery"])
+        n_pf_fail = sum(1 for r in by_kind["supervisor_preflight"] if not r.get("ok"))
+        exits = [r.get("outcome", "?") for r in by_kind["supervisor_exit"]]
+        line = f"supervision: {len(by_kind['supervisor_launch'])} launch(es)"
+        if exits:
+            line += " -> " + ", ".join(exits)
+        if n_pf_fail:
+            line += f"  (preflight failures: {n_pf_fail})"
+        out(line)
+        for r in by_kind["supervisor_recovery"]:
+            out(
+                f"  attempt {r.get('attempt', '?')}: {r.get('outcome', '?')} -> "
+                f"{r.get('action', '?')}"
+                + (f" (rollback {r['rollback']})" if r.get("rollback") else "")
+                + (f" after {r['backoff_s']:.1f}s backoff" if r.get("backoff_s") else "")
+            )
+        if by_kind["supervisor_verdict"]:
+            v = by_kind["supervisor_verdict"][-1]
+            out(
+                f"  verdict: {v.get('verdict', '?').upper()} after "
+                f"{v.get('attempts', '?')} attempt(s), {v.get('restarts', 0)} "
+                f"restart(s), {v.get('rollbacks', 0)} rollback(s)"
+                + (f" — {v['reason']}" if v.get("reason") else "")
+            )
+        if n_recover == 0 and not by_kind["supervisor_verdict"]:
+            out("  (supervision still in progress)")
+
     # -- checkpoints ---------------------------------------------------------
     saves = [r for r in by_kind["checkpoint"] if r.get("ckpt_kind") != "emergency"]
     if saves or by_kind["restore"]:

@@ -45,6 +45,21 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def token_slot_positions(onehot_e: jnp.ndarray) -> jnp.ndarray:
+    """Per-token position in its chosen expert's send buffer, as **int32**.
+
+    ``onehot_e`` is the float one-hot expert choice ``[n, E]``; the result
+    ``[n]`` is the running count of earlier local tokens that chose the same
+    expert. The cumsum runs over the *cast* int32 one-hot, not the float
+    one: a float32 cumsum stops counting exactly at 2^24 (16.8M — real for
+    long-sequence shards), silently freezing every later token's slot at
+    the same position, so capacity assignment would overwrite slots and
+    corrupt the dispatch without any error. Int32 counts exactly to 2^31.
+    """
+    oh = onehot_e.astype(jnp.int32)
+    return jnp.sum((jnp.cumsum(oh, axis=0) - 1) * oh, axis=-1)
+
+
 def switch_moe(
     x: jnp.ndarray,
     gate_kernel: jnp.ndarray,
@@ -90,10 +105,13 @@ def switch_moe(
 
     onehot_e = jax.nn.one_hot(top, e, dtype=jnp.float32)  # [n, E]
     # position of each token within its expert's send buffer (source-local):
-    # the running count of earlier local tokens that chose the same expert
-    pos = jnp.sum((jnp.cumsum(onehot_e, axis=0) - 1.0) * onehot_e, axis=-1)  # [n]
+    # the running count of earlier local tokens that chose the same expert.
+    # Counted in int32 — a float32 cumsum silently saturates at 2^24 tokens
+    # per expert and would corrupt slot assignment past it (see
+    # token_slot_positions).
+    pos = token_slot_positions(onehot_e)  # [n] int32
     keep = pos < capacity
-    pos_c = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    pos_c = jnp.clip(pos, 0, capacity - 1)
     onehot_c = jax.nn.one_hot(pos_c, capacity, dtype=jnp.float32)  # [n, C]
     # dispatch mask [n, E, C]: token t -> slot (top_t, pos_t), dropped -> 0
     dispatch = onehot_e[:, :, None] * onehot_c[:, None, :] * keep[:, None, None].astype(jnp.float32)

@@ -24,9 +24,19 @@ activation per stage per tick) without hand-managed buffers; wrap
 Constraints (by design, to stay one fused program):
 - uniform activation shape across stage boundaries (true of transformer
   blocks and any residual trunk — the regimes PP is for);
-- every stage runs every tick (inactive ticks compute on garbage and mask
-  the result — on TPU a predictable dense loop beats divergent control
-  flow; the bubble cost is inherent to GPipe, not to this choice).
+- every stage runs every tick. Inactive ticks compute on an explicit
+  **zero activation** (selected *before* ``stage_fn``, see the tick body)
+  and the result is masked after — on TPU a predictable dense loop beats
+  divergent control flow; the bubble cost is inherent to GPipe, not to
+  this choice;
+- therefore ``stage_fn`` must be finite *with a finite Jacobian* at the
+  zero activation: eps-guard any division/normalization (``x /
+  sqrt(mean(x²) + eps)``, not ``x / sqrt(mean(x²))``). The masked tick's
+  cotangent is zero, but `jnp.where` backward computes ``stage_fn``'s VJP
+  at the inactive primal anyway, and ``0 · ∞ = NaN`` would poison the
+  *parameter* gradients of every stage — the exact failure the trainer's
+  non-finite guard would then misread as data poison (skip-loop → abort).
+  Pinned by tests/test_pipeline.py::test_pipeline_division_stage_grads_finite.
 
 Use inside `shard_map` over a mesh with a ``stage`` axis; combine with a
 ``data`` axis by pmean-ing gradients over ``data`` only — stage params
@@ -119,6 +129,16 @@ def pipeline_apply(
         my_input = jnp.where(
             idx == 0, lax.dynamic_index_in_dim(micro, m_safe, keepdims=False), buf
         )
+        # Double-where: the INPUT is selected before stage compute, so every
+        # inactive tick runs stage_fn on an explicit zero activation — never
+        # on whatever the schedule left in buf / the clamped microbatch
+        # index re-read. The outer where already zeroes the masked tick's
+        # cotangent; this inner select is what guarantees stage_fn's VJP is
+        # evaluated at a KNOWN-safe primal, because 0-cotangent times a
+        # non-finite Jacobian is NaN, and that NaN lands in the stage
+        # *parameter* grads (the where/NaN-grad trap — see the module
+        # docstring's zero-input constraint on stage_fn).
+        my_input = jnp.where(active, my_input, jnp.zeros_like(my_input))
         out = stage_fn(stage_params, my_input)
         out = jnp.where(active, out, buf)
         # collect the last stage's finished microbatch before handing off

@@ -344,12 +344,72 @@ def uninstall_preemption_handler() -> None:
 
 
 # ---------------------------------------------------------------------------
-# Distributed watchdog (hang detection)
+# Exit-code taxonomy (the contract between workers and the dtpu-agent
+# supervisor, docs/FAULT_TOLERANCE.md "Supervised runs")
 # ---------------------------------------------------------------------------
 
 # GNU timeout's "command timed out" code: recognizable to supervisors, and
 # distinct from Preempted's 128+signum family.
 HANG_EXIT_CODE = 124
+
+# A worker that aborted on persistent non-finite steps (NonFiniteDivergence:
+# the run has diverged or its input is poisoned) exits with this code so the
+# supervisor can tell "restarting won't help, roll back" from an ordinary
+# crash. Deliberately outside the 125-128 shell-reserved band and the
+# 128+signum family.
+POISON_EXIT_CODE = 117
+
+# Graceful-preemption exits (Preempted): 128+SIGTERM from the scheduler,
+# 128+SIGINT from an operator. Both mean "the run checkpointed and stopped
+# on purpose" — a supervisor restart resumes exactly where it left off.
+PREEMPT_EXIT_CODES = (143, 130)
+
+# classify_exit_code verdicts, in escalation order for the agent's policy.
+EXIT_CLEAN = "clean"
+EXIT_PREEMPTED = "preempted"
+EXIT_HANG = "hang"
+EXIT_POISON = "poison"
+EXIT_KILLED = "killed"
+EXIT_CRASH = "crash"
+
+
+def classify_exit_code(code: int | None) -> str:
+    """Map a worker's ``Popen.returncode`` onto the recovery taxonomy.
+
+    ``None`` (still running / launcher timeout) and negative codes (died to
+    signal ``-code``, e.g. an OOM-kill's SIGKILL) are both hard deaths with
+    no cleanup — `EXIT_KILLED`. Everything unrecognized is `EXIT_CRASH`.
+    """
+    if code == 0:
+        return EXIT_CLEAN
+    if code is None or (isinstance(code, int) and code < 0):
+        return EXIT_KILLED
+    if code == HANG_EXIT_CODE:
+        return EXIT_HANG
+    if code == POISON_EXIT_CODE:
+        return EXIT_POISON
+    if code in PREEMPT_EXIT_CODES:
+        return EXIT_PREEMPTED
+    return EXIT_CRASH
+
+
+def call_with_poison_exit(fn: Callable[[], Any]) -> tuple[int, Any]:
+    """Run ``fn()`` under the worker side of the supervisor contract: a
+    `NonFiniteDivergence` prints the ``POISON:`` marker to stderr and maps
+    to ``(POISON_EXIT_CODE, None)``; anything else returns ``(0, result)``.
+
+    The one place this translation lives — train_net.py, the agent's
+    built-in ``--worker`` mode and the test/scenario workers all route
+    through it, so a taxonomy change cannot silently leave one entry point
+    exiting poison as an ordinary crash (which a supervisor would answer
+    with plain restarts that replay the divergence).
+    """
+    try:
+        result = fn()
+    except NonFiniteDivergence as exc:
+        print(f"POISON: {exc}", file=sys.stderr, flush=True)
+        return POISON_EXIT_CODE, None
+    return 0, result
 
 
 def dump_all_stacks(reason: str = "") -> None:

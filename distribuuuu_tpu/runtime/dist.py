@@ -28,6 +28,7 @@ import faulthandler
 import os
 import re
 import signal
+import socket
 import subprocess
 from dataclasses import dataclass
 
@@ -51,6 +52,39 @@ class DistInfo:
 _DEFAULT_PORT = 29566  # same default as the reference (`utils.py:35`)
 
 _initialized = False  # idempotence guard: jax.distributed.initialize is once-only
+
+
+# ---------------------------------------------------------------------------
+# Agent-owned rendezvous (dtpu-agent supervisor, distribuuuu_tpu/agent.py)
+# ---------------------------------------------------------------------------
+
+def port_is_free(port: int, host: str = "127.0.0.1") -> bool:
+    """Can the coordinator bind this rendezvous port right now?
+
+    The agent's preflight gate calls this before every (re)launch: a stale
+    worker from the previous attempt still holding the port would make every
+    relaunched rank fail its rendezvous, burning a whole restart out of the
+    budget on an avoidable bind error.
+    """
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            s.bind((host, int(port)))
+            return True
+        except OSError:
+            return False
+
+
+def pick_rendezvous_port() -> int:
+    """A currently-free ephemeral port for an agent-owned fleet rendezvous.
+
+    Best-effort by construction (the probe socket is released before the
+    coordinator binds), which is why `port_is_free` re-checks in the
+    preflight gate immediately before each launch.
+    """
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 
 def _first_slurm_hostname(nodelist: str) -> str:

@@ -733,6 +733,16 @@ def train_model():
     start_epoch, start_step, best_acc1 = 0, 0, 0.0
     resumed = False
     if cfg.TRAIN.AUTO_RESUME:
+        # rollback depth: the dtpu-agent's poison escalation rides the env
+        # var (it supervises arbitrary worker commands and never edits
+        # YAMLs); a hand-set RESUME.ROLLBACK works the same way
+        rollback = int(os.environ.get("DTPU_RESUME_ROLLBACK", cfg.RESUME.ROLLBACK))
+        if rollback > 0:
+            logger.warning(
+                f"Auto-resume with rollback depth {rollback}: the "
+                f"{rollback} most-advanced known-good checkpoint(s) will be "
+                f"skipped (poison escalation)"
+            )
         res = ckpt.restore_latest(
             cfg.OUT_DIR,
             state,
@@ -740,6 +750,7 @@ def train_model():
             skip_corrupt=cfg.RESUME.SKIP_CORRUPT,
             verify_integrity=cfg.RESUME.VERIFY_INTEGRITY,
             samples_per_step=samples_per_step,
+            rollback=rollback,
         )
         if res is not None:
             state, start_epoch, start_step, best_acc1, rng_key, path = res

@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""Supervised training launcher — thin wrapper over `distribuuuu_tpu.agent`.
+
+    python scripts/dtpu_agent.py --cfg config/resnet50.yaml [KEY VALUE ...]
+
+Identical to ``python -m distribuuuu_tpu.agent`` (and the ``dtpu-agent``
+console script); exists so repo checkouts without an installed package get
+the same one-liner as train_net.py. See docs/FAULT_TOLERANCE.md
+"Supervised runs" for the recovery policy.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distribuuuu_tpu.agent import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -4,7 +4,7 @@ fault-tolerance layer, docs/FAULT_TOLERANCE.md), outside pytest with the
 phases spelled out and timed so a failing resume can be bisected
 interactively.
 
-    python scripts/run_resilience_check.py [--scenario basic|elastic|corrupt|all]
+    python scripts/run_resilience_check.py [--scenario basic|elastic|corrupt|supervised|all]
 
 Scenarios:
 
@@ -20,6 +20,12 @@ Scenarios:
 - **corrupt** (tests/test_integrity.py): byte-flip the newest checkpoint;
   restore_latest must quarantine it (corrupt_*) and fall back to the
   previous one.
+- **supervised** (tests/test_agent.py chaos tier): run the same tiny recipe
+  under `python -m distribuuuu_tpu.agent` with an injected SIGKILL
+  mid-epoch-1; the agent must auto-restart into elastic resume (no human
+  input), finish bitwise-identical to an uninterrupted run, and journal the
+  whole story as ``supervisor_*`` records. (This scenario re-execs this
+  script with ``--worker`` as the supervised rank command.)
 
 Exit code 0 iff every requested scenario passes. Self-pins to a virtual
 8-device CPU mesh (cpu_mesh_run-style bootstrap), so it runs anywhere.
@@ -27,6 +33,7 @@ Exit code 0 iff every requested scenario passes. Self-pins to a virtual
 
 import argparse
 import os
+import re
 import shutil
 import sys
 import tempfile
@@ -226,21 +233,101 @@ def check_corrupt(scratch: str, epochs: int) -> bool:
     return False
 
 
+def _params_digest(state) -> str:
+    import hashlib
+
+    digest = hashlib.sha256()
+    for leaf in leaves(state):
+        digest.update(np.ascontiguousarray(leaf).tobytes())
+    return digest.hexdigest()
+
+
+def worker_main(out_dir: str, epochs: int) -> int:
+    """Supervised-rank mode (`--worker`): the command the supervised scenario
+    hands to `AGENT.CMD`. Runs the tiny recipe under the full exit-code
+    taxonomy and prints the params digest the parent compares bitwise."""
+    configure(out_dir, epochs)
+    code, result = resilience.call_with_poison_exit(trainer.train_model)
+    if code:
+        return code
+    state, _ = result
+    print(f"SUPERVISED DIGEST {_params_digest(state)}", flush=True)
+    return 0
+
+
+def check_supervised(scratch: str, epochs: int) -> bool:
+    """Supervised recovery (tests/test_agent.py chaos tier, interactively):
+    inject a SIGKILL mid-epoch-1 under `python -m distribuuuu_tpu.agent`; the
+    agent must classify the death, restart into auto-resume with the
+    injection disarmed, finish bitwise-identical to an uninterrupted run,
+    and journal the whole story as ``supervisor_*`` records."""
+    import subprocess
+
+    from distribuuuu_tpu import obs
+
+    t0 = time.time()
+    out_ref = os.path.join(scratch, "sup_ref")
+    configure(out_ref, epochs)
+    state_ref, _ = trainer.train_model()
+    ref_digest = _params_digest(state_ref)
+    print(f"[1/2] uninterrupted reference done in {time.time() - t0:.1f}s")
+
+    out_sup = os.path.join(scratch, "sup")
+    steps_per_epoch = 4  # 64 dummy samples / (batch 2 x 8 devices)
+    env = dict(os.environ)
+    env["DTPU_FAULT_KILL_STEP"] = str(steps_per_epoch + 2)  # mid epoch 1
+    t0 = time.time()
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "distribuuuu_tpu.agent",
+            "OUT_DIR", out_sup,
+            "AGENT.CMD",
+            f"{sys.executable} {os.path.abspath(__file__)} --worker {out_sup} "
+            f"--epochs {epochs}",
+            "AGENT.PREFLIGHT_DEVICE_PROBE", "False",
+            "AGENT.BACKOFF_BASE_S", "0.05",
+            "AGENT.BACKOFF_MAX_S", "0.2",
+        ],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    recs = list(obs.read_journal(os.path.join(out_sup, "telemetry.jsonl")))
+    recoveries = [r for r in recs if r.get("kind") == "supervisor_recovery"]
+    verdicts = [r for r in recs if r.get("kind") == "supervisor_verdict"]
+    m = re.search(r"SUPERVISED DIGEST (\w+)", proc.stdout)
+    clean = bool(verdicts) and verdicts[-1].get("verdict") == "clean"
+    bitwise = bool(m) and m.group(1) == ref_digest
+    print(f"[2/2] agent rc={proc.returncode} in {time.time() - t0:.1f}s; "
+          f"{len(recoveries)} recovery record(s); "
+          f"verdict={verdicts[-1].get('verdict') if verdicts else 'MISSING'}; "
+          f"bitwise={bitwise}")
+    if proc.returncode == 0 and recoveries and clean and bitwise:
+        print("PASS supervised: injected kill -> automatic restart -> "
+              "bitwise-identical finish")
+        return True
+    print(f"FAIL supervised; agent tail:\n{proc.stdout[-2000:]}{proc.stderr[-2000:]}")
+    return False
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--scenario", choices=("basic", "elastic", "corrupt", "all"),
+    ap.add_argument("--scenario",
+                    choices=("basic", "elastic", "corrupt", "supervised", "all"),
                     default="basic")
     ap.add_argument("--preempt-step", type=int, default=5,
                     help="global step to inject the simulated SIGTERM before (basic)")
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--keep", action="store_true", help="keep scratch OUT_DIRs")
+    ap.add_argument("--worker", metavar="OUT_DIR", help=argparse.SUPPRESS)
     args = ap.parse_args()
+    if args.worker:
+        return worker_main(args.worker, args.epochs)
 
     scratch = tempfile.mkdtemp(prefix="dtpu_resilience_check_")
     checks = {
         "basic": lambda: check_basic(scratch, args.preempt_step, args.epochs),
         "elastic": lambda: check_elastic(scratch, args.epochs),
         "corrupt": lambda: check_corrupt(scratch, args.epochs),
+        "supervised": lambda: check_supervised(scratch, args.epochs),
     }
     selected = list(checks) if args.scenario == "all" else [args.scenario]
     rc = 0

@@ -172,6 +172,23 @@ def main():
     if args.golden and os.path.exists(args.golden):
         with open(args.golden) as f:
             gold = json.load(f)
+        # provenance gate BEFORE comparing logits: re-checking resnet50
+        # against a resnet18 golden (or a golden written with different
+        # fixed inputs) would fail as an opaque "max|Δlogit| huge" — or,
+        # worse, pass by luck on a coarse tolerance. Fail with the story.
+        mismatches = [
+            f"{field}: golden has {gold.get(field)!r}, this run uses {want!r}"
+            for field, want in (("arch", args.arch), ("input_seed", 0), ("n", 8))
+            if gold.get(field) != want
+        ]
+        if mismatches:
+            print(
+                f"golden check: {args.golden} does not describe this check "
+                f"({'; '.join(mismatches)}). Re-write the golden with "
+                f"--arch {gold.get('arch', args.arch)} (where torchvision is "
+                f"importable) or point --golden at the right file."
+            )
+            sys.exit(2)
         ref = np.asarray(gold["logits"], dtype=np.float32)
         diff = float(np.max(np.abs(ours - ref)))
         print(f"golden check: max|Δlogit| = {diff:.3e} (tol {args.tol})")

@@ -1,0 +1,107 @@
+"""Supervised-rank worker for the dtpu-agent chaos tests (tests/test_agent.py)
+— NOT a pytest module.
+
+Runs a tiny DUMMY_INPUT `train_model` under the dtpu-agent's worker contract
+(distribuuuu_tpu/agent.py): rendezvous and recovery state arrive via env,
+never argv — RANK/WORLD_SIZE/MASTER_ADDR/MASTER_PORT when the agent runs a
+multi-process fleet, XLA_FLAGS from AGENT.CPU_DEVICES_PER_WORKER,
+DTPU_RESUME_ROLLBACK consumed by the trainer's auto-resume, DTPU_FAULT_*
+chaos injections inherited from the launch (and disarmed by the agent on
+restart). Exits under the full `resilience` taxonomy: 0 clean, 124 hang
+(in-process watchdog), 143/130 preemption (Preempted is a SystemExit),
+`POISON_EXIT_CODE` on NonFiniteDivergence — the codes the agent's recovery
+policy dispatches on.
+
+argv: out_dir max_epoch
+env:  DTPU_TEST_HANG_TIMEOUT_S   -> cfg.FAULT.HANG_TIMEOUT_S (default 0: off)
+      DTPU_TEST_MAX_CONSEC_SKIPS -> cfg.FAULT.MAX_CONSECUTIVE_SKIPS
+      DTPU_FAULT_*               -> FaultInjector modes (see resilience.py)
+
+Prints ``AGENT DIGEST <sha256>`` of the final params on a clean finish —
+the bitwise-recovery oracle for the tests.
+"""
+
+import hashlib
+import os
+import sys
+
+out_dir, max_epoch = sys.argv[1:3]
+
+# XLA_FLAGS belongs to the agent (AGENT.CPU_DEVICES_PER_WORKER); default to
+# a single-device host only when nothing set it, so direct invocation works.
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=1"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distribuuuu_tpu.runtime.compile_cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+
+import flax.linen as nn  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from distribuuuu_tpu import config, resilience, trainer  # noqa: E402
+from distribuuuu_tpu.models import list_models, register_model  # noqa: E402
+
+if "agent_tiny" not in list_models():
+
+    class _AgentTiny(nn.Module):
+        num_classes: int = 4
+
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = nn.Conv(4, (3, 3), use_bias=False, dtype=jnp.float32)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            return nn.Dense(self.num_classes)(nn.relu(x).mean(axis=(1, 2)))
+
+    @register_model("agent_tiny")
+    def agent_tiny(num_classes, dtype, bn_axis_name=None, remat=False):
+        return _AgentTiny(num_classes=num_classes)
+
+
+def main() -> int:
+    world = int(os.environ.get("WORLD_SIZE", "1"))
+    c = config.cfg
+    c.MODEL.ARCH = "agent_tiny"
+    c.MODEL.NUM_CLASSES = 4
+    c.MODEL.DTYPE = "float32"
+    c.MODEL.DUMMY_INPUT = True
+    c.TRAIN.BATCH_SIZE = 4 // world  # global batch 4 at any fleet size
+    c.TRAIN.IM_SIZE = 8
+    c.TEST.IM_SIZE = 8
+    c.TEST.CROP_SIZE = 8
+    c.TEST.BATCH_SIZE = 4 // world
+    c.TRAIN.DUMMY_EPOCH_SAMPLES = 64  # 16 steps/epoch at global batch 4
+    c.TRAIN.PRINT_FREQ = 1
+    c.OPTIM.MAX_EPOCH = int(max_epoch)
+    c.OPTIM.WARMUP_EPOCHS = 0
+    c.RNG_SEED = 5
+    c.FAULT.HANG_TIMEOUT_S = float(os.environ.get("DTPU_TEST_HANG_TIMEOUT_S", "0"))
+    c.FAULT.MAX_CONSECUTIVE_SKIPS = int(
+        os.environ.get("DTPU_TEST_MAX_CONSEC_SKIPS", c.FAULT.MAX_CONSECUTIVE_SKIPS)
+    )
+    c.FAULT.HANDLE_SIGNALS = True  # the agent forwards SIGTERM on preemption
+    c.OUT_DIR = out_dir
+
+    code, result = resilience.call_with_poison_exit(trainer.train_model)
+    if code:
+        return code
+    state, best = result
+    digest = hashlib.sha256()
+    for leaf in jax.tree.leaves(jax.device_get(state.params)):
+        digest.update(np.ascontiguousarray(leaf).tobytes())
+    print(f"AGENT DIGEST {digest.hexdigest()}", flush=True)
+    print(f"AGENT OK rank={os.environ.get('RANK', '0')} best={best:.4f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,529 @@
+"""dtpu-agent supervision tests (docs/FAULT_TOLERANCE.md "Supervised runs").
+
+Three tiers:
+
+- **unit**: the recovery-policy pieces — exit-code taxonomy, fleet outcome
+  merge, sliding-window restart budget, jittered backoff, preflight gate,
+  rollback target selection — are pure host-side logic, tested in-process.
+- **CLI**: ``python -m distribuuuu_tpu.agent`` supervising trivial shell
+  workers: restart-on-crash, budget exhaustion, poison rollback escalation,
+  preflight-failure accounting and the journal-heartbeat kill, each asserted
+  against the typed ``supervisor_*`` journal stream.
+- **chaos** (slow, ``chaos`` marker; CI's supervisor-smoke job): supervised
+  real training fleets (tests/_agent_worker.py) with injected SIGKILL /
+  hang / persistent-NaN faults — the acceptance scenarios: automatic
+  recovery with a **bitwise-identical** post-restart step stream, and
+  poison → rollback-to-older-checkpoint → bounded give-up.
+"""
+
+import os
+import random
+import re
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from distribuuuu_tpu import agent, resilience
+from distribuuuu_tpu.obs.journal import read_journal, validate_journal
+from distribuuuu_tpu.runtime.dist import pick_rendezvous_port, port_is_free
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_agent_worker.py")
+
+
+# ---------------------------------------------------------------------------
+# Unit tier: recovery-policy pieces
+# ---------------------------------------------------------------------------
+
+def test_classify_exit_code_taxonomy():
+    c = resilience.classify_exit_code
+    assert c(0) == resilience.EXIT_CLEAN
+    assert c(resilience.HANG_EXIT_CODE) == resilience.EXIT_HANG
+    assert c(resilience.POISON_EXIT_CODE) == resilience.EXIT_POISON
+    assert c(143) == resilience.EXIT_PREEMPTED  # 128+SIGTERM (scheduler)
+    assert c(130) == resilience.EXIT_PREEMPTED  # 128+SIGINT (operator)
+    assert c(None) == resilience.EXIT_KILLED    # still running / wait timeout
+    assert c(-9) == resilience.EXIT_KILLED      # died to SIGKILL (OOM killer)
+    assert c(1) == resilience.EXIT_CRASH
+    assert c(77) == resilience.EXIT_CRASH
+
+
+def test_merge_outcomes_most_actionable_wins():
+    m = agent.merge_outcomes
+    assert m([0, 0]) == resilience.EXIT_CLEAN
+    # a SIGKILL'd rank is the root cause; the survivor's watchdog 124 is the
+    # symptom — the merged outcome must say "killed"
+    assert m([-9, resilience.HANG_EXIT_CODE]) == resilience.EXIT_KILLED
+    assert m([resilience.POISON_EXIT_CODE, resilience.HANG_EXIT_CODE]) == (
+        resilience.EXIT_POISON
+    )
+    assert m([1, resilience.HANG_EXIT_CODE]) == resilience.EXIT_CRASH
+    assert m([143, 0]) == resilience.EXIT_PREEMPTED
+    assert m([resilience.HANG_EXIT_CODE]) == resilience.EXIT_HANG
+
+
+def test_restart_budget_window_ages_out():
+    now = [0.0]
+    b = agent.RestartBudget(2, 100.0, clock=lambda: now[0])
+    assert b.try_spend()
+    now[0] = 50.0
+    assert b.try_spend()
+    assert not b.try_spend()  # 2 restarts inside the window: exhausted
+    now[0] = 101.0  # the t=0 spend ages out, the t=50 one remains
+    assert b.in_window() == 1
+    assert b.try_spend()
+    assert not b.try_spend()
+
+
+def test_backoff_delay_full_jitter_bounds():
+    rng = random.Random(3)
+    for n in range(8):
+        for _ in range(20):
+            d = agent.backoff_delay(n, 1.0, 8.0, rng)
+            assert 0.0 <= d <= min(8.0, 2.0**n)
+    # deterministic given the rng: two identical supervisions log identical
+    # backoff schedules
+    seq = [agent.backoff_delay(n, 1.0, 8.0, random.Random(7)) for n in range(4)]
+    assert seq == [agent.backoff_delay(n, 1.0, 8.0, random.Random(7)) for n in range(4)]
+
+
+def test_preflight_gate_passes_on_healthy_host(tmp_path):
+    ok, failures, checks = agent.preflight_checks(
+        str(tmp_path), rollback=0, port=None, min_free_disk_gb=0.001,
+        device_probe=False, device_probe_timeout_s=5.0,
+    )
+    assert ok and not failures, (failures, checks)
+    assert checks["resume_target"] == "fresh"
+    assert checks["resume_target_status"] == "fresh"
+    assert checks["free_disk_gb"] > 0
+
+
+def test_preflight_free_disk_threshold_fails(tmp_path):
+    ok, failures, checks = agent.preflight_checks(
+        str(tmp_path), rollback=0, port=None, min_free_disk_gb=10**9,
+        device_probe=False, device_probe_timeout_s=5.0,
+    )
+    assert not ok and failures == ["free_disk"]
+
+
+def test_preflight_rendezvous_port_liveness(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        s.listen(1)
+        port = s.getsockname()[1]
+        assert not port_is_free(port)
+        ok, failures, _ = agent.preflight_checks(
+            str(tmp_path), rollback=0, port=port, min_free_disk_gb=0,
+            device_probe=False, device_probe_timeout_s=5.0,
+        )
+        assert not ok and failures == ["rendezvous_port"]
+    assert port_is_free(port)  # released: the same check passes now
+    assert port_is_free(pick_rendezvous_port())
+
+
+def test_preflight_exhausted_history_fails_resume_target(monkeypatch, tmp_path):
+    """'exhausted' (candidates existed but none survived — all corrupt, or
+    rollback past the end of history) must FAIL the gate: restarting into a
+    silent from-scratch run would discard the run's progress."""
+    monkeypatch.setattr(
+        agent, "verify_resume_target", lambda out_dir, rollback: (None, "exhausted")
+    )
+    ok, failures, checks = agent.preflight_checks(
+        str(tmp_path), rollback=0, port=None, min_free_disk_gb=0,
+        device_probe=False, device_probe_timeout_s=5.0,
+    )
+    assert not ok and failures == ["resume_target"]
+    assert checks["resume_target_status"] == "exhausted"
+
+
+def test_preflight_device_probe_subprocess(tmp_path):
+    """The probe runs in a throwaway subprocess (backend init must not claim
+    the workers' accelerators) and sees >= 1 device on this CPU host."""
+    ok, failures, checks = agent.preflight_checks(
+        str(tmp_path), rollback=0, port=None, min_free_disk_gb=0,
+        device_probe=True, device_probe_timeout_s=120.0,
+        probe_env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert ok and not failures, (failures, checks)
+    assert checks["devices"] >= 1
+
+
+def test_verify_resume_target_rollback_and_exhaustion(monkeypatch, tmp_path):
+    import distribuuuu_tpu.checkpoint as ckpt
+
+    cands = [
+        ((2, 0, 1), "epoch", "/c2"),
+        ((1, 0, 1), "epoch", "/c1"),
+        ((0, 0, 1), "epoch", "/c0"),
+    ]
+    statuses = {"/c2": ("corrupt", ["payload: sha256 mismatch"]),
+                "/c1": ("ok", []), "/c0": ("unverified", [])}
+    quarantined = []
+    monkeypatch.setattr(ckpt, "resume_candidates", lambda out_dir, **kw: list(cands))
+    monkeypatch.setattr(ckpt, "verify_checkpoint", lambda p: statuses[p])
+    monkeypatch.setattr(ckpt, "quarantine_checkpoint",
+                        lambda p, errs: quarantined.append(p))
+    out = str(tmp_path)
+    # corrupt newest is quarantined at preflight and does NOT spend rollback
+    assert agent.verify_resume_target(out, 0) == ("/c1", "ok")
+    assert quarantined == ["/c2"]
+    # rollback 1 skips the most-advanced KNOWN-GOOD candidate
+    assert agent.verify_resume_target(out, 1) == ("/c0", "unverified")
+    # deeper than history: the poison escalation has run out of checkpoints
+    assert agent.verify_resume_target(out, 2) == (None, "exhausted")
+    monkeypatch.setattr(ckpt, "resume_candidates", lambda out_dir, **kw: [])
+    assert agent.verify_resume_target(out, 0) == (None, "fresh")
+
+
+def test_supervisor_journal_typed_records(tmp_path):
+    sj = agent.SupervisorJournal(str(tmp_path))
+    sj.event("supervisor_start", nprocs=1, max_restarts=3)
+    sj.event("supervisor_exit", attempt=1)  # missing required keys: dropped
+    sj.event("supervisor_verdict", verdict="clean", attempts=1, restarts=0)
+    sj.close()
+    assert validate_journal(sj.path) == []
+    kinds = [r["kind"] for r in read_journal(sj.path)]
+    assert kinds == ["supervisor_start", "supervisor_verdict"]
+
+
+def test_default_worker_cmd_and_env(tmp_path, fresh_cfg):
+    """The built-in worker re-execs the agent's own argv under --worker;
+    rendezvous + recovery state ride env vars, never argv; chaos injections
+    are disarmed on restarts (but NOT data poison, which must replay)."""
+    fresh_cfg.OUT_DIR = str(tmp_path)
+    fresh_cfg.AGENT.NPROCS = 2
+    fresh_cfg.AGENT.CPU_DEVICES_PER_WORKER = 4
+    ag = agent.Agent(["--cfg", "x.yaml", "RNG_SEED", "9"])
+    assert ag._worker_cmd() == [
+        sys.executable, "-m", "distribuuuu_tpu.agent", "--worker",
+        "--cfg", "x.yaml", "RNG_SEED", "9",
+    ]
+    env = ag._worker_env(1, 2, 3, 29500)
+    assert env["RANK"] == "1" and env["WORLD_SIZE"] == "2"
+    assert env["MASTER_ADDR"] == "127.0.0.1" and env["MASTER_PORT"] == "29500"
+    assert env["DTPU_AGENT_ATTEMPT"] == "2" and env["DTPU_RESUME_ROLLBACK"] == "3"
+    # attempt 2: machine-fault injections disarmed, data poison left alone
+    assert env["DTPU_FAULT_KILL_STEP"] == "-1"
+    assert env["DTPU_FAULT_HANG_STEP"] == "-1"
+    assert "DTPU_FAULT_NAN_STEPS" not in env
+    # the conftest 8-device flag is REPLACED, not stacked
+    assert env["XLA_FLAGS"].count("xla_force_host_platform_device_count") == 1
+    assert "--xla_force_host_platform_device_count=4" in env["XLA_FLAGS"]
+    ag.journal.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI tier: the supervision loop over trivial shell workers
+# ---------------------------------------------------------------------------
+
+def _run_agent_cli(out_dir, overrides, env_extra=None, timeout=180):
+    cmd = [
+        sys.executable, "-m", "distribuuuu_tpu.agent",
+        "OUT_DIR", str(out_dir),
+        "AGENT.PREFLIGHT_DEVICE_PROBE", "False",
+        "AGENT.MIN_FREE_DISK_GB", "0",
+        "AGENT.BACKOFF_BASE_S", "0.01",
+        "AGENT.BACKOFF_MAX_S", "0.05",
+        *[str(x) for x in overrides],
+    ]
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    return subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+def _journal(out_dir):
+    return list(read_journal(os.path.join(str(out_dir), "telemetry.jsonl")))
+
+
+def _by_kind(records, kind):
+    return [r for r in records if r.get("kind") == kind]
+
+
+def test_agent_cli_restarts_transient_crash_then_finishes(tmp_path):
+    flag = tmp_path / "flag"
+    p = _run_agent_cli(tmp_path, [
+        "AGENT.CMD", f"sh -c 'test -f {flag} && exit 0; touch {flag}; exit 7'",
+    ])
+    assert p.returncode == 0, p.stdout + p.stderr
+    recs = _journal(tmp_path)
+    assert validate_journal(os.path.join(str(tmp_path), "telemetry.jsonl")) == []
+    assert [r["outcome"] for r in _by_kind(recs, "supervisor_exit")] == [
+        resilience.EXIT_CRASH, resilience.EXIT_CLEAN,
+    ]
+    (rec,) = _by_kind(recs, "supervisor_recovery")
+    assert rec["action"] == "restart" and rec["outcome"] == resilience.EXIT_CRASH
+    assert rec["restarts_in_window"] == 1
+    (verdict,) = _by_kind(recs, "supervisor_verdict")
+    assert verdict["verdict"] == "clean" and verdict["attempts"] == 2
+    assert verdict["restarts"] == 1 and verdict["rollbacks"] == 0
+    # every preflight passed and was journaled
+    assert [r["ok"] for r in _by_kind(recs, "supervisor_preflight")] == [True, True]
+
+
+def test_agent_cli_crash_loop_exhausts_budget(tmp_path):
+    p = _run_agent_cli(tmp_path, [
+        "AGENT.CMD", "sh -c 'exit 3'", "AGENT.MAX_RESTARTS", "2",
+    ])
+    assert p.returncode == 1, p.stdout + p.stderr
+    recs = _journal(tmp_path)
+    assert len(_by_kind(recs, "supervisor_launch")) == 3  # 1 + 2 restarts
+    (verdict,) = _by_kind(recs, "supervisor_verdict")
+    assert verdict["verdict"] == "gave_up" and verdict["attempts"] == 3
+    assert "crash loop" in verdict["reason"]
+
+
+def test_agent_cli_poison_escalates_rollback_then_gives_up(tmp_path):
+    p = _run_agent_cli(tmp_path, [
+        "AGENT.CMD", f"sh -c 'exit {resilience.POISON_EXIT_CODE}'",
+        "AGENT.MAX_ROLLBACKS", "1",
+    ])
+    assert p.returncode == 1, p.stdout + p.stderr
+    recs = _journal(tmp_path)
+    assert [r["outcome"] for r in _by_kind(recs, "supervisor_exit")] == [
+        resilience.EXIT_POISON, resilience.EXIT_POISON,
+    ]
+    (rec,) = _by_kind(recs, "supervisor_recovery")
+    assert rec["action"] == "rollback" and rec["rollback"] == 1
+    # the relaunch carried the deeper resume rollback
+    assert [r["rollback"] for r in _by_kind(recs, "supervisor_launch")] == [0, 1]
+    (verdict,) = _by_kind(recs, "supervisor_verdict")
+    assert verdict["verdict"] == "gave_up" and verdict["rollbacks"] == 2
+    assert "poison persisted" in verdict["reason"]
+
+
+def test_agent_cli_unlaunchable_cmd_ends_in_verdict(tmp_path):
+    """A worker command that cannot even spawn (typo'd interpreter) must end
+    in a typed gave_up verdict via the restart budget — never an unwound
+    supervisor traceback with a truncated journal."""
+    p = _run_agent_cli(tmp_path, [
+        "AGENT.CMD", "dtpu_no_such_binary_xyz --flag", "AGENT.MAX_RESTARTS", "1",
+    ])
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "Traceback" not in p.stderr
+    recs = _journal(tmp_path)
+    assert not _by_kind(recs, "supervisor_launch")  # nothing ever spawned
+    assert [r["outcome"] for r in _by_kind(recs, "supervisor_recovery")] == [
+        "launch_failed",
+    ]
+    (verdict,) = _by_kind(recs, "supervisor_verdict")
+    assert verdict["verdict"] == "gave_up" and "launch" in verdict["reason"]
+
+
+def test_agent_cli_sigterm_mid_backoff_exits_preempted(tmp_path):
+    """SIGTERM delivered between fleets (the crashed worker's backoff wait)
+    must NOT launch another fleet: the agent exits 128+SIGTERM with a
+    'preempted' verdict, like an ordinary preempted job."""
+    import signal as _signal
+
+    cmd = [
+        sys.executable, "-m", "distribuuuu_tpu.agent",
+        "OUT_DIR", str(tmp_path),
+        "AGENT.PREFLIGHT_DEVICE_PROBE", "False",
+        "AGENT.MIN_FREE_DISK_GB", "0",
+        "AGENT.CMD", "sh -c 'exit 3'",
+        "AGENT.BACKOFF_BASE_S", "30",  # park the loop in the backoff wait
+        "AGENT.BACKOFF_MAX_S", "30",
+    ]
+    proc = subprocess.Popen(cmd, cwd=REPO, env=dict(os.environ),
+                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                            text=True)
+    deadline = time.time() + 60
+    while time.time() < deadline:  # wait for the first crash to be journaled
+        try:
+            if any(r.get("kind") == "supervisor_recovery"
+                   for r in _journal(tmp_path)):
+                break
+        except FileNotFoundError:  # agent hasn't opened the journal yet
+            pass
+        time.sleep(0.2)
+    proc.send_signal(_signal.SIGTERM)
+    out, _ = proc.communicate(timeout=60)
+    assert proc.returncode == 128 + _signal.SIGTERM, out
+    recs = _journal(tmp_path)
+    assert len(_by_kind(recs, "supervisor_launch")) == 1  # no second fleet
+    (verdict,) = _by_kind(recs, "supervisor_verdict")
+    assert verdict["verdict"] == "preempted"
+
+
+def test_agent_cli_preflight_failure_spends_budget(tmp_path):
+    p = _run_agent_cli(tmp_path, [
+        "AGENT.CMD", "sh -c 'exit 0'",
+        "AGENT.MIN_FREE_DISK_GB", str(10**9),
+        "AGENT.MAX_RESTARTS", "1",
+    ])
+    assert p.returncode == 1, p.stdout + p.stderr
+    recs = _journal(tmp_path)
+    assert not _by_kind(recs, "supervisor_launch")  # gate never opened
+    pf = _by_kind(recs, "supervisor_preflight")
+    assert pf and all(not r["ok"] and "free_disk" in r["failures"] for r in pf)
+    (rec,) = _by_kind(recs, "supervisor_recovery")
+    assert rec["outcome"] == "preflight_failed"
+    (verdict,) = _by_kind(recs, "supervisor_verdict")
+    assert verdict["verdict"] == "gave_up" and "preflight" in verdict["reason"]
+
+
+def test_agent_cli_heartbeat_kills_wedged_fleet(tmp_path):
+    """A fleet whose journal stops growing is killed (SIGUSR2 diagnose →
+    grace → SIGKILL), classified as a hang, and restarted — the supervisor-
+    side backstop for a worker wedged beyond its own watchdog's reach."""
+    tic = time.time()
+    p = _run_agent_cli(tmp_path, [
+        "AGENT.CMD", "sleep 600",
+        "AGENT.HEARTBEAT_TIMEOUT_S", "1.0",
+        "AGENT.MAX_RESTARTS", "1",
+    ], timeout=120)
+    wall = time.time() - tic
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert wall < 90, f"heartbeat kill not bounded: {wall:.0f}s"
+    recs = _journal(tmp_path)
+    exits = _by_kind(recs, "supervisor_exit")
+    assert exits and all(r["outcome"] == resilience.EXIT_HANG for r in exits)
+    assert any(r.get("heartbeat_kill") for r in exits)
+    (verdict,) = _by_kind(recs, "supervisor_verdict")
+    assert verdict["verdict"] == "gave_up"
+
+
+# ---------------------------------------------------------------------------
+# Chaos tier: supervised real training fleets (the acceptance scenarios)
+# ---------------------------------------------------------------------------
+
+def _chaos_env(extra=None):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)  # the agent pins the per-worker device count
+    for k in ("DTPU_FAULT_KILL_STEP", "DTPU_FAULT_HANG_STEP",
+              "DTPU_FAULT_NAN_STEPS", "DTPU_TEST_HANG_TIMEOUT_S",
+              "DTPU_TEST_MAX_CONSEC_SKIPS", "DTPU_RESUME_ROLLBACK"):
+        env.pop(k, None)
+    env.update(extra or {})
+    return env
+
+
+def _run_supervised(out_dir, nprocs, max_epoch, env_extra=None, overrides=(),
+                    timeout=420):
+    cmd = [
+        sys.executable, "-m", "distribuuuu_tpu.agent",
+        "OUT_DIR", str(out_dir),
+        "AGENT.NPROCS", str(nprocs),
+        "AGENT.CMD", f"{sys.executable} {WORKER} {out_dir} {max_epoch}",
+        "AGENT.CPU_DEVICES_PER_WORKER", "1",
+        "AGENT.PREFLIGHT_DEVICE_PROBE", "False",
+        "AGENT.BACKOFF_BASE_S", "0.05",
+        "AGENT.BACKOFF_MAX_S", "0.2",
+        "AGENT.EXIT_BARRIER_S", "45",
+        *[str(x) for x in overrides],
+    ]
+    return subprocess.run(cmd, cwd=REPO, env=_chaos_env(env_extra),
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def _digests(stdout):
+    return set(re.findall(r"AGENT DIGEST (\w+)", stdout))
+
+
+def _final_window_losses(out_dir):
+    """gstep -> loss from the LAST window record per gstep (a recovered run
+    replays steps; the final value is the one the run trained on)."""
+    out = {}
+    for r in read_journal(os.path.join(str(out_dir), "telemetry.jsonl")):
+        if r.get("kind") == "window" and r.get("loss") is not None:
+            out[r["gstep"]] = r["loss"]
+    return out
+
+
+@pytest.fixture(scope="module")
+def supervised_reference(tmp_path_factory):
+    """Uninterrupted supervised 2-proc run: the bitwise oracle for the
+    kill/hang recovery tests (identical recipe, no injections)."""
+    out = tmp_path_factory.mktemp("agent_ref") / "out"
+    p = _run_supervised(out, nprocs=2, max_epoch=2)
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-3000:]
+    digests = _digests(p.stdout)
+    assert len(digests) == 1, f"ranks disagree on final params: {digests}"
+    losses = _final_window_losses(out)
+    assert sorted(losses) == list(range(32)), sorted(losses)  # 2 ep x 16 steps
+    return {"digest": digests, "losses": losses}
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_supervised_kill_recovery_is_bitwise(supervised_reference, tmp_path):
+    """FAULT.INJECT_KILL_STEP under supervision: the fleet hard-dies at
+    gstep 20, the agent classifies, backs off, disarms the injection,
+    relaunches into elastic resume — and the recovered run's step stream and
+    final params are bitwise identical to the uninterrupted reference."""
+    out = tmp_path / "out"
+    p = _run_supervised(out, nprocs=2, max_epoch=2, env_extra={
+        "DTPU_FAULT_KILL_STEP": "20",       # epoch 1, step 4: ep-0 ckpt durable
+        "DTPU_TEST_HANG_TIMEOUT_S": "12",   # a surviving rank dies loudly too
+    })
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-3000:]
+    recs = _journal(out)
+    outcomes = [r["outcome"] for r in _by_kind(recs, "supervisor_exit")]
+    assert outcomes[0] in (resilience.EXIT_KILLED, resilience.EXIT_HANG), outcomes
+    assert outcomes[-1] == resilience.EXIT_CLEAN
+    assert any(r["action"] == "restart" for r in _by_kind(recs, "supervisor_recovery"))
+    (verdict,) = _by_kind(recs, "supervisor_verdict")
+    assert verdict["verdict"] == "clean" and verdict["restarts"] >= 1
+    # bitwise: same final params, same per-step loss stream as the reference
+    assert _digests(p.stdout) == supervised_reference["digest"]
+    assert _final_window_losses(out) == supervised_reference["losses"]
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_supervised_hang_recovery_is_bitwise(supervised_reference, tmp_path):
+    """FAULT.INJECT_HANG_STEP under supervision: the stalled fleet exits via
+    its in-process watchdogs (124), the agent relaunches immediately (no
+    backoff — the run stopped at a durable point), and recovery is bitwise."""
+    out = tmp_path / "out"
+    p = _run_supervised(out, nprocs=2, max_epoch=2, env_extra={
+        "DTPU_FAULT_HANG_STEP": "20",
+        "DTPU_TEST_HANG_TIMEOUT_S": "10",
+    })
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-3000:]
+    recs = _journal(out)
+    outcomes = [r["outcome"] for r in _by_kind(recs, "supervisor_exit")]
+    assert outcomes[0] in (resilience.EXIT_HANG, resilience.EXIT_KILLED), outcomes
+    assert outcomes[-1] == resilience.EXIT_CLEAN
+    hang_recoveries = [r for r in _by_kind(recs, "supervisor_recovery")
+                       if r["outcome"] == resilience.EXIT_HANG]
+    assert all(r["backoff_s"] == 0 for r in hang_recoveries)
+    (verdict,) = _by_kind(recs, "supervisor_verdict")
+    assert verdict["verdict"] == "clean"
+    assert _digests(p.stdout) == supervised_reference["digest"]
+    assert _final_window_losses(out) == supervised_reference["losses"]
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_supervised_poison_rolls_back_then_gives_up(tmp_path):
+    """Persistent poison-at-step-k: NaN injection over epoch 2 (armed across
+    restarts — data poison replays by design) aborts the worker with the
+    poison exit; the agent rolls auto-resume back to an OLDER known-good
+    checkpoint, the divergence replays anyway, and the supervision ends
+    within the rollback budget with a typed gave_up verdict."""
+    out = tmp_path / "out"
+    p = _run_supervised(out, nprocs=1, max_epoch=3, env_extra={
+        "DTPU_FAULT_NAN_STEPS": "36,37,38,39,40,41",  # epoch 2 of 16-step epochs
+        "DTPU_TEST_MAX_CONSEC_SKIPS": "3",
+    }, overrides=["AGENT.MAX_ROLLBACKS", "1"])
+    assert p.returncode == 1, p.stdout[-3000:] + p.stderr[-3000:]
+    recs = _journal(out)
+    assert [r["outcome"] for r in _by_kind(recs, "supervisor_exit")] == [
+        resilience.EXIT_POISON, resilience.EXIT_POISON,
+    ]
+    (rec,) = _by_kind(recs, "supervisor_recovery")
+    assert rec["action"] == "rollback" and rec["rollback"] == 1
+    assert [r["rollback"] for r in _by_kind(recs, "supervisor_launch")] == [0, 1]
+    # the rollback really skipped the most-advanced known-good checkpoint
+    skips = [r for r in _by_kind(recs, "ckpt_skipped")
+             if r.get("reason") == "rollback"]
+    assert skips, [r["kind"] for r in recs]
+    (verdict,) = _by_kind(recs, "supervisor_verdict")
+    assert verdict["verdict"] == "gave_up" and verdict["rollbacks"] == 2
+    assert "poison persisted" in verdict["reason"]

@@ -733,6 +733,30 @@ def test_vit_b16_timm_schema_full_tree_structure():
     verify_against_model(converted, "vit_b16")
 
 
+def test_vit_conversion_raises_on_unmatched_keys():
+    """Stray torch keys (a qk_norm/distilled variant, or a typo) must fail
+    the ViT conversion with the full list of strays — mirroring
+    verify_against_model's flax-side loudness — never be silently dropped
+    into a model that loads, runs, and scores garbage."""
+    import pytest
+
+    sd = _synthetic_vit_b16_torchvision()
+    sd["blocks.0.attn.q_norm.weight"] = np.zeros(768, np.float32)  # timm qk_norm
+    sd["head_dist.weight"] = np.zeros((1000, 768), np.float32)  # deit distilled
+    sd["encoder.layerz.encoder_layer_1.ln_1.weight"] = np.zeros(768, np.float32)
+    # non-integer index segments must land in the stray list too, not die in
+    # an opaque int() traceback
+    sd["encoder.layers.encoder_layer_x.ln_1.weight"] = np.zeros(768, np.float32)
+    sd["blocks.seq.attn.qkv.weight"] = np.zeros((2304, 768), np.float32)
+    with pytest.raises(ValueError, match="match no mapping") as exc:
+        convert_state_dict(sd, "vit_b16")
+    for stray in ("blocks.0.attn.q_norm.weight", "head_dist.weight",
+                  "encoder.layerz.encoder_layer_1.ln_1.weight",
+                  "encoder.layers.encoder_layer_x.ln_1.weight",
+                  "blocks.seq.attn.qkv.weight"):
+        assert stray in str(exc.value)
+
+
 def _export_and_load(tnet, arch, variables):
     """Export flax variables, load into the real torch net, return it eval'd."""
     from distribuuuu_tpu.convert import export_state_dict

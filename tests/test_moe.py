@@ -158,3 +158,25 @@ def test_moe_rejects_expert_count_mismatch():
     )
     with pytest.raises(ValueError, match="routes to 16 experts"):
         f(params, x)
+
+
+def test_token_slot_positions_are_int32():
+    """Capacity slots are counted with an int32 cumsum: a float32 cumsum
+    silently stops incrementing at 2^24 tokens per expert, which would
+    overwrite send-buffer slots (corrupted dispatch, no error). Pins the
+    dtype and the exact counting semantics."""
+    from distribuuuu_tpu.parallel.moe import token_slot_positions
+
+    top = jnp.asarray([0, 1, 0, 0, 2, 1, 0], jnp.int32)
+    onehot = jax.nn.one_hot(top, 3, dtype=jnp.float32)
+    pos = token_slot_positions(onehot)
+    assert pos.dtype == jnp.int32
+    np.testing.assert_array_equal(
+        np.asarray(pos), [0, 0, 1, 2, 0, 1, 3]
+    )
+    # the jitted dtype is what matters on device: trace and check the aval
+    traced = jax.eval_shape(token_slot_positions, onehot)
+    assert traced.dtype == jnp.int32
+    # and the float32 failure mode this guards against is real: one more
+    # token past 2^24 does not increment a float32 counter
+    assert np.float32(2**24) + np.float32(1.0) == np.float32(2**24)

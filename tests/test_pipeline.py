@@ -145,3 +145,76 @@ def test_pipeline_rejects_bad_microbatching():
     )
     with pytest.raises(ValueError, match="not divisible"):
         f(stacked, x)
+
+
+def div_stage_fn(params, x):
+    """Division-containing stage (eps-guarded RMS-norm-style block).
+
+    The regression target for the where/NaN-grad trap: `jnp.where` masking
+    after the compute still evaluates stage_fn's VJP at the inactive-tick
+    primal, so a stage whose Jacobian blows up on garbage input would leak
+    NaN into the *parameter* grads (0-cotangent x inf-Jacobian). The
+    pipeline therefore feeds an explicit ZERO activation into inactive
+    ticks, and stage_fn must be finite with a finite Jacobian there — which
+    this eps-guarded division is (and an unguarded `/ sqrt(mean(h^2))`
+    deliberately is not: 0/0 at the zero activation, by documented
+    constraint).
+    """
+    h = jnp.tanh(x @ params["w1"] + params["b1"]) @ params["w2"]
+    return x + h / jnp.sqrt(jnp.mean(h * h, axis=-1, keepdims=True) + 1e-4)
+
+
+def dense_div_forward(stacked, x):
+    for s in range(stacked["w1"].shape[0]):
+        x = div_stage_fn(jax.tree.map(lambda a, s=s: a[s], stacked), x)
+    return x
+
+
+def test_pipeline_division_stage_grads_finite():
+    """Non-finite-grad regression (where/NaN-grad trap): a pipeline of
+    division-containing stages must produce all-finite parameter grads that
+    match the dense oracle — inactive ticks compute on explicit zeros, not
+    leftovers."""
+    n_stages, batch, num_micro = 8, 8, 4
+    mesh = create_mesh({"stage": n_stages})
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal((batch, D)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((batch, D)), jnp.float32)
+    stacked = make_stage_params(jax.random.PRNGKey(11), n_stages)
+
+    def body(params_local, x, y):
+        params_local = jax.tree.map(lambda a: a[0], params_local)
+
+        def loss_fn(p):
+            out = pipeline_apply(
+                p, x, div_stage_fn, num_microbatches=num_micro, axis_name="stage"
+            )
+            return _loss_from_out(out, y)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params_local)
+        return loss, jax.tree.map(lambda g: g[None], grads)
+
+    sharded = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P("stage"), P(), P()),
+            out_specs=(P(), P("stage")),
+            check_vma=False,
+        )
+    )
+    loss, grads = sharded(stacked, x, y)
+
+    for k, g in grads.items():
+        assert np.isfinite(np.asarray(g)).all(), f"non-finite grad in {k}"
+    assert np.isfinite(float(loss))
+
+    def dense_loss(p):
+        return _loss_from_out(dense_div_forward(p, x), y)
+
+    expect_loss, expect_grads = jax.value_and_grad(dense_loss)(stacked)
+    np.testing.assert_allclose(float(loss), float(expect_loss), rtol=1e-5)
+    for k in expect_grads:
+        np.testing.assert_allclose(
+            np.asarray(grads[k]), np.asarray(expect_grads[k]),
+            rtol=1e-4, atol=1e-5, err_msg=k,
+        )

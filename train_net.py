@@ -5,16 +5,25 @@ Usage (identical CLI):
 
 Single host drives all local TPU chips; on a pod, launch one process per host
 (Slurm or RANK/WORLD_SIZE/MASTER_ADDR env — see distribuuuu_tpu/runtime/dist.py).
+Under the dtpu-agent supervisor (`python -m distribuuuu_tpu.agent`), the exit
+code tells the agent what happened: 0 clean, 124 hang (watchdog), 143/130
+graceful preemption, 117 poison (persistent non-finite divergence — see
+`resilience.classify_exit_code` and docs/FAULT_TOLERANCE.md).
 """
 
 import distribuuuu_tpu.trainer as trainer
+from distribuuuu_tpu import resilience
 from distribuuuu_tpu.config import cfg, load_cfg_fom_args
 
 
 def main():
     load_cfg_fom_args("Train a classification model.")
     cfg.freeze()
-    trainer.train_model()
+    # the typed poison exit: a supervisor must not plain-restart a diverged
+    # run (the divergence replays); it needs the rollback escalation instead
+    code, _ = resilience.call_with_poison_exit(trainer.train_model)
+    if code:
+        raise SystemExit(code)
 
 
 if __name__ == "__main__":
