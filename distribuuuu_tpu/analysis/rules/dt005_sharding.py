@@ -8,11 +8,17 @@ failure are static:
 * **Axis-name census (cross-file).** Pass 1 collects every axis name the
   scanned tree *declares*: dict keys passed to ``create_mesh`` (the
   ``runtime/mesh.py`` entry point — ``data_mesh`` declares ``data`` there),
-  string tuples passed to ``Mesh(...)``/``axis_names=``, and string
-  defaults of ``axis_name``/``bn_axis_name`` parameters (a library function
-  defaulting to ``"seq"`` is declaring that axis's vocabulary). Pass 2
-  flags any ``PartitionSpec``/``P`` string and any ``axis_name=`` /
-  positional collective axis string that the census never saw.
+  dict literals *assigned to a name* that the same module later passes to
+  ``create_mesh`` (``data_mesh`` builds its ``('data', 'fsdp')`` axes dict
+  in a variable), string tuples passed to ``Mesh(...)``/``axis_names=``,
+  string defaults of ``axis_name``/``bn_axis_name`` parameters (a library
+  function defaulting to ``"seq"`` is declaring that axis's vocabulary),
+  and axis-vocabulary constants — ``FSDP_AXIS = "fsdp"``-style assignments
+  to a name ending in ``_AXIS`` (the `parallel/fsdp.py` partition-rule
+  idiom: the axis name declared in exactly one place and referenced by
+  constant everywhere else). Pass 2 flags any ``PartitionSpec``/``P``
+  string and any ``axis_name=`` / positional collective axis string that
+  the census never saw.
 * **shard_map spec arity.** ``shard_map(f, in_specs=(...))`` where ``f``
   is a local def or lambda: ``len(in_specs)`` must equal ``f``'s positional
   arity — a mismatch is an immediate trace error on every backend, flagged
@@ -62,6 +68,36 @@ def _str_elts(node: ast.AST):
 
 def collect(tree: ast.AST, ctx) -> None:
     """Pass 1: harvest declared axis names into ``ctx.known_axes``."""
+    # names this module passes to create_mesh as the axes dict — dict
+    # literals assigned to them declare their keys (data_mesh builds the
+    # ('data', 'fsdp') dict in a variable before the call)
+    mesh_arg_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            cn = call_name(node) or ""
+            if cn in {"create_mesh", "create_hybrid_device_mesh"}:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        mesh_arg_names.add(arg.id)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = node.value
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                # FSDP_AXIS = "fsdp": axis-vocabulary constant
+                if (
+                    t.id.endswith("_AXIS")
+                    and isinstance(value, ast.Constant)
+                    and isinstance(value.value, str)
+                ):
+                    ctx.known_axes.add(value.value)
+                # axes = {"data": d, "fsdp": f} ... create_mesh(axes)
+                if t.id in mesh_arg_names and isinstance(value, ast.Dict):
+                    for k in value.keys:
+                        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                            ctx.known_axes.add(k.value)
     for node in ast.walk(tree):
         if isinstance(node, ast.Call):
             cn = call_name(node) or ""

@@ -81,6 +81,15 @@ _C.TRAIN.LABEL_SMOOTH = 0.0
 # × ACCUM_STEPS). The reference reaches large batches with more GPUs only
 # (`README.md:178-192`); this reaches them on a fixed chip count.
 _C.TRAIN.ACCUM_STEPS = 1
+# Persistent XLA compilation cache (runtime/compile_cache.py): identical
+# programs compile once per machine, not once per process per run — so a
+# dtpu-agent supervised restart (or any relaunch) resumes without paying the
+# full compile again. Cache hit/miss counts flow through the obs compile
+# counters (/jax/compilation_cache/* in the journal's counters records).
+_C.TRAIN.COMPILE_CACHE = True
+# Cache directory ("" = the repo-local default next to the package checkout;
+# set to a shared path, e.g. a persistent volume, for fleet-wide reuse).
+_C.TRAIN.COMPILE_CACHE_DIR = ""
 # jax.profiler trace of a few steady-state steps (epoch 0) → OUT_DIR/profile.
 # The reference has no profiler (SURVEY §5); this is the idiomatic upgrade.
 _C.TRAIN.PROFILE = False
@@ -129,6 +138,17 @@ _C.OPTIM.WEIGHT_DECAY = 5e-5
 # axes are declared here so multi-axis meshes (see parallel/) slot in.
 _C.MESH = CN()
 _C.MESH.DATA = -1  # -1: all devices on the 'data' axis
+# ZeRO-style parameter + optimizer-state sharding (parallel/fsdp.py,
+# docs/PARALLELISM.md): >1 grows the training mesh to ('data', 'fsdp') and
+# shards params/grads/optimizer state over the fsdp axis (all-gather on use,
+# reduce-scatter grads, 1/N per-chip state). -1: every device not claimed by
+# DATA (with DATA=-1 too, pure FSDP over the whole fleet). Composes with data
+# parallelism: batches shard over both axes.
+_C.MESH.FSDP = 1
+# Partition-rule floor: param/optimizer leaves with fewer elements than this
+# stay replicated (BN scales, biases — sharding them saves ~nothing and costs
+# a collective). The census of what sharded is logged and journaled.
+_C.MESH.FSDP_MIN_SIZE = 16384
 
 # Fault tolerance (TPU addition; docs/FAULT_TOLERANCE.md). The reference has
 # no mid-epoch failure story; these knobs govern the resilience layer.

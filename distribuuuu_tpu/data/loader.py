@@ -493,8 +493,15 @@ def prefetch_to_device(iterator, mesh, prefetch: int = 2):
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    img_sharding = NamedSharding(mesh, P("data", None, None, None))
-    vec_sharding = NamedSharding(mesh, P("data"))
+    from distribuuuu_tpu.parallel.fsdp import batch_axes
+
+    # On a ('data', 'fsdp') mesh the batch shards over BOTH axes (fsdp
+    # composes with dp — every device computes a distinct slice), and the
+    # committed layout must match the step's in_specs or every batch pays a
+    # reshard collective at step entry.
+    bx = batch_axes(mesh)
+    img_sharding = NamedSharding(mesh, P(bx, None, None, None))
+    vec_sharding = NamedSharding(mesh, P(bx))
 
     def to_device(batch):
         return {

@@ -195,6 +195,24 @@ SCHEMA: dict[str, tuple[dict[str, tuple], dict[str, tuple]]] = {
         {"epoch": _INT, "live_arrays": _INT, "live_bytes": _INT},
         {"per_device": (dict, type(None))},
     ),
+    # per-device train-state byte census (params/opt/BN, measured from
+    # addressable shards — obs/memory.state_bytes): the journaled proof that
+    # fsdp=N keeps ~1/N of params+optimizer state per chip
+    "state_bytes": (
+        {
+            "fsdp": _INT,
+            "devices": _INT,
+            "params_bytes": _INT,
+            "opt_bytes": _INT,
+            "bn_bytes": _INT,
+            "total_bytes": _INT,
+        },
+        {
+            "params_global_bytes": _INT,
+            "opt_global_bytes": _INT,
+            "bn_global_bytes": _INT,
+        },
+    ),
     "profile": (
         {"gstep": _INT, "steps": _INT, "logdir": _STR},
         {"device_ms_per_step": _NUM_OR_NONE, "top_ops": _LIST, "trigger": _STR},

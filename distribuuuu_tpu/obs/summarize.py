@@ -178,6 +178,21 @@ def render(records: Iterable[dict]) -> str:
             f"{len(by_kind['restore'])} restore(s)"
         )
 
+    # -- state bytes (fsdp 1/N measurement) ----------------------------------
+    if by_kind["state_bytes"]:
+        s = by_kind["state_bytes"][-1]
+        glob = sum(
+            s.get(f"{k}_global_bytes", 0) for k in ("params", "opt", "bn")
+        )
+        ratio = f" = {s['total_bytes'] / glob:.2f}x of global" if glob else ""
+        out(
+            f"state bytes/device (fsdp={s['fsdp']}): "
+            f"params {s['params_bytes'] / 1e6:.1f} MB + "
+            f"opt {s['opt_bytes'] / 1e6:.1f} MB + "
+            f"bn {s['bn_bytes'] / 1e6:.1f} MB "
+            f"= {s['total_bytes'] / 1e6:.1f} MB{ratio}"
+        )
+
     # -- memory --------------------------------------------------------------
     if by_kind["memory"]:
         m = by_kind["memory"][-1]

@@ -107,7 +107,54 @@ def sgd_momentum(
     return optax.GradientTransformation(init, update)
 
 
-def construct_optimizer() -> optax.GradientTransformation:
+def _scale_by_trust_ratio_fsdp(
+    param_specs, fsdp_axis: str
+) -> optax.GradientTransformation:
+    """`optax.scale_by_trust_ratio` for fsdp-sharded leaves.
+
+    The trust ratio is the one LAMB stage that is not leafwise-elementwise:
+    it needs each parameter's (and update's) *global* L2 norm, and on a
+    1/N shard a local norm is wrong. For leaves ``param_specs`` marks as
+    sharded, the squared norm is ``psum``'d over the fsdp axis before the
+    sqrt; replicated leaves (identical on every fsdp rank once grads are
+    averaged) use their local norm unchanged. Same formula as optax 0.2.x
+    (trust_coefficient=1, eps=0, min_norm=0): ratio = |p|/|u|, 1 where
+    either norm is zero. Must be applied under a `shard_map` that has the
+    fsdp axis in scope.
+    """
+    from distribuuuu_tpu.parallel import fsdp as _fsdp
+
+    def _norm(x, spec):
+        sq = jnp.sum(jnp.square(x))
+        if _fsdp.fsdp_dim(spec) is not None:
+            sq = jax.lax.psum(sq, fsdp_axis)
+        return jnp.sqrt(sq)
+
+    def init(params):
+        del params
+        return optax.EmptyState()
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("trust ratio needs params")
+
+        def one(u, p, spec):
+            p_norm = _norm(p, spec)
+            u_norm = _norm(u, spec)
+            zero = jnp.logical_or(p_norm == 0.0, u_norm == 0.0)
+            ratio = jnp.where(
+                zero, jnp.array(1.0, dtype=p.dtype), p_norm / u_norm
+            )
+            return u * ratio
+
+        return jax.tree.map(one, updates, params, param_specs), state
+
+    return optax.GradientTransformation(init, update)
+
+
+def construct_optimizer(
+    param_specs=None, fsdp_axis: str | None = None
+) -> optax.GradientTransformation:
     """Build the cfg-selected optimizer as an LR-free ascent direction; the
     trainer applies ``params - lr·update`` with lr as a traced scalar.
 
@@ -119,6 +166,14 @@ def construct_optimizer() -> optax.GradientTransformation:
       ImageNet global batches past ~8k on big TPU meshes. Composed of the
       same optax primitives as `optax.lamb`, minus the final ``scale(-lr)``
       (the trust ratio is LR-independent, so the epoch-LR contract holds).
+
+    Under fsdp (``param_specs`` + ``fsdp_axis`` set by
+    `trainer.create_train_state` when cfg.MESH.FSDP > 1) the update runs on
+    the 1/N *shard*: every SGD stage (coupled WD, the momentum buffer, the
+    nesterov combine) is leafwise-elementwise, so shard-in/shard-out is the
+    identical math on a slice — momentum lives sharded exactly like its
+    parameter. LAMB's trust ratio is the one norm-based stage and swaps in
+    the fsdp-aware variant above.
     """
     name = cfg.OPTIM.OPTIMIZER
     if name == "sgd":
@@ -139,12 +194,16 @@ def construct_optimizer() -> optax.GradientTransformation:
         def _wd_mask(params):
             return jax.tree.map(lambda p: p.ndim > 1, params)
 
+        if param_specs is not None and fsdp_axis is not None:
+            trust = _scale_by_trust_ratio_fsdp(param_specs, fsdp_axis)
+        else:
+            trust = optax.scale_by_trust_ratio()
         return optax.chain(
             optax.scale_by_adam(
                 b1=cfg.OPTIM.BETA1, b2=cfg.OPTIM.BETA2, eps=cfg.OPTIM.EPS
             ),
             optax.add_decayed_weights(cfg.OPTIM.WEIGHT_DECAY, mask=_wd_mask),
-            optax.scale_by_trust_ratio(),
+            trust,
         )
     raise ValueError(
         f"Unknown OPTIM.OPTIMIZER {name!r} (available: 'sgd', 'lamb')"

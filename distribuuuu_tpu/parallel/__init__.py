@@ -20,8 +20,13 @@ building blocks that extend the same mesh design to other axes:
 - `moe`: switch-style top-1 mixture-of-experts over an ``expert`` axis —
   one-hot einsum dispatch/combine (dense MXU contractions, static shapes)
   around a single `all_to_all` each way.
+- `fsdp`: ZeRO-style parameter + optimizer-state sharding over an ``fsdp``
+  axis — shape-pure partition rules, all-gather-on-use parameters (whose
+  autodiff transpose is the grad reduce-scatter), shard-resident optimizer
+  updates; composes with the data axis (cfg.MESH.FSDP).
 """
 
+from distribuuuu_tpu.parallel import fsdp
 from distribuuuu_tpu.parallel.collectives import (
     barrier,
     pmean_tree,
@@ -34,6 +39,7 @@ from distribuuuu_tpu.parallel.tensor import column_parallel_logits, tp_cross_ent
 from distribuuuu_tpu.parallel.ulysses import ulysses_attention
 
 __all__ = [
+    "fsdp",
     "barrier",
     "pmean_tree",
     "scaled_all_reduce",

@@ -1,9 +1,16 @@
-"""Repo-local persistent XLA compilation cache — ONE definition.
+"""Persistent XLA compilation cache — ONE definition.
 
-Shared by tests/conftest.py and scripts/cpu_mesh_run.py so the test suite
-and the CLI wrapper always hit the same cache (identical programs compile
-once per machine, not once per process per run). Dev tooling only: the
-cache lands next to the repo checkout this package was imported from.
+Shared by tests/conftest.py, scripts/cpu_mesh_run.py AND the production
+entry points (`trainer.train_model`/`test_model` and the dtpu-agent's
+built-in worker enable it by default, cfg.TRAIN.COMPILE_CACHE): identical
+programs compile once per machine, not once per process per run. That is
+what makes supervised restarts warm — a dtpu-agent relaunch resumes
+training without paying the full step compile again, and the saved time
+shows up directly in the journal's goodput. Cache interactions are
+journaled through the existing obs compile counters
+(``/jax/compilation_cache/*`` events in ``counters`` records;
+``backend_compile_duration`` keeps counting true compiles only).
+
 Call before the first computation (jax may already be imported; only
 backend-touching work must come after).
 """
@@ -13,11 +20,21 @@ from __future__ import annotations
 import os
 
 
-def enable_persistent_cache() -> str:
+def enable_persistent_cache(cache_dir: str | None = None) -> str:
+    """Point jax at a persistent on-disk compile cache and return its path.
+
+    ``cache_dir`` default (None/"") is repo-local — next to the checkout
+    this package was imported from — which keeps dev/test runs hermetic.
+    Production runs point it somewhere durable via
+    ``cfg.TRAIN.COMPILE_CACHE_DIR`` (e.g. a persistent volume shared by a
+    host's workers). Idempotent: re-enabling with the same dir is a no-op
+    config update.
+    """
     import jax
 
-    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    cache_dir = os.path.join(root, ".cache", "jax_compile")
+    if not cache_dir:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        cache_dir = os.path.join(root, ".cache", "jax_compile")
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     return cache_dir

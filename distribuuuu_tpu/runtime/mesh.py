@@ -3,9 +3,10 @@
 The reference's parallelism topology is implicit in its process layout (one
 process per GPU, DDP over all of them, `trainer.py:134`). Here topology is an
 explicit `jax.sharding.Mesh`. The framework's core is data-parallel over a
-1-D ``('data',)`` mesh; `create_mesh` is general over named axes so richer
-layouts (data × model × sequence, see `distribuuuu_tpu/parallel/`) use the
-same entry point.
+1-D ``('data',)`` mesh, growing to 2-D ``('data', 'fsdp')`` when parameter/
+optimizer-state sharding is on (cfg.MESH.FSDP > 1, `parallel/fsdp.py`);
+`create_mesh` is general over named axes so richer layouts (data × model ×
+sequence, see `distribuuuu_tpu/parallel/`) use the same entry point.
 """
 
 from __future__ import annotations
@@ -54,34 +55,51 @@ def create_mesh(axes: dict[str, int], devices=None) -> Mesh:
     return Mesh(dev_array, tuple(sizes.keys()))
 
 
-def data_mesh(data: int = -1) -> Mesh:
-    """The framework's default 1-D data-parallel mesh (cfg.MESH.DATA).
+def data_mesh(data: int = -1, fsdp: int = 1) -> Mesh:
+    """The framework's default training mesh (cfg.MESH.DATA / cfg.MESH.FSDP).
 
-    ``data=-1`` (the default) spans all visible devices. An explicit size
-    smaller than the fleet builds a mesh over the first ``data`` devices —
-    the elastic-restore affordance (resume a run saved on N devices onto an
-    M-device submesh of this host, see docs/FAULT_TOLERANCE.md) and the CPU
-    test harness's way of emulating differently-sized slices. Deliberately
-    loud: leaving chips idle is only ever intentional.
+    ``fsdp=1`` (the default) is the original 1-D ``('data',)`` data-parallel
+    mesh, bit-for-bit. ``fsdp>1`` (or -1: all remaining devices) grows it to
+    2-D ``('data', 'fsdp')`` — batches shard over both axes, params and
+    optimizer state shard over ``fsdp`` (see `parallel/fsdp.py`). The fsdp
+    axis is last so `mesh_utils` places it on the tightest ICI ring (its
+    all-gather/reduce-scatter traffic is the latency-critical part).
+
+    ``data=-1`` spans all devices not claimed by fsdp. Explicit sizes whose
+    product is smaller than the fleet build a mesh over the first
+    ``data*fsdp`` devices — the elastic-restore affordance (resume a run
+    saved on N devices onto an M-device submesh of this host, see
+    docs/FAULT_TOLERANCE.md) and the CPU test harness's way of emulating
+    differently-sized slices. Deliberately loud: leaving chips idle is only
+    ever intentional.
     """
     devices = jax.devices()
-    if 0 < data < len(devices):
+    if fsdp in (0, 1):
+        axes: dict[str, int] = {"data": data}
+        want = data
+    else:
+        if data == -1 and fsdp == -1:
+            # "shard state over everything": pure FSDP, data axis trivial
+            data = 1
+        axes = {"data": data, "fsdp": fsdp}
+        want = data * fsdp if data > 0 and fsdp > 0 else -1
+    if 0 < want < len(devices):
         from distribuuuu_tpu.logging import logger
 
         if jax.process_count() > 1:
-            # devices[:data] would leave some hosts with zero local mesh
+            # devices[:want] would leave some hosts with zero local mesh
             # devices and the loader dividing by a zero host batch — fail
             # here with the real story instead
             raise ValueError(
-                f"MESH.DATA={data} < {len(devices)} devices is only "
-                f"supported on single-host runs: a submesh over the first "
-                f"{data} devices would leave some of the "
+                f"MESH.DATA={data} x MESH.FSDP={fsdp} < {len(devices)} "
+                f"devices is only supported on single-host runs: a submesh "
+                f"over the first {want} devices would leave some of the "
                 f"{jax.process_count()} hosts with no mesh-local devices. "
                 f"Relaunch with a host count matching the target topology."
             )
         logger.warning(
-            f"MESH.DATA={data} uses {data} of {len(devices)} visible devices "
-            f"(submesh; the rest stay idle)"
+            f"MESH.DATA={data} x MESH.FSDP={fsdp} uses {want} of "
+            f"{len(devices)} visible devices (submesh; the rest stay idle)"
         )
-        return create_mesh({"data": data}, devices=devices[:data])
-    return create_mesh({"data": data})
+        return create_mesh(axes, devices=devices[:want])
+    return create_mesh(axes)

@@ -51,6 +51,7 @@ from distribuuuu_tpu.metrics import (
     topk_correct_weighted,
 )
 from distribuuuu_tpu.models import build_model
+from distribuuuu_tpu.parallel import fsdp
 from distribuuuu_tpu.runtime import data_mesh, setup_distributed, setup_seed
 from distribuuuu_tpu.runtime.compat import ensure_jax_compat
 from distribuuuu_tpu.runtime.seeding import configure_determinism
@@ -92,13 +93,21 @@ def _forward_loss(model, params, batch_stats, batch, train: bool, rng):
 
 def make_train_step(
     model, tx, mesh: Mesh, topk: int, accum_steps: int = 1,
-    nonfinite_guard: bool | None = None,
+    nonfinite_guard: bool | None = None, state_specs=None,
 ):
     """Build the jitted SPMD train step.
 
     Per-device: forward/backward on the local batch shard → `pmean` grads over
     the data axis → identical optimizer update everywhere. Metrics are raw
     *count* sums (`psum`) so averaging is exact regardless of shard sizes.
+
+    ``state_specs`` (a TrainState of PartitionSpecs, from
+    `parallel.fsdp.specs_of`) turns on ZeRO-style execution on a
+    ``('data', 'fsdp')`` mesh: the state arrives as 1/N shards, the forward
+    pass materializes full parameters via all-gather *inside* the loss (whose
+    autodiff transpose is the grad reduce-scatter, so backward grads are
+    already shards), and the optimizer update runs leafwise on the shard.
+    ``None`` (the default) is the original fully-replicated path, bit-for-bit.
 
     ``accum_steps > 1``: the local batch is split into that many micro-batches
     and grads/metrics are averaged over a `lax.scan` before the single
@@ -118,9 +127,29 @@ def make_train_step(
     """
     if nonfinite_guard is None:
         nonfinite_guard = cfg.FAULT.NONFINITE_GUARD
+    if fsdp.fsdp_size(mesh) > 1 and state_specs is None:
+        # without specs the step would shard the batch over both axes but
+        # reduce grads over 'data' only — silent per-fsdp-group divergence
+        # (check_vma=False means nothing else trips). Fail at build time.
+        raise ValueError(
+            "make_train_step: mesh has an fsdp axis but state_specs is None "
+            "— pass parallel.fsdp.specs_of(state) (see train_model)"
+        )
+    use_fsdp = state_specs is not None and fsdp.fsdp_size(mesh) > 1
+    fsdp_n = fsdp.fsdp_size(mesh)
+    param_specs = state_specs.params if use_fsdp else None
+    # grads/BN stats/metrics reduce over every batch-bearing axis: fsdp
+    # composes with dp, so the fleet mean spans both
+    reduce_axes = ("data", fsdp.FSDP_AXIS) if use_fsdp else "data"
+    n_mesh_devices = int(mesh.devices.size)
 
     def grads_one(params, batch_stats, micro, rng):
         def loss_fn(p):
+            if use_fsdp:
+                # gather INSIDE the differentiated function: the transpose of
+                # the tiled all-gather is a psum_scatter, so the grads this
+                # returns are already 1/N shards (summed over the fsdp axis)
+                p = fsdp.all_gather_params(p, param_specs)
             return _forward_loss(model, p, batch_stats, micro, True, rng)
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -129,8 +158,17 @@ def make_train_step(
         return loss, logits, new_stats, grads
 
     def step(state: TrainState, batch, lr, rng):
-        # distinct dropout stream per device (rng arrives replicated)
-        rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+        # distinct dropout stream per device (rng arrives replicated); on a
+        # 2-D mesh the fold uses the linearized device index so a (d, f) mesh
+        # reproduces the stream of a (d·f,)-device data-parallel mesh
+        if use_fsdp:
+            dev_idx = (
+                jax.lax.axis_index("data") * fsdp_n
+                + jax.lax.axis_index(fsdp.FSDP_AXIS)
+            )
+        else:
+            dev_idx = jax.lax.axis_index("data")
+        rng = jax.random.fold_in(rng, dev_idx)
 
         if accum_steps == 1:
             loss, logits, new_stats, grads = grads_one(
@@ -164,12 +202,17 @@ def make_train_step(
             # input stats never enter a train-mode forward, so grads/outputs
             # are unaffected; equality vs the sequential oracle is pinned in
             # tests/test_train_step.py).
+        if use_fsdp:
+            # sharded leaves arrive as per-shard fsdp-axis SUMS from the
+            # gather transpose (÷N makes them means); replicated leaves still
+            # differ along fsdp and take an explicit pmean there
+            grads = fsdp.average_grads(grads, param_specs, fsdp_n)
         grads = jax.lax.pmean(grads, "data")
         # Running BN stats: averaged across replicas so state stays replicated.
         # (With SYNCBN the normalization stats are already cross-replica; this
         # additionally keeps the *running* estimates identical on every chip —
         # strictly more consistent than DDP's per-rank copies, SURVEY §2b.)
-        new_stats = jax.lax.pmean(new_stats, "data")
+        new_stats = jax.lax.pmean(new_stats, reduce_axes)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optim.apply_updates_with_lr(state.params, updates, lr)
         n = jnp.float32(batch["label"].shape[0])
@@ -179,9 +222,21 @@ def make_train_step(
             # every device and the selection below stays replicated. A NaN
             # anywhere on any device poisons the pmean'd grads, so checking
             # the post-collective values catches per-device faults too.
-            keep = jnp.isfinite(jax.lax.pmean(loss, "data"))
+            keep = jnp.isfinite(jax.lax.pmean(loss, reduce_axes))
+            local_ok = jnp.bool_(True)
             for g in jax.tree.leaves(grads):
-                keep = jnp.logical_and(keep, jnp.all(jnp.isfinite(g)))
+                local_ok = jnp.logical_and(local_ok, jnp.all(jnp.isfinite(g)))
+            if use_fsdp:
+                # grads are per-device SHARDS here, so finiteness is a local
+                # fact — agree across the mesh or devices would diverge on
+                # the select below (the replicated path needs no collective:
+                # its pmean'd grads are identical everywhere already)
+                ok_count = jax.lax.psum(
+                    local_ok.astype(jnp.float32), reduce_axes
+                )
+                keep = jnp.logical_and(keep, ok_count == n_mesh_devices)
+            else:
+                keep = jnp.logical_and(keep, local_ok)
 
             def sel(new, old):
                 return jnp.where(keep, new, old)
@@ -198,10 +253,10 @@ def make_train_step(
         else:
             loss_term = loss * n
         metrics = {
-            "loss_sum": jax.lax.psum(loss_term, "data"),
-            "n": jax.lax.psum(n, "data"),
-            "correct1": jax.lax.psum(correct[1], "data"),
-            f"correct{topk}": jax.lax.psum(correct[topk], "data"),
+            "loss_sum": jax.lax.psum(loss_term, reduce_axes),
+            "n": jax.lax.psum(n, reduce_axes),
+            "correct1": jax.lax.psum(correct[1], reduce_axes),
+            f"correct{topk}": jax.lax.psum(correct[topk], reduce_axes),
         }
         if nonfinite_guard:
             metrics["skipped"] = 1.0 - keep.astype(jnp.float32)
@@ -210,27 +265,39 @@ def make_train_step(
             metrics,
         )
 
+    state_in_specs = state_specs if use_fsdp else P()
     sharded = jax.shard_map(
         step,
         mesh=mesh,
-        in_specs=(P(), P("data"), P(), P()),
-        out_specs=(P(), P()),
+        in_specs=(state_in_specs, P(fsdp.batch_axes(mesh)), P(), P()),
+        out_specs=(state_in_specs, P()),
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def make_eval_step(model, mesh: Mesh, topk: int):
+def make_eval_step(model, mesh: Mesh, topk: int, state_specs=None):
     """Jitted SPMD eval step with weight-masked exact metrics (SURVEY §3.3).
 
     Takes and returns the running metric totals so accumulation happens
     *inside* the compiled step (one dispatch per batch). ``zero_metrics()``
-    builds the initial totals.
+    builds the initial totals. ``state_specs`` mirrors `make_train_step`:
+    fsdp-sharded params are all-gathered per batch for the forward pass.
     """
+    if fsdp.fsdp_size(mesh) > 1 and state_specs is None:
+        raise ValueError(
+            "make_eval_step: mesh has an fsdp axis but state_specs is None "
+            "— pass parallel.fsdp.specs_of(state) (see train_model)"
+        )
+    use_fsdp = state_specs is not None and fsdp.fsdp_size(mesh) > 1
+    reduce_axes = ("data", fsdp.FSDP_AXIS) if use_fsdp else "data"
 
     def step(state: TrainState, batch, totals):
+        params = state.params
+        if use_fsdp:
+            params = fsdp.all_gather_params(params, state_specs.params)
         logits = model.apply(
-            {"params": state.params, "batch_stats": state.batch_stats},
+            {"params": params, "batch_stats": state.batch_stats},
             device_normalize(batch["image"]),
             train=False,
         )
@@ -239,15 +306,17 @@ def make_eval_step(model, mesh: Mesh, topk: int):
         nll = per_example_nll(logits32, batch["label"])
         correct = topk_correct_weighted(logits32, batch["label"], w, ks=(1, topk))
         m = {
-            "loss_sum": jax.lax.psum(jnp.sum(nll * w), "data"),
-            "n": jax.lax.psum(jnp.sum(w), "data"),
-            "correct1": jax.lax.psum(correct[1], "data"),
-            f"correct{topk}": jax.lax.psum(correct[topk], "data"),
+            "loss_sum": jax.lax.psum(jnp.sum(nll * w), reduce_axes),
+            "n": jax.lax.psum(jnp.sum(w), reduce_axes),
+            "correct1": jax.lax.psum(correct[1], reduce_axes),
+            f"correct{topk}": jax.lax.psum(correct[topk], reduce_axes),
         }
         return jax.tree.map(jnp.add, totals, m)
 
+    state_in_specs = state_specs if use_fsdp else P()
     sharded = jax.shard_map(
-        step, mesh=mesh, in_specs=(P(), P("data"), P()), out_specs=P(), check_vma=False
+        step, mesh=mesh, in_specs=(state_in_specs, P(fsdp.batch_axes(mesh)), P()),
+        out_specs=P(), check_vma=False,
     )
     # NB: totals is NOT donated — the buffers are 4 scalars, and donating a
     # replicated shard_map input deadlocked the XLA:CPU collective rendezvous.
@@ -268,23 +337,71 @@ def zero_metrics(topk: int, mesh: Mesh):
 # ---------------------------------------------------------------------------
 
 def create_train_state(model, key, mesh: Mesh, im_size: int):
-    """Init params on device, replicated across the mesh."""
-    tx = optim.construct_optimizer()
+    """Init the train state on device.
 
-    def init_fn(key):
+    On a 1-D data mesh the state is replicated across the mesh (the original
+    contract). On a ``('data', 'fsdp')`` mesh (cfg.MESH.FSDP > 1) params and
+    optimizer state are initialized DIRECTLY into their 1/N fsdp shards —
+    ``out_shardings`` on the jitted init means XLA SPMD materializes each
+    device's slice only, so even the first instant of a run never holds a
+    replicated copy of state that doesn't fit replicated. The partition
+    rules (`parallel/fsdp.py`) are priced on abstract shapes via
+    `jax.eval_shape` before anything is allocated.
+    """
+    fsdp_n = fsdp.fsdp_size(mesh)
+
+    def model_init(key):
         variables = model.init(
             key, jnp.zeros((1, im_size, im_size, 3), jnp.float32), train=False
         )
-        params = variables["params"]
-        batch_stats = variables.get("batch_stats", {})
+        return variables["params"], variables.get("batch_stats", {})
+
+    if fsdp_n > 1:
+        abs_params, _ = jax.eval_shape(model_init, key)
+        param_specs = fsdp.tree_specs(abs_params, fsdp_n)
+        # the optimizer update runs on the shard; LAMB's trust ratio needs
+        # the specs to psum its norms over the fsdp axis
+        tx = optim.construct_optimizer(
+            param_specs=param_specs, fsdp_axis=fsdp.FSDP_AXIS
+        )
+    else:
+        tx = optim.construct_optimizer()
+
+    def init_fn(key):
+        params, batch_stats = model_init(key)
         return TrainState(
             params=params, batch_stats=batch_stats, opt_state=tx.init(params)
         )
 
-    replicated = NamedSharding(mesh, P())
+    if fsdp_n > 1:
+        abs_state = jax.eval_shape(init_fn, key)
+        specs = fsdp.train_state_specs(abs_state, mesh)
+        c = fsdp.census(abs_state.params, specs.params)
+        c_opt = fsdp.census(abs_state.opt_state, specs.opt_state)
+        logger.info(
+            f"fsdp={fsdp_n}: params {c['sharded_leaves']} leaves/"
+            f"{c['sharded_bytes'] / 1e6:.1f} MB sharded, "
+            f"{c['replicated_leaves']} leaves/"
+            f"{c['replicated_bytes'] / 1e6:.1f} MB replicated; opt state "
+            f"{c_opt['sharded_bytes'] / 1e6:.1f} MB sharded/"
+            f"{c_opt['replicated_bytes'] / 1e6:.1f} MB replicated"
+        )
+        out_shardings = fsdp.shardings(specs, mesh)
+    else:
+        out_shardings = NamedSharding(mesh, P())
     # jit-then-call is deliberate here: init runs once per (model, mesh,
-    # im_size) and a keyed cache would pin every model ever constructed
-    state = jax.jit(init_fn, out_shardings=replicated)(key)  # dtpu-lint: disable=DT003
+    # im_size) and a keyed cache would pin every model ever constructed.
+    # Partitionable threefry for the init only: legacy (non-partitionable)
+    # threefry bits are partitioning-DEPENDENT under SPMD, so the same seed
+    # on a ('data','fsdp') mesh would initialize a different model than on a
+    # 1-D mesh — the sharded-init path must be the same model at every
+    # topology (the dp-oracle and elastic contracts both assume it).
+    prev_prng = jax.config.jax_threefry_partitionable
+    jax.config.update("jax_threefry_partitionable", True)
+    try:
+        state = jax.jit(init_fn, out_shardings=out_shardings)(key)  # dtpu-lint: disable=DT003
+    finally:
+        jax.config.update("jax_threefry_partitionable", prev_prng)
     return state, tx
 
 
@@ -324,7 +441,12 @@ def _build_cfg_model():
     if bn_dtype == "auto":
         bn_dtype = cfg.MODEL.DTYPE
     set_bn_compute_dtype(jnp.bfloat16 if bn_dtype == "bfloat16" else jnp.float32)
-    bn_axis = "data" if cfg.MODEL.SYNCBN else None
+    # SYNCBN spans every batch-bearing axis: on a ('data', 'fsdp') mesh the
+    # batch shards over both, so stats pmean over the pair — a pure-dp run
+    # and an fsdp run of the same device count normalize identically
+    bn_axis = None
+    if cfg.MODEL.SYNCBN:
+        bn_axis = "data" if cfg.MESH.FSDP in (0, 1) else ("data", fsdp.FSDP_AXIS)
     kwargs = {}
     if cfg.MODEL.STEM_S2D:  # resnet/botnet-family option; loud TypeError elsewhere
         kwargs["stem_s2d"] = True
@@ -619,6 +741,31 @@ def validate(
 # Top-level entry points (reference `train_model`/`test_model`)
 # ---------------------------------------------------------------------------
 
+def _enable_compile_cache() -> None:
+    """Point jax at the persistent compile cache (cfg.TRAIN.COMPILE_CACHE,
+    default on): identical programs compile once per machine, so a
+    dtpu-agent supervised restart (or any relaunch) resumes without paying
+    the full step compile again. Hit/miss counts ride the existing obs
+    compile counters (``/jax/compilation_cache/*`` in ``counters`` records)."""
+    if not cfg.TRAIN.COMPILE_CACHE:
+        return
+    from distribuuuu_tpu.runtime.compile_cache import enable_persistent_cache
+
+    cache_dir = enable_persistent_cache(cfg.TRAIN.COMPILE_CACHE_DIR or None)
+    logger.info(f"persistent XLA compile cache: {cache_dir}")
+
+
+def _journal_state_bytes(state, mesh: Mesh) -> None:
+    """Typed per-device state-bytes record: the measured half of the fsdp
+    1/N claim (obs/memory.py). Epoch-boundary-grade host work, no sync."""
+    try:
+        obs.current().event(
+            "state_bytes", **obs.state_bytes(state, fsdp=fsdp.fsdp_size(mesh))
+        )
+    except Exception as exc:  # observability must never kill the run
+        logger.warning(f"state-bytes snapshot failed: {exc!r}")
+
+
 def _bn_dtype_scoped(fn):
     """Restore the process-global BN boundary dtype on return: a run with
     MODEL.BN_DTYPE=bfloat16 must not silently change what a later *direct*
@@ -638,14 +785,20 @@ def _bn_dtype_scoped(fn):
 
 
 @functools.lru_cache(maxsize=None)
-def _recommit_fn(mesh: Mesh):
-    """Jitted replicated-copy, cached per mesh: binding the callable once
-    keeps the compile cache keyed on a stable function object (a fresh
-    ``jax.jit(lambda ...)`` per call retraces every call — DT003; this was
-    dtpu-lint's first real catch, regression-pinned in tests/test_analysis.py).
-    Meshes are hashable and O(1)-few per process, so the cache is bounded."""
-    replicated = NamedSharding(mesh, P())
-    return jax.jit(lambda s: jax.tree.map(jnp.copy, s), out_shardings=replicated)
+def _recommit_fn(mesh: Mesh, spec_treedef=None, spec_leaves=None):
+    """Jitted sharding-preserving copy, cached per (mesh, spec tree): binding
+    the callable once keeps the compile cache keyed on a stable function
+    object (a fresh ``jax.jit(lambda ...)`` per call retraces every call —
+    DT003; this was dtpu-lint's first real catch, regression-pinned in
+    tests/test_analysis.py). Meshes, treedefs and PartitionSpec tuples are
+    hashable and O(1)-few per process, so the cache is bounded."""
+    if spec_treedef is None:
+        out_shardings = NamedSharding(mesh, P())
+    else:
+        out_shardings = jax.tree_util.tree_unflatten(
+            spec_treedef, [NamedSharding(mesh, s) for s in spec_leaves]
+        )
+    return jax.jit(lambda s: jax.tree.map(jnp.copy, s), out_shardings=out_shardings)
 
 
 def _recommit_state(state: TrainState, mesh: Mesh) -> TrainState:
@@ -655,10 +808,19 @@ def _recommit_state(state: TrainState, mesh: Mesh) -> TrainState:
     unpinned_host`` on some runtimes); feeding those straight into the
     donated train step crashes XLA:CPU on its second invocation. The jitted
     copy re-materializes the state exactly as `create_train_state` does —
-    replicated sharding, device-committed buffers — so donation behaves
-    identically to the fresh-init path. Values are copied bit-exactly.
+    same sharding (replicated, or the fsdp partition the restore targeted),
+    device-committed buffers — so donation behaves identically to the
+    fresh-init path. Values are copied bit-exactly; the copy is
+    sharding-PRESERVING, never a re-replication (an fsdp state must not be
+    blown back up to a full per-chip copy by its own resume path).
     """
-    return _recommit_fn(mesh)(state)
+    specs = fsdp.specs_of(state)
+    leaves, treedef = jax.tree_util.tree_flatten(
+        specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    if all(s == P() for s in leaves):
+        return _recommit_fn(mesh)(state)  # replicated: the original path
+    return _recommit_fn(mesh, treedef, tuple(leaves))(state)
 
 
 @_bn_dtype_scoped
@@ -668,6 +830,7 @@ def train_model():
     Returns ``(final_state, best_acc1)``.
     """
     configure_determinism(cfg.CUDNN.DETERMINISTIC)  # before first backend use
+    _enable_compile_cache()
     info = setup_distributed()
     key = setup_seed(cfg.RNG_SEED, info.process_index)
     if info.is_primary:
@@ -695,7 +858,7 @@ def train_model():
             f"(failures={injector.io_failures}), nan_steps="
             f"{sorted(injector.nan_steps)}, preempt_step={injector.preempt_step}"
         )
-    mesh = data_mesh(cfg.MESH.DATA)
+    mesh = data_mesh(cfg.MESH.DATA, cfg.MESH.FSDP)
     # fleet-wide samples one optimizer step consumes — the unit elastic
     # resume remaps checkpointed sample offsets with
     samples_per_step = cfg.TRAIN.BATCH_SIZE * cfg.TRAIN.ACCUM_STEPS * int(mesh.devices.size)
@@ -722,13 +885,20 @@ def train_model():
     state, tx = create_train_state(model, init_key, mesh, cfg.TRAIN.IM_SIZE)
     logger.info(f"Model:\n{cfg.MODEL.ARCH}")
     logger.info(f"Params(M): {count_parameters(state.params):.3f}")
+    # the committed state's actual shardings are the authoritative specs the
+    # step functions carry (None on a 1-D mesh: the replicated fast path)
+    state_specs = (
+        fsdp.specs_of(state) if fsdp.fsdp_size(mesh) > 1 else None
+    )
+    _journal_state_bytes(state, mesh)
 
     train_loader = construct_train_loader(mesh)
     val_loader = construct_val_loader(mesh)
     train_step = make_train_step(
-        model, tx, mesh, cfg.TRAIN.TOPK, accum_steps=cfg.TRAIN.ACCUM_STEPS
+        model, tx, mesh, cfg.TRAIN.TOPK, accum_steps=cfg.TRAIN.ACCUM_STEPS,
+        state_specs=state_specs,
     )
-    eval_step = make_eval_step(model, mesh, cfg.TRAIN.TOPK)
+    eval_step = make_eval_step(model, mesh, cfg.TRAIN.TOPK, state_specs=state_specs)
 
     start_epoch, start_step, best_acc1 = 0, 0, 0.0
     resumed = False
@@ -844,13 +1014,17 @@ def train_model():
 def test_model():
     """Evaluation run (reference `trainer.py:176-209`)."""
     configure_determinism(cfg.CUDNN.DETERMINISTIC)
+    _enable_compile_cache()
     info = setup_distributed()
     setup_logger(cfg.OUT_DIR, info.process_index)
-    mesh = data_mesh(cfg.MESH.DATA)
+    mesh = data_mesh(cfg.MESH.DATA, cfg.MESH.FSDP)
     model = _build_cfg_model()
     key = jax.random.PRNGKey(0)
     state, _ = create_train_state(model, key, mesh, cfg.TRAIN.IM_SIZE)
     logger.info(f"Params(M): {count_parameters(state.params):.3f}")
+    state_specs = (
+        fsdp.specs_of(state) if fsdp.fsdp_size(mesh) > 1 else None
+    )
     if cfg.MODEL.WEIGHTS:
         state, _, _ = ckpt.load_checkpoint(cfg.MODEL.WEIGHTS, state)
         logger.info(f"Loaded weights from {cfg.MODEL.WEIGHTS}")
@@ -858,5 +1032,5 @@ def test_model():
         state, _, _ = ckpt.load_checkpoint(_pretrained_path(), state, load_opt=False)
         logger.info(f"Loaded pretrained weights ({cfg.MODEL.ARCH})")
     val_loader = construct_val_loader(mesh)
-    eval_step = make_eval_step(model, mesh, cfg.TRAIN.TOPK)
+    eval_step = make_eval_step(model, mesh, cfg.TRAIN.TOPK, state_specs=state_specs)
     return validate(val_loader, mesh, eval_step, state, info.is_primary)

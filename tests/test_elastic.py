@@ -34,16 +34,23 @@ if "elastic_tiny" not in list_models():
 
     class _ElasticTiny(nn.Module):
         num_classes: int = 4
+        bn_axis_name: tuple | str | None = None
 
         @nn.compact
         def __call__(self, x, train: bool = False):
             x = nn.Conv(4, (3, 3), use_bias=False, dtype=jnp.float32)(x)
-            x = nn.BatchNorm(use_running_average=not train)(x)
+            # SYNCBN (bn_axis_name set): local BN stats change with the
+            # per-device batch, so a cross-topology resume would alter the
+            # normalization semantics — synced stats make the loss stream
+            # genuinely topology-independent (the documented contract)
+            x = nn.BatchNorm(
+                use_running_average=not train, axis_name=self.bn_axis_name
+            )(x)
             return nn.Dense(self.num_classes)(nn.relu(x).mean(axis=(1, 2)))
 
     @register_model("elastic_tiny")
     def elastic_tiny(num_classes, dtype, bn_axis_name=None, remat=False):
-        return _ElasticTiny(num_classes=num_classes)
+        return _ElasticTiny(num_classes=num_classes, bn_axis_name=bn_axis_name)
 
 
 _GLOBAL_BATCH = 8  # held fixed across topologies: same sample stream
@@ -56,6 +63,7 @@ def _elastic_cfg(c, out_dir, mesh_size: int, max_epoch: int = 3):
     c.MODEL.NUM_CLASSES = 4
     c.MODEL.DTYPE = "float32"
     c.MODEL.DUMMY_INPUT = True
+    c.MODEL.SYNCBN = True  # see _ElasticTiny: required for topology-independence
     c.MESH.DATA = mesh_size
     c.TRAIN.BATCH_SIZE = _GLOBAL_BATCH // mesh_size
     c.TRAIN.IM_SIZE = 8
@@ -66,6 +74,11 @@ def _elastic_cfg(c, out_dir, mesh_size: int, max_epoch: int = 3):
     c.TRAIN.PRINT_FREQ = 1
     c.OPTIM.MAX_EPOCH = max_epoch
     c.OPTIM.WARMUP_EPOCHS = 0
+    # keep the replayed-batch loss from memorizing to ~1e-4 within the run:
+    # at the default LR the cross-topology arms end in a regime where
+    # float32 reduction-order noise (amplified over 24 steps) dominates the
+    # tight allclose, and the comparison stops being informative
+    c.OPTIM.BASE_LR = 0.01
     c.RNG_SEED = 5
     c.FAULT.HANDLE_SIGNALS = False
     c.OUT_DIR = str(out_dir)
@@ -153,9 +166,8 @@ def test_elastic_resume_matches_uninterrupted_run(fresh_cfg, tmp_path):
             # topology changed: identical sample stream and update math, but
             # pmean/psum reduction order follows the shard count — exact in
             # real arithmetic, tight-allclose in float (docs/FAULT_TOLERANCE.md).
-            # atol floor: by the end of the run the loss has memorized the
-            # replayed dummy batch down to ~1e-5, where float32 reduction
-            # noise dominates any relative comparison.
+            # atol floor: float32 reduction noise across a shard-count
+            # change; a real stream/model bug shows up as O(0.1) error
             np.testing.assert_allclose(loss_vec_a, loss_vec_r, rtol=1e-3, atol=1e-5)
             for a, b in zip(leaves_a, leaves_r):
                 np.testing.assert_allclose(a, b, rtol=1e-3, atol=2e-5)
