@@ -11,10 +11,13 @@ actually lives or dies by.
 Two halves:
 
 * **Static** (`lint_paths`, ``python -m distribuuuu_tpu.analysis`` /
-  ``dtpu-lint``): an AST pass with six JAX-specific rules (DT001–DT006, one
-  module each under :mod:`distribuuuu_tpu.analysis.rules`), inline
-  ``# dtpu-lint: disable=...`` suppressions, and a committed-baseline
-  mechanism for grandfathered findings (:mod:`.baseline`).
+  ``dtpu-lint``): an AST pass with six per-file JAX rules (DT001–DT006, one
+  module each under :mod:`distribuuuu_tpu.analysis.rules`) plus the
+  interprocedural SPMD series (DT101–DT104) backed by the repo-wide
+  call-graph/collective-summary index :class:`~.ipa.ProgramIndex`
+  (:mod:`.ipa`), inline ``# dtpu-lint: disable=...`` suppressions, and a
+  committed-baseline mechanism for grandfathered findings
+  (:mod:`.baseline`).
 * **Runtime** (:mod:`.guards`): :class:`CompileGuard` asserts an exact
   compile count over a region (a training epoch must compile its step
   exactly once) and :class:`TransferGuard` wraps ``jax.transfer_guard`` so
@@ -39,12 +42,14 @@ from distribuuuu_tpu.analysis.guards import (
     TransferGuard,
     allow_transfers,
 )
+from distribuuuu_tpu.analysis.ipa import ProgramIndex
 
 __all__ = [
     "Baseline",
     "CompileGuard",
     "CompileGuardError",
     "Finding",
+    "ProgramIndex",
     "TransferGuard",
     "all_rules",
     "allow_transfers",

@@ -6,6 +6,8 @@
     dtpu-lint --select DT001,DT005 ...                    # subset of rules
     dtpu-lint --list-rules                                # rule catalog
     dtpu-lint --format json ...                           # machine-readable
+    dtpu-lint --format github ...                         # CI inline annotations
+    dtpu-lint --stats ...                                 # per-rule wall time
 
 The baseline file defaults to ``.dtpu-lint-baseline.json`` in the current
 directory when it exists (the committed repo-root convention); pass
@@ -26,7 +28,7 @@ from distribuuuu_tpu.analysis.baseline import (
     normalize_paths,
     write_baseline,
 )
-from distribuuuu_tpu.analysis.core import all_rules, lint_paths
+from distribuuuu_tpu.analysis.core import all_rules, iter_python_files, lint_paths
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -49,11 +51,28 @@ def _build_parser() -> argparse.ArgumentParser:
         help="write the current findings as the new baseline and exit 0",
     )
     ap.add_argument(
-        "--select", default=None, help="comma-separated rule codes (e.g. DT001,DT005)"
+        "--select",
+        default=None,
+        help="comma-separated rule codes or prefixes (e.g. DT001,DT005 or DT10)",
     )
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "github"), default="text")
+    ap.add_argument(
+        "--stats",
+        action="store_true",
+        help="report per-rule wall time (and the shared parse/model/ipa passes)",
+    )
     ap.add_argument("--list-rules", action="store_true", help="print the rule catalog")
     return ap
+
+
+def _gh_escape(s: str) -> str:
+    """GitHub workflow-command escaping for the message ('data') part."""
+    return s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+
+
+def _gh_escape_prop(s: str) -> str:
+    """Escaping for property values (file=...) — also , and :."""
+    return _gh_escape(s).replace(":", "%3A").replace(",", "%2C")
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -82,8 +101,9 @@ def main(argv: list[str] | None = None) -> int:
             )
             return 2
 
+    stats: dict[str, float] | None = {} if args.stats else None
     try:
-        findings = lint_paths(args.paths, select=select)
+        findings = lint_paths(args.paths, select=select, stats=stats)
     except OSError as exc:
         print(f"dtpu-lint: {exc}", file=sys.stderr)
         return 2
@@ -97,10 +117,23 @@ def main(argv: list[str] | None = None) -> int:
     anchor = os.path.dirname(os.path.abspath(baseline_path or DEFAULT_BASELINE))
     findings = normalize_paths(findings, anchor)
 
+    if stats is not None:
+        total = sum(stats.values())
+        print(f"dtpu-lint: --stats (total {total * 1000:.0f} ms)", file=sys.stderr)
+        for key, secs in sorted(stats.items(), key=lambda kv: -kv[1]):
+            print(f"  {key:<8s} {secs * 1000:8.1f} ms", file=sys.stderr)
+
     if args.write_baseline:
         path = baseline_path or DEFAULT_BASELINE
-        write_baseline(path, findings)
-        print(f"dtpu-lint: wrote {len(findings)} finding(s) to {path}")
+        linted = {
+            os.path.relpath(os.path.abspath(p), anchor).replace(os.sep, "/")
+            for p in iter_python_files(args.paths)
+        }
+        b = write_baseline(path, findings, linted_files=linted)
+        msg = f"dtpu-lint: wrote {sum(b.counts.values())} finding(s) to {path}"
+        if b.pruned:
+            msg += f" (pruned {b.pruned} stale entr{'y' if b.pruned == 1 else 'ies'} for deleted files)"
+        print(msg)
         return 0
 
     stale: list[dict] = []
@@ -112,7 +145,32 @@ def main(argv: list[str] | None = None) -> int:
             print(f"dtpu-lint: bad baseline {baseline_path}: {exc}", file=sys.stderr)
             return 2
 
-    if args.format == "json":
+    if args.format == "github":
+        # GitHub Actions workflow commands: each finding becomes an inline
+        # annotation on the PR diff (::error file=...,line=...,col=...)
+        for f in new:
+            print(
+                f"::error file={_gh_escape_prop(f.path)},line={f.line},"
+                f"col={f.col + 1},title={_gh_escape_prop('dtpu-lint ' + f.code)}"
+                f"::{_gh_escape(f.message)}"
+            )
+        # stale entries surface as ::warning annotations so the CI job —
+        # the only github-format consumer — sees the shrink-the-baseline
+        # signal the text format prints
+        for entry in stale:
+            print(
+                f"::warning file={_gh_escape_prop(str(entry.get('path')))},"
+                f"title={_gh_escape_prop('dtpu-lint stale baseline')}"
+                f"::stale baseline entry {entry.get('code')} "
+                f"({_gh_escape(repr(entry.get('line_text', '')))}) — fixed? "
+                "regenerate with --write-baseline"
+            )
+        n_base = len(findings) - len(new)
+        summary = f"dtpu-lint: {len(new)} finding(s)"
+        if n_base:
+            summary += f" ({n_base} baselined)"
+        print(summary, file=sys.stderr)
+    elif args.format == "json":
         print(
             json.dumps(
                 {

@@ -47,6 +47,9 @@ class Baseline:
 
     counts: Counter = field(default_factory=Counter)
     meta: dict[str, dict] = field(default_factory=dict)
+    # entries dropped by the last write because their file no longer exists
+    # (write-time hygiene: stale fingerprints must not accrete forever)
+    pruned: int = 0
 
     @classmethod
     def from_findings(cls, findings: list[Finding]) -> "Baseline":
@@ -97,8 +100,41 @@ def load_baseline(path: str) -> Baseline:
     return b
 
 
-def write_baseline(path: str, findings: list[Finding]) -> Baseline:
+def write_baseline(
+    path: str,
+    findings: list[Finding],
+    linted_files: set[str] | None = None,
+) -> Baseline:
+    """Write ``findings`` as the new baseline at ``path``.
+
+    When ``linted_files`` is given (paths normalized like the findings,
+    relative to the baseline's directory), an existing baseline's entries
+    for files OUTSIDE that set are preserved — a partial-path
+    ``--write-baseline distribuuuu_tpu/`` must not silently discard the
+    grandfathered ``tests/`` entries — EXCEPT entries whose file no longer
+    exists on disk, which are pruned (counted in ``Baseline.pruned``):
+    keeping fingerprints for deleted files would grow the committed file
+    forever and mask the count-based un-suppression for any file later
+    recreated at the same path. Without ``linted_files`` the baseline is
+    regenerated purely from ``findings`` (the in-memory/test entry point).
+    """
     b = Baseline.from_findings(findings)
+    if linted_files is not None and os.path.exists(path):
+        root = os.path.dirname(os.path.abspath(path))
+        try:
+            prev = load_baseline(path)
+        except (OSError, ValueError, KeyError):
+            prev = None
+        if prev is not None:
+            for fp, cnt in prev.counts.items():
+                entry_path = prev.meta.get(fp, {}).get("path", "")
+                if not entry_path or entry_path in linted_files:
+                    continue  # covered by this run: regenerated above
+                if not os.path.exists(os.path.join(root, entry_path)):
+                    b.pruned += cnt  # file gone: stale fingerprint
+                    continue
+                b.counts[fp] += cnt
+                b.meta.setdefault(fp, prev.meta[fp])
     entries = [
         {
             "fingerprint": fp,

@@ -6,9 +6,14 @@ list[Finding]``. Rules never read files themselves — linting is a pure
 function of parsed sources, so the test corpus can feed snippets directly
 (:func:`lint_sources`).
 
-Two-pass protocol: pass 1 lets rules with cross-file context collect it
-(today only DT005's mesh-axis census, via the optional module hook
-``collect(tree, ctx)``); pass 2 runs every ``check``. Suppression is
+Two-pass protocol: pass 1 parses every file ONCE, builds one shared
+:class:`ModuleModel` per file (the single AST traversal all rules iterate),
+lets rules with cross-file context collect it (DT005's mesh-axis census, via
+the optional module hook ``collect(tree, ctx, model)``), and builds the
+interprocedural :class:`~distribuuuu_tpu.analysis.ipa.ProgramIndex`
+(``ctx.program``) the DT10x rules query; pass 2 runs every ``check`` against
+the same parsed artifacts. Per-rule wall time is accumulated into an
+optional ``stats`` dict (the CLI's ``--stats``). Suppression is
 line-anchored: ``# dtpu-lint: disable=DT001[,DT002]`` (or ``# noqa: DT001``)
 on the finding's line or the line above kills the finding at the source; the
 committed baseline (:mod:`.baseline`) grandfathers the rest.
@@ -20,8 +25,10 @@ import ast
 import hashlib
 import os
 import re
+import time
 from dataclasses import dataclass, field
 
+from distribuuuu_tpu.analysis.ipa import ProgramIndex
 from distribuuuu_tpu.analysis.rules import RULE_MODULES
 from distribuuuu_tpu.analysis.rules.common import ModuleModel
 
@@ -60,6 +67,9 @@ class LintContext:
 
     known_axes: set[str] = field(default_factory=set)
     axis_declarations: dict[str, list[str]] = field(default_factory=dict)
+    # interprocedural call-graph/summary index (analysis/ipa.py), built once
+    # per run after pass 1; the DT10x rules query it per call node
+    program: ProgramIndex | None = None
 
 
 def all_rules() -> list[dict]:
@@ -117,24 +127,59 @@ def _parse(path: str, src: str) -> tuple[ast.AST | None, Finding | None]:
         )
 
 
-def lint_sources(sources: dict[str, str], select: set[str] | None = None) -> list[Finding]:
+def lint_sources(
+    sources: dict[str, str],
+    select: set[str] | None = None,
+    stats: dict[str, float] | None = None,
+) -> list[Finding]:
     """Lint an in-memory ``{path: source}`` mapping (the test-corpus entry
     point; also what :func:`lint_paths` bottoms out in).
 
-    Both passes see ALL files, so DT005's axis census spans the whole run
-    exactly like the CLI over ``distribuuuu_tpu/ scripts/ tests/``.
+    Both passes see ALL files, so DT005's axis census and the DT10x
+    interprocedural summaries span the whole run exactly like the CLI over
+    ``distribuuuu_tpu/ scripts/ tests/``. When ``stats`` (a dict) is given,
+    per-rule wall time in seconds is accumulated into it, keyed by rule
+    code (plus ``parse``, ``model`` and ``ipa`` for the shared passes).
     """
+
+    def _timed(key: str, t0: float) -> None:
+        if stats is not None:
+            stats[key] = stats.get(key, 0.0) + (time.perf_counter() - t0)
+
     ctx = LintContext()
     parsed: dict[str, tuple[ast.AST | None, str, Finding | None]] = {}
+    models: dict[str, ModuleModel] = {}
+    t0 = time.perf_counter()
     for path, src in sources.items():
         tree, err = _parse(path, src)
         parsed[path] = (tree, src, err)
+    _timed("parse", t0)
+    t0 = time.perf_counter()
+    for path, (tree, _src, _err) in parsed.items():
+        if tree is not None:
+            # the ONE AST traversal per file: every rule iterates the
+            # model's node/call/function caches instead of re-walking
+            models[path] = ModuleModel(tree)
+    _timed("model", t0)
+    for path, (tree, src, err) in parsed.items():
         if tree is None:
             continue
         for mod in RULE_MODULES:
             collect = getattr(mod, "collect", None)
             if collect is not None:
-                collect(tree, ctx)
+                t0 = time.perf_counter()
+                collect(tree, ctx, models[path])
+                _timed(mod.CODE, t0)
+    # the interprocedural index only feeds DT101/DT102 — skip the repo-wide
+    # fixpoint when --select excludes both (prefix-matched like rule select)
+    _IPA_CODES = ("DT101", "DT102")
+    if select is None or any(c.startswith(s) for s in select for c in _IPA_CODES):
+        t0 = time.perf_counter()
+        ctx.program = ProgramIndex(
+            {p: t for p, (t, _s, _e) in parsed.items() if t is not None},
+            models=models,
+        )
+        _timed("ipa", t0)
 
     findings: list[Finding] = []
     for path, (tree, src, err) in parsed.items():
@@ -142,13 +187,17 @@ def lint_sources(sources: dict[str, str], select: set[str] | None = None) -> lis
             findings.append(err)
             continue
         assert tree is not None
-        model = ModuleModel(tree)
+        model = models[path]
         lines = src.splitlines()
         file_findings: list[Finding] = []
         for mod in RULE_MODULES:
-            if select and mod.CODE not in select:
+            # prefix match: --select DT10 runs the whole DT10x series
+            if select and not any(mod.CODE.startswith(s) for s in select):
                 continue
-            for f in mod.check(tree, model, ctx):
+            t0 = time.perf_counter()
+            rule_findings = mod.check(tree, model, ctx)
+            _timed(mod.CODE, t0)
+            for f in rule_findings:
                 text = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
                 file_findings.append(
                     Finding(
@@ -177,6 +226,19 @@ def lint_file(path: str, select: set[str] | None = None) -> list[Finding]:
         return lint_sources({path: fh.read()}, select=select)
 
 
+def lint_paths(
+    paths: list[str],
+    select: set[str] | None = None,
+    stats: dict[str, float] | None = None,
+) -> list[Finding]:
+    """Lint files/directories from disk (the CLI entry point)."""
+    sources: dict[str, str] = {}
+    for path in iter_python_files(paths):
+        with open(path, encoding="utf-8") as fh:
+            sources[os.path.normpath(path)] = fh.read()
+    return lint_sources(sources, select=select, stats=stats)
+
+
 def iter_python_files(paths: list[str]) -> list[str]:
     out: list[str] = []
     for p in paths:
@@ -191,12 +253,3 @@ def iter_python_files(paths: list[str]) -> list[str]:
                 if name.endswith(".py"):
                     out.append(os.path.join(root, name))
     return out
-
-
-def lint_paths(paths: list[str], select: set[str] | None = None) -> list[Finding]:
-    """Lint files/directories from disk (the CLI entry point)."""
-    sources: dict[str, str] = {}
-    for path in iter_python_files(paths):
-        with open(path, encoding="utf-8") as fh:
-            sources[os.path.normpath(path)] = fh.read()
-    return lint_sources(sources, select=select)

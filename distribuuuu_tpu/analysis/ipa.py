@@ -1,0 +1,519 @@
+"""Interprocedural SPMD analysis — the substrate under the DT10x rules.
+
+The DT00x rules see one function at a time; the failure modes that actually
+kill pods are *cross-function*: a ``lax.psum`` reached through two levels of
+helper (``pmean_tree`` → ``jax.lax.pmean``) under an ``if process_index()``
+guard deadlocks exactly like a direct one, and an axis-name typo passed to
+``scaled_all_reduce(..., axis_name="dta")`` never appears near a collective
+call site. Following GSPMD's observation that sharding/axis information
+propagates statically through the whole program (Xu et al. 2021) and the MPI
+static-verification line on collective matching (Vakkalanka et al.; the
+analysis behind ISP/MUST), this module builds:
+
+* a **repo-wide function index** over every linted module, keyed by
+  unqualified name (ambiguous names — two defs sharing one name — are
+  dropped: conservative, false negatives over false positives);
+* a **per-function summary**: the ordered list of collectives the function
+  issues, directly or through callees, with each collective's axis names
+  resolved to literals where possible (through literal arguments, parameter
+  defaults, and ``*_AXIS`` module constants) and to ``<param:name>``
+  placeholders where the axis arrives as an argument;
+* a **fixpoint expansion**: summaries are propagated caller-ward until
+  stable (bounded), so a collective hidden two or three helpers deep is
+  visible at the outermost call site with its axis substituted through the
+  chain;
+* per-call-site tables the rules query by node identity:
+  :meth:`ProgramIndex.collectives_at` (what collectives does this call
+  issue, transitively) and :meth:`ProgramIndex.axis_literals_at` (which
+  literal axis names does this call pass into axis-consuming positions).
+
+Known blind spots (deliberate; documented in docs/STATIC_ANALYSIS.md):
+dynamic dispatch (a function passed as a value and called through a
+parameter), method dispatch by receiver *type* (``obj.f(...)`` resolves by
+the unqualified name ``f`` with the implicit ``self``/``cls`` slot
+accounted for in binding — which class's ``f`` runs is not tracked),
+``lax.cond``/``lax.switch`` branches (traced, not Python control flow),
+and ambiguous names. Nested
+``def``s are folded into their *enclosing* function's summary — the right
+call for the dominant idiom here (collectives live in closures handed to
+``lax.scan``/``fori_loop``/``shard_map`` inside the same call), slightly
+over-approximate for factories that only *return* the closure.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from distribuuuu_tpu.analysis.rules.common import call_name, pos_key
+
+# Communicating (rendezvous) collectives: every participant over the axis
+# must issue the same sequence or the program hangs — the DT101 alphabet.
+COMM_COLLECTIVES = frozenset(
+    {
+        "psum",
+        "pmean",
+        "pmax",
+        "pmin",
+        "all_gather",
+        "psum_scatter",
+        "all_to_all",
+        "ppermute",
+        "pswapaxes",
+        # host-level rendezvous (jax.experimental.multihost_utils)
+        "sync_global_devices",
+        "broadcast_one_to_all",
+        "process_allgather",
+    }
+)
+
+# Axis-consuming ops that don't rendezvous (free queries): they validate
+# axis names (DT102) but cannot deadlock on their own (excluded from DT101).
+AXIS_QUERY_OPS = frozenset({"axis_index", "axis_size"})
+
+AXIS_OPS = COMM_COLLECTIVES | AXIS_QUERY_OPS
+
+# Position of the axis-name argument per op (value-carrying collectives take
+# it second; the queries take it first; the multihost ops have none).
+_AXIS_ARG_POS: dict[str, int] = {
+    op: 1
+    for op in (
+        "psum",
+        "pmean",
+        "pmax",
+        "pmin",
+        "all_gather",
+        "psum_scatter",
+        "all_to_all",
+        "ppermute",
+        "pswapaxes",
+    )
+}
+_AXIS_ARG_POS.update({"axis_index": 0, "axis_size": 0})
+
+_AXIS_KWARGS = ("axis_name", "axis")
+
+OPAQUE = "<?>"  # an axis atom the analysis cannot resolve to a literal
+
+_PARAM_RE = re.compile(r"^<param:(?P<name>\w+)>$")
+
+_EXPANSION_CAP = 64  # collectives kept per summary (runaway-recursion bound)
+_FIXPOINT_ROUNDS = 8  # ≥ max helper nesting depth we care to see through
+
+
+def _param_atom(name: str) -> str:
+    return f"<param:{name}>"
+
+
+def param_of_atom(atom: str) -> str | None:
+    """The parameter name behind a ``<param:...>`` placeholder atom."""
+    m = _PARAM_RE.match(atom)
+    return m.group("name") if m else None
+
+
+@dataclass(frozen=True)
+class Collective:
+    """One collective issue point in a summary.
+
+    ``axes`` is a tuple of atoms: literal axis names (``"data"``),
+    ``<param:name>`` placeholders (axis arrives as an argument), or
+    :data:`OPAQUE`. ``via`` is the helper-call chain the collective was
+    reached through (empty for a direct call); ``path``/``line``/``col``
+    locate the *underlying* collective call in its defining module.
+    """
+
+    op: str
+    axes: tuple
+    line: int
+    col: int
+    path: str
+    via: tuple = ()
+
+    @property
+    def comm(self) -> bool:
+        return self.op in COMM_COLLECTIVES
+
+    def key(self):
+        """Sequence-comparison identity (op + axes, not location)."""
+        return (self.op, self.axes)
+
+    def describe(self) -> str:
+        ax = ",".join(str(a) for a in self.axes) if self.axes else ""
+        chain = " via " + "→".join(self.via) if self.via else ""
+        return f"{self.op}({ax}){chain}"
+
+
+@dataclass
+class _HelperCall:
+    callee: str
+    node: ast.Call
+
+
+@dataclass
+class FuncInfo:
+    """Summary state for one function definition."""
+
+    name: str
+    path: str
+    node: ast.AST
+    params: tuple = ()
+    default_atoms: dict = field(default_factory=dict)
+    events: list = field(default_factory=list)  # ordered Collective | _HelperCall
+    collectives: tuple = ()  # fixpoint-expanded
+    axis_params: frozenset = frozenset()
+
+
+def axis_atoms(expr: ast.AST | None, params=(), consts=None) -> tuple:
+    """Resolve an axis-argument expression to a tuple of atoms.
+
+    Literal strings and (nested) tuples/lists of them resolve fully; names
+    that are parameters of the enclosing function become placeholders;
+    ``*_AXIS`` vocabulary constants resolve through ``consts``; everything
+    else is :data:`OPAQUE`.
+    """
+    consts = consts or {}
+    if expr is None:
+        return ()
+    if isinstance(expr, ast.Constant):
+        if isinstance(expr.value, str):
+            return (expr.value,)
+        return (OPAQUE,)
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out: list = []
+        for e in expr.elts:
+            out.extend(axis_atoms(e, params, consts))
+        return tuple(out)
+    if isinstance(expr, ast.Name):
+        if expr.id in params:
+            return (_param_atom(expr.id),)
+        if expr.id in consts:
+            return (consts[expr.id],)
+        return (OPAQUE,)
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in consts:
+            return (consts[expr.attr],)
+        return (OPAQUE,)
+    return (OPAQUE,)
+
+
+def axis_expr_of(call: ast.Call, op: str) -> ast.AST | None:
+    """The axis-argument expression of a direct collective call, if present.
+
+    Shared with DT102's tuple-member check — one place knows where each
+    op keeps its axis argument."""
+    pos = _AXIS_ARG_POS.get(op)
+    if pos is not None and len(call.args) > pos:
+        return call.args[pos]
+    for kw in call.keywords:
+        if kw.arg in _AXIS_KWARGS:
+            return kw.value
+    return None
+
+
+def _param_names(fn: ast.AST) -> tuple:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    return tuple(names)
+
+
+def _param_defaults(fn: ast.AST, consts: dict) -> dict:
+    """param -> atoms for literal string/tuple defaults (axis vocabularies)."""
+    a = fn.args
+    out: dict = {}
+    pos = a.posonlyargs + a.args
+    for p, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+        atoms = axis_atoms(d, (), consts)
+        if atoms and all(x is not OPAQUE and not param_of_atom(x) for x in atoms):
+            out[p.arg] = atoms
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if d is None:
+            continue
+        atoms = axis_atoms(d, (), consts)
+        if atoms and all(x is not OPAQUE and not param_of_atom(x) for x in atoms):
+            out[p.arg] = atoms
+    return out
+
+
+class ProgramIndex:
+    """Repo-wide call graph + collective summaries, built once per lint run."""
+
+    def __init__(self, trees: dict[str, ast.AST], models: dict | None = None):
+        self.funcs: dict[str, FuncInfo] = {}
+        self._ambiguous: set[str] = set()
+        self.consts: dict[str, str] = {}
+        # shared per-file ModuleModel node caches (analysis/core.py builds
+        # them once; standalone callers may omit and we walk ourselves)
+        self._models = models or {}
+        # per-call-node tables, keyed by id(node) (trees are shared objects)
+        self._direct: dict[int, Collective] = {}
+        self._expanded: dict[int, tuple] = {}
+        self._axis_literals: dict[int, list] = {}
+
+        self._collect_consts(trees)
+        for path, tree in trees.items():
+            self._index_module(path, tree)
+        self._fixpoint()
+        self._finalize(trees)
+
+    # -- construction --------------------------------------------------------
+
+    def _nodes_of(self, path: str, tree: ast.AST) -> list:
+        m = self._models.get(path)
+        if m is not None:
+            return m.nodes
+        return list(ast.walk(tree))
+
+    def _collect_consts(self, trees: dict[str, ast.AST]) -> None:
+        """``FSDP_AXIS = "fsdp"``-style axis-vocabulary constants, repo-wide
+        (dropped when two modules disagree on a name's value)."""
+        seen: dict[str, str] = {}
+        dropped: set[str] = set()
+        for path, tree in trees.items():
+            for node in self._nodes_of(path, tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not (
+                    isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)
+                ):
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id.endswith("_AXIS"):
+                        if t.id in seen and seen[t.id] != node.value.value:
+                            dropped.add(t.id)
+                        seen[t.id] = node.value.value
+        self.consts = {k: v for k, v in seen.items() if k not in dropped}
+
+    def _index_module(self, path: str, tree: ast.AST) -> None:
+        # module top level participates as a pseudo-function so module-level
+        # collectives/calls are classified too
+        toplevel = FuncInfo(name=f"<module:{path}>", path=path, node=tree)
+        self._extract_events(toplevel, tree, stop_at_defs=True)
+        self.funcs[toplevel.name] = toplevel
+        for node in self._nodes_of(path, tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            fi = FuncInfo(
+                name=node.name,
+                path=path,
+                node=node,
+                params=_param_names(node),
+                default_atoms=_param_defaults(node, self.consts),
+            )
+            # nested defs fold into the enclosing summary (see module doc)
+            self._extract_events(fi, node, stop_at_defs=False)
+            if node.name in self._ambiguous:
+                continue
+            if node.name in self.funcs and self.funcs[node.name].node is not node:
+                del self.funcs[node.name]
+                self._ambiguous.add(node.name)
+                continue
+            self.funcs[node.name] = fi
+
+    def _extract_events(self, fi: FuncInfo, root: ast.AST, stop_at_defs: bool) -> None:
+        stack = list(ast.iter_child_nodes(root))
+        calls: list[ast.Call] = []
+        nested_defs: set[str] = set()
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if stop_at_defs:
+                    continue
+                nested_defs.add(node.name)
+            elif stop_at_defs and isinstance(node, ast.Lambda):
+                continue
+            if isinstance(node, ast.Call):
+                calls.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        for call in sorted(calls, key=pos_key):
+            cn = call_name(call)
+            if cn is None:
+                continue
+            if cn in nested_defs:
+                # a def nested in THIS function is already folded into this
+                # summary body-inline; also expanding the call through the
+                # function index would double-count its collectives
+                continue
+            if cn in AXIS_OPS:
+                atoms = axis_atoms(axis_expr_of(call, cn), fi.params, self.consts)
+                fi.events.append(
+                    Collective(
+                        op=cn,
+                        axes=atoms,
+                        line=call.lineno,
+                        col=call.col_offset,
+                        path=fi.path,
+                    )
+                )
+            else:
+                fi.events.append(_HelperCall(callee=cn, node=call))
+
+    # -- fixpoint ------------------------------------------------------------
+
+    def _bind_args(self, callee: FuncInfo, call: ast.Call, caller: FuncInfo) -> dict:
+        """callee param -> atoms, evaluated in the caller's context."""
+        binding: dict = {}
+        # obj.f(a) bound against `def f(self, x)`: a is the SECOND param —
+        # the receiver fills the implicit first slot (an off-by-one here
+        # turned every method summary's axes opaque-or-wrong)
+        offset = (
+            1
+            if isinstance(call.func, ast.Attribute)
+            and callee.params
+            and callee.params[0] in ("self", "cls")
+            else 0
+        )
+        for i, arg in enumerate(call.args):
+            if i + offset < len(callee.params):
+                binding[callee.params[i + offset]] = axis_atoms(
+                    arg, caller.params, self.consts
+                )
+        for kw in call.keywords:
+            if kw.arg:
+                binding[kw.arg] = axis_atoms(kw.value, caller.params, self.consts)
+        return binding
+
+    def _substitute(self, c: Collective, callee: FuncInfo, binding: dict) -> tuple:
+        out: list = []
+        for atom in c.axes:
+            p = param_of_atom(atom) if isinstance(atom, str) else None
+            if p is None:
+                out.append(atom)
+            elif p in binding:
+                out.extend(binding[p])
+            elif p in callee.default_atoms:
+                out.extend(callee.default_atoms[p])
+            else:
+                out.append(OPAQUE)
+        return tuple(out)
+
+    def _expand_call(self, ev: _HelperCall, caller: FuncInfo) -> tuple:
+        callee = self.funcs.get(ev.callee)
+        if callee is None or callee is caller or not callee.collectives:
+            return ()
+        binding = self._bind_args(callee, ev.node, caller)
+        out = []
+        for c in callee.collectives:
+            out.append(
+                Collective(
+                    op=c.op,
+                    axes=self._substitute(c, callee, binding),
+                    line=c.line,
+                    col=c.col,
+                    path=c.path,
+                    via=(ev.callee,) + c.via,
+                )
+            )
+        return tuple(out)
+
+    def _fixpoint(self) -> None:
+        for _ in range(_FIXPOINT_ROUNDS):
+            changed = False
+            for fi in self.funcs.values():
+                exp: list = []
+                axis_params: set = set()
+                for ev in fi.events:
+                    if isinstance(ev, Collective):
+                        exp.append(ev)
+                    else:
+                        exp.extend(self._expand_call(ev, fi))
+                    if len(exp) >= _EXPANSION_CAP:
+                        exp = exp[:_EXPANSION_CAP]
+                        break
+                for c in exp:
+                    for atom in c.axes:
+                        p = param_of_atom(atom) if isinstance(atom, str) else None
+                        if p is not None and p in fi.params:
+                            axis_params.add(p)
+                new = tuple(exp)
+                if new != fi.collectives or frozenset(axis_params) != fi.axis_params:
+                    fi.collectives = new
+                    fi.axis_params = frozenset(axis_params)
+                    changed = True
+            if not changed:
+                break
+
+    def _finalize(self, trees: dict[str, ast.AST]) -> None:
+        """Per-call-node query tables for the rules."""
+        for fi in self.funcs.values():
+            for ev in fi.events:
+                if isinstance(ev, Collective):
+                    continue
+                node_id = id(ev.node)
+                expanded = self._expand_call(ev, fi)
+                if expanded:
+                    self._expanded[node_id] = expanded
+                callee = self.funcs.get(ev.callee)
+                if callee is not None and callee.axis_params:
+                    lits = self._literal_axis_args(callee, ev.node)
+                    if lits:
+                        self._axis_literals[node_id] = lits
+        # direct collectives: classified per call node (atoms resolved with
+        # literals/constants only — placeholder-free, for rule-side checks)
+        for path, tree in trees.items():
+            for node in self._nodes_of(path, tree):
+                if isinstance(node, ast.Call):
+                    cn = call_name(node)
+                    if cn in AXIS_OPS and id(node) not in self._direct:
+                        self._direct[id(node)] = Collective(
+                            op=cn,
+                            axes=axis_atoms(
+                                axis_expr_of(node, cn), (), self.consts
+                            ),
+                            line=node.lineno,
+                            col=node.col_offset,
+                            path=path,
+                        )
+
+    def _literal_axis_args(self, callee: FuncInfo, call: ast.Call) -> list:
+        """(axis literal, arg node) pairs this call passes into the callee's
+        axis-consuming parameters — the DT102 helper-indirection check."""
+        out: list = []
+
+        def literals(expr: ast.AST):
+            if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+                yield expr.value, expr
+            elif isinstance(expr, (ast.Tuple, ast.List)):
+                for e in expr.elts:
+                    yield from literals(e)
+
+        offset = (
+            1
+            if isinstance(call.func, ast.Attribute)
+            and callee.params
+            and callee.params[0] in ("self", "cls")
+            else 0
+        )
+        for i, arg in enumerate(call.args):
+            j = i + offset
+            if j < len(callee.params) and callee.params[j] in callee.axis_params:
+                out.extend(literals(arg))
+        for kw in call.keywords:
+            if kw.arg in callee.axis_params and kw.value is not None:
+                out.extend(literals(kw.value))
+        return out
+
+    # -- queries -------------------------------------------------------------
+
+    def direct_collective(self, call: ast.Call) -> Collective | None:
+        """The collective this call node IS (``lax.psum(...)``), else None."""
+        return self._direct.get(id(call))
+
+    def collectives_at(self, call: ast.Call) -> tuple:
+        """Everything this call node issues: itself when it is a collective,
+        or its resolved callee's expanded summary (empty when unresolved)."""
+        d = self._direct.get(id(call))
+        if d is not None:
+            return (d,)
+        return self._expanded.get(id(call), ())
+
+    def comm_collectives_at(self, call: ast.Call) -> tuple:
+        return tuple(c for c in self.collectives_at(call) if c.comm)
+
+    def axis_literals_at(self, call: ast.Call) -> list:
+        """Literal axis names this (helper) call passes into axis params."""
+        return self._axis_literals.get(id(call), [])
+
+    def summary(self, name: str) -> FuncInfo | None:
+        return self.funcs.get(name)

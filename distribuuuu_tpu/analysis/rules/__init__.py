@@ -15,6 +15,10 @@ from distribuuuu_tpu.analysis.rules import (
     dt004_donation,
     dt005_sharding,
     dt006_timing,
+    dt101_collective,
+    dt102_axis_validity,
+    dt103_spec_shape,
+    dt104_precision,
 )
 
 RULE_MODULES = [
@@ -24,6 +28,10 @@ RULE_MODULES = [
     dt004_donation,
     dt005_sharding,
     dt006_timing,
+    dt101_collective,
+    dt102_axis_validity,
+    dt103_spec_shape,
+    dt104_precision,
 ]
 
 __all__ = ["RULE_MODULES"]

@@ -161,13 +161,19 @@ def names_in(node: ast.AST) -> set[str]:
 
 
 class ParentMap:
-    """Child → parent links plus ancestor queries."""
+    """Child → parent links plus ancestor queries.
+
+    Also records the walk's node list (``nodes``) so the one traversal that
+    builds the links doubles as the shared node cache every rule iterates —
+    rules never re-``ast.walk`` whole modules (the --stats satellite)."""
 
     def __init__(self, tree: ast.AST):
         self._parent: dict[ast.AST, ast.AST] = {}
+        self.nodes: list[ast.AST] = [tree]
         for parent in ast.walk(tree):
             for child in ast.iter_child_nodes(parent):
                 self._parent[child] = parent
+                self.nodes.append(child)
 
     def parent(self, node: ast.AST) -> ast.AST | None:
         return self._parent.get(node)
@@ -203,11 +209,11 @@ def _walk_skipping_nested_defs(fn: ast.AST):
             stack.extend(ast.iter_child_nodes(node))
 
 
-def _jax_random_aliases(tree: ast.AST) -> tuple[set[str], set[str]]:
+def _jax_random_aliases(nodes) -> tuple[set[str], set[str]]:
     """(module aliases for jax.random, bare names imported from it)."""
     mod_aliases = {"jax.random"}
     bare: set[str] = set()
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, ast.Import):
             for a in node.names:
                 if a.name == "jax.random" and a.asname:
@@ -223,9 +229,9 @@ def _jax_random_aliases(tree: ast.AST) -> tuple[set[str], set[str]]:
     return mod_aliases, bare
 
 
-def _partition_spec_aliases(tree: ast.AST) -> set[str]:
+def _partition_spec_aliases(nodes) -> set[str]:
     names = {"PartitionSpec"}
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, ast.ImportFrom) and node.module in {
             "jax.sharding",
             "jax.experimental.pjit",
@@ -257,20 +263,35 @@ class ModuleModel:
     jax_random_modules: set[str] = field(default_factory=set)
     jax_random_bare: set[str] = field(default_factory=set)
     pspec_names: set[str] = field(default_factory=set)
+    # shared single-walk caches: rules iterate these instead of re-walking
+    # the module tree (one ast traversal total per file, in ParentMap)
+    nodes: list = field(default_factory=list)
+    calls: list = field(default_factory=list)
+    functions: list = field(default_factory=list)
+    # per-function-subtree node lists, memoized on first use: DT002/DT006/
+    # DT104 all scan the same function bodies — one walk, shared
+    _scope_cache: dict = field(default_factory=dict)
 
     def __post_init__(self) -> None:
         self.parents = ParentMap(self.tree)
-        self.jax_random_modules, self.jax_random_bare = _jax_random_aliases(self.tree)
-        self.pspec_names = _partition_spec_aliases(self.tree)
+        self.nodes = self.parents.nodes
+        self.calls = [n for n in self.nodes if isinstance(n, ast.Call)]
+        self.functions = [
+            n
+            for n in self.nodes
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        self.jax_random_modules, self.jax_random_bare = _jax_random_aliases(
+            self.nodes
+        )
+        self.pspec_names = _partition_spec_aliases(self.nodes)
         self._collect_factories()
         self._collect_bindings()
 
     # -- inference -----------------------------------------------------------
 
     def _collect_factories(self) -> None:
-        for node in ast.walk(self.tree):
-            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
+        for node in self.functions:
             # only returns lexically belonging to THIS function: an outer
             # function merely containing a nested jit-returning helper is
             # not itself a factory (its own return value is something else)
@@ -280,7 +301,7 @@ class ModuleModel:
                     break
 
     def _collect_bindings(self) -> None:
-        for node in ast.walk(self.tree):
+        for node in self.nodes:
             if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
                 continue
             call = node.value
@@ -308,6 +329,15 @@ class ModuleModel:
                         device.add(t)
 
     # -- queries -------------------------------------------------------------
+
+    def scope_nodes(self, fn: ast.AST) -> list:
+        """All descendant nodes of ``fn`` (inclusive), walked once and
+        memoized — the shared scan list for per-scope rules."""
+        lst = self._scope_cache.get(id(fn))
+        if lst is None:
+            lst = list(ast.walk(fn))
+            self._scope_cache[id(fn)] = lst
+        return lst
 
     def is_dispatch_call(self, call: ast.Call) -> bool:
         """Call that launches device work: jit-bound name or step-named."""
@@ -392,7 +422,92 @@ def _is_boundary_test(test: ast.AST) -> bool:
     return False
 
 
+def str_elts(node: ast.AST):
+    """String-constant nodes in an expression that may be a bare str or a
+    (nested) tuple/list of them — the P(...)/``axis_names`` vocabulary
+    walker shared by DT005 and DT102."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            yield from str_elts(e)
+
+
+def is_pspec_call(node: ast.AST, model: "ModuleModel") -> bool:
+    """``PartitionSpec(...)`` / ``P(...)`` / ``jax.sharding.PartitionSpec(...)``
+    construction — the one predicate DT005/DT102/DT103 all share."""
+    return isinstance(node, ast.Call) and (
+        (isinstance(node.func, ast.Name) and node.func.id in model.pspec_names)
+        or (call_name(node) or "").endswith("PartitionSpec")
+    )
+
+
+def scoped_unique_binding(
+    name: str, use: ast.AST, model: "ModuleModel"
+) -> ast.AST | None:
+    """The value expression of the single ``Assign`` binding ``name`` that is
+    *visible at* ``use`` — scope-aware and conservative.
+
+    Returns None when the name is a parameter of the enclosing function
+    (shadowed: a ``def f(mesh)`` parameter must never resolve to some other
+    function's local ``mesh``), when it is bound more than once module-wide
+    (rebound or reused across scopes), or when its one binding lives inside
+    a *different* function's body. A unique module-level binding is visible
+    everywhere; a unique binding in the same function is visible there.
+    """
+    scope = model.enclosing_function(use)
+    if scope is not None:
+        a = scope.args
+        params = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+        if a.vararg is not None:
+            params.add(a.vararg.arg)
+        if a.kwarg is not None:
+            params.add(a.kwarg.arg)
+        if name in params:
+            return None
+    bindings = [
+        n
+        for n in model.nodes
+        if isinstance(n, ast.Assign)
+        and any(isinstance(t, ast.Name) and t.id == name for t in n.targets)
+    ]
+    if len(bindings) != 1:
+        return None
+    b_scope = model.enclosing_function(bindings[0])
+    if b_scope is not None and b_scope is not scope:
+        return None
+    return bindings[0].value
+
+
 def iter_functions(tree: ast.AST):
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             yield node
+
+
+def resolve_local_callable(
+    call: ast.Call, model: "ModuleModel"
+) -> ast.FunctionDef | ast.Lambda | None:
+    """The local def/lambda a higher-order call's first argument names.
+
+    Shared by DT005 (shard_map arity) and DT102 (shard_map axis scope):
+    for ``shard_map(f, ...)`` with ``f`` a lambda, that lambda; with ``f``
+    a name, the *nearest preceding* def of that name — modules reuse local
+    names like ``step``/``body`` across factory functions, so the lexically
+    closest definition before the call site is the one in scope."""
+    if not call.args:
+        return None
+    target = call.args[0]
+    if isinstance(target, ast.Lambda):
+        return target
+    if not isinstance(target, ast.Name):
+        return None
+    fn = None
+    best_pos = None
+    call_pos = pos_key(call)
+    for cand in model.functions:
+        if isinstance(cand, ast.FunctionDef) and cand.name == target.id:
+            p = pos_key(cand)
+            if p < call_pos and (best_pos is None or p > best_pos):
+                fn, best_pos = cand, p
+    return fn

@@ -57,7 +57,7 @@ def check(tree: ast.AST, model: ModuleModel, ctx) -> list[RawFinding]:
     findings: list[RawFinding] = []
     step_loops = [
         n
-        for n in ast.walk(tree)
+        for n in model.nodes
         if isinstance(n, (ast.For, ast.While)) and model.is_step_loop(n)
     ]
     seen: set[tuple[int, int]] = set()

@@ -26,7 +26,6 @@ from distribuuuu_tpu.analysis.rules.common import (
     ModuleModel,
     RawFinding,
     assign_target_names,
-    iter_functions,
     pos_key,
 )
 
@@ -43,12 +42,12 @@ def check(tree: ast.AST, model: ModuleModel, ctx) -> list[RawFinding]:
 
 def _check_reuse_after_split(tree: ast.AST, model: ModuleModel) -> list[RawFinding]:
     findings: list[RawFinding] = []
-    for scope in iter_functions(tree):
+    for scope in model.functions:
         # (key name, position, ids of the split call's own descendant nodes)
         splits: list[tuple[str, tuple[int, int], set[int]]] = []
         rebinds: dict[str, list[tuple[int, int]]] = {}
         uses: list[tuple[str, int, tuple[int, int]]] = []
-        for node in ast.walk(scope):
+        for node in model.scope_nodes(scope):
             if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.For)):
                 for t in assign_target_names(node):
                     rebinds.setdefault(t, []).append(pos_key(node))
@@ -93,9 +92,7 @@ def _check_reuse_after_split(tree: ast.AST, model: ModuleModel) -> list[RawFindi
 
 def _check_literal_seed_in_loop(tree: ast.AST, model: ModuleModel) -> list[RawFinding]:
     findings: list[RawFinding] = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
+    for node in model.calls:
         fn = model.is_jax_random_call(node)
         if fn not in {"PRNGKey", "key"}:
             continue

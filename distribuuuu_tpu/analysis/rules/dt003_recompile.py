@@ -52,9 +52,7 @@ _HOST_VARYING = {
 
 def check(tree: ast.AST, model: ModuleModel, ctx) -> list[RawFinding]:
     findings: list[RawFinding] = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
+    for node in model.calls:
         # (a) jit construction inside a loop BODY (the iter/test expression
         # of a for/while evaluates once — constructing there is fine)
         if is_jit_call(node) and _in_loop_body(node, model):
@@ -118,14 +116,12 @@ def _traced_defs(tree: ast.AST, model: ModuleModel) -> list[ast.FunctionDef]:
     """Defs that are jitted/shard_mapped: by decorator, or by name passed to
     jax.jit / shard_map anywhere in the module."""
     jitted_names: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call) and (is_jit_call(node) or is_shard_map_call(node)):
+    for node in model.calls:
+        if is_jit_call(node) or is_shard_map_call(node):
             if node.args and isinstance(node.args[0], ast.Name):
                 jitted_names.add(node.args[0].id)
     out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
+    for node in model.functions:
         if node.name in jitted_names:
             out.append(node)
             continue

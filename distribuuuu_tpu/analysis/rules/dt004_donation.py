@@ -43,14 +43,14 @@ def check(tree: ast.AST, model: ModuleModel, ctx) -> list[RawFinding]:
     if not donated_fns:
         return []
     findings: list[RawFinding] = []
-    for block in _blocks(tree):
+    for block in _blocks(model.nodes):
         findings.extend(_check_block(block, donated_fns))
     return findings
 
 
-def _blocks(tree: ast.AST):
+def _blocks(nodes):
     """Every statement list in the module (function bodies, loop bodies...)."""
-    for node in ast.walk(tree):
+    for node in nodes:
         for field in ("body", "orelse", "finalbody"):
             stmts = getattr(node, field, None)
             if isinstance(stmts, list) and stmts and isinstance(stmts[0], ast.stmt):

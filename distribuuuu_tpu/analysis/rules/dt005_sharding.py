@@ -33,9 +33,10 @@ from distribuuuu_tpu.analysis.rules.common import (
     ModuleModel,
     RawFinding,
     call_name,
-    dotted,
+    is_pspec_call,
     is_shard_map_call,
-    pos_key,
+    resolve_local_callable,
+    str_elts,
 )
 
 CODE = "DT005"
@@ -57,29 +58,20 @@ _COLLECTIVES = {
 _AXIS_KWARGS = {"axis_name", "bn_axis_name"}
 
 
-def _str_elts(node: ast.AST):
-    """String constants in a node that may be a str or (nested) tuple/list."""
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        yield node
-    elif isinstance(node, (ast.Tuple, ast.List)):
-        for e in node.elts:
-            yield from _str_elts(e)
-
-
-def collect(tree: ast.AST, ctx) -> None:
+def collect(tree: ast.AST, ctx, model: ModuleModel) -> None:
     """Pass 1: harvest declared axis names into ``ctx.known_axes``."""
+    nodes = model.nodes  # the shared single-walk cache (no re-walk)
     # names this module passes to create_mesh as the axes dict — dict
     # literals assigned to them declare their keys (data_mesh builds the
     # ('data', 'fsdp') dict in a variable before the call)
     mesh_arg_names: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call):
-            cn = call_name(node) or ""
-            if cn in {"create_mesh", "create_hybrid_device_mesh"}:
-                for arg in node.args:
-                    if isinstance(arg, ast.Name):
-                        mesh_arg_names.add(arg.id)
-    for node in ast.walk(tree):
+    for node in model.calls:
+        cn = call_name(node) or ""
+        if cn in {"create_mesh", "create_hybrid_device_mesh"}:
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    mesh_arg_names.add(arg.id)
+    for node in nodes:
         if isinstance(node, (ast.Assign, ast.AnnAssign)):
             value = node.value
             targets = node.targets if isinstance(node, ast.Assign) else [node.target]
@@ -98,7 +90,7 @@ def collect(tree: ast.AST, ctx) -> None:
                     for k in value.keys:
                         if isinstance(k, ast.Constant) and isinstance(k.value, str):
                             ctx.known_axes.add(k.value)
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, ast.Call):
             cn = call_name(node) or ""
             # create_mesh({"data": -1, "seq": 4})
@@ -111,11 +103,11 @@ def collect(tree: ast.AST, ctx) -> None:
             # Mesh(devices, ("data", "model")) / axis_names=(...)
             if cn == "Mesh":
                 if len(node.args) >= 2:
-                    for s in _str_elts(node.args[1]):
+                    for s in str_elts(node.args[1]):
                         ctx.known_axes.add(s.value)
             for kw in node.keywords:
                 if kw.arg == "axis_names":
-                    for s in _str_elts(kw.value):
+                    for s in str_elts(kw.value):
                         ctx.known_axes.add(s.value)
         # def f(..., axis_name: str = "seq"): library default declares "seq"
         elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -138,16 +130,12 @@ def collect(tree: ast.AST, ctx) -> None:
 def check(tree: ast.AST, model: ModuleModel, ctx) -> list[RawFinding]:
     findings: list[RawFinding] = []
     known = ctx.known_axes
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
+    for node in model.calls:
         cn = call_name(node) or ""
         # PartitionSpec("data", None, ...) strings
-        if isinstance(node.func, ast.Name) and node.func.id in model.pspec_names or (
-            dotted(node.func) or ""
-        ).endswith("PartitionSpec"):
+        if is_pspec_call(node, model):
             for arg in node.args:
-                for s in _str_elts(arg):
+                for s in str_elts(arg):
                     if known and s.value not in known:
                         findings.append(_unknown_axis(s, s.value, "PartitionSpec"))
             continue
@@ -190,23 +178,10 @@ def _positional_arity(fn: ast.FunctionDef | ast.Lambda) -> tuple[int, bool]:
 def _check_shard_map_arity(node: ast.Call, model: ModuleModel) -> list[RawFinding]:
     if not node.args:
         return []
-    target = node.args[0]
-    fn: ast.FunctionDef | ast.Lambda | None = None
-    if isinstance(target, ast.Lambda):
-        fn = target
-    elif isinstance(target, ast.Name):
-        # nearest preceding def with that name: modules reuse local names
-        # like `step`/`body` across factory functions, so the lexically
-        # closest definition before the call site is the one in scope
-        best_pos = None
-        call_pos = pos_key(node)
-        for cand in ast.walk(model.tree):
-            if isinstance(cand, ast.FunctionDef) and cand.name == target.id:
-                p = pos_key(cand)
-                if p < call_pos and (best_pos is None or p > best_pos):
-                    fn, best_pos = cand, p
+    fn = resolve_local_callable(node, model)
     if fn is None:
         return []
+    target = node.args[0]
     in_specs = None
     for kw in node.keywords:
         if kw.arg == "in_specs":

@@ -26,7 +26,6 @@ from distribuuuu_tpu.analysis.rules.common import (
     RawFinding,
     dotted,
     call_name,
-    iter_functions,
     pos_key,
 )
 
@@ -59,15 +58,16 @@ def _is_sync_call(node: ast.Call, model: ModuleModel) -> bool:
 
 def check(tree: ast.AST, model: ModuleModel, ctx) -> list[RawFinding]:
     findings: list[RawFinding] = []
-    for scope in iter_functions(tree):
+    for scope in model.functions:
         findings.extend(_check_scope(scope, model))
     return findings
 
 
 def _check_scope(scope: ast.AST, model: ModuleModel) -> list[RawFinding]:
     # timestamp bindings: t0 = time.perf_counter()
+    nodes = model.scope_nodes(scope)
     stamps: dict[str, tuple[int, int]] = {}
-    for node in ast.walk(scope):
+    for node in nodes:
         if isinstance(node, ast.Assign) and _is_clock_call(node.value):
             for t in node.targets:
                 if isinstance(t, ast.Name):
@@ -76,7 +76,7 @@ def _check_scope(scope: ast.AST, model: ModuleModel) -> list[RawFinding]:
         return []
     # closing expressions: <clock call> - t0
     closes: list[tuple[str, ast.BinOp]] = []
-    for node in ast.walk(scope):
+    for node in nodes:
         if (
             isinstance(node, ast.BinOp)
             and isinstance(node.op, ast.Sub)
@@ -94,7 +94,7 @@ def _check_scope(scope: ast.AST, model: ModuleModel) -> list[RawFinding]:
             continue  # loop-carried reuse; linear span only
         dispatch = None
         synced = False
-        for node in ast.walk(scope):
+        for node in nodes:
             if not isinstance(node, ast.Call):
                 continue
             p = pos_key(node)

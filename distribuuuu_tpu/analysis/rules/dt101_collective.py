@@ -1,0 +1,171 @@
+"""DT101: collective consistency — static deadlock detection.
+
+A communicating collective (``psum``/``pmean``/``all_gather``/
+``all_to_all``/``ppermute``/``psum_scatter``/``sync_global_devices``/...)
+must be issued by *every* participant over its axis, in the same order, or
+the fleet hangs in the rendezvous. The runtime watchdog (PR 4) diagnoses
+that hang after ``FAULT.HANG_TIMEOUT_S`` seconds of lost goodput; this rule
+is the static form — the two statically-visible ways to write the hang:
+
+* **Rank-varying guard** (the MPI-verification "collective under a
+  rank-dependent conditional"): a collective reachable — directly or
+  through helper functions, resolved by the interprocedural summaries in
+  :mod:`distribuuuu_tpu.analysis.ipa` — only under an ``if`` whose test
+  depends on *which host/rank is asking*: ``jax.process_index()``,
+  ``is_master``/``is_primary``-style flags, ``rank`` comparisons, or
+  per-host environment reads. Only rank 0 (say) enters the collective; the
+  other hosts never show up; the job is dead. Guards that are uniform
+  across hosts (``process_count() == 1``, ``axis_size(...) == 1``, config
+  flags) are fine and not flagged.
+
+* **Divergent branches**: an ``if``/``else`` whose two branches issue
+  *different* collective sequences (including through helpers). If the test
+  could ever disagree between participants, the two sides rendezvous
+  different programs. Branches where only ONE side has collectives are
+  flagged solely under a rank-varying test (the common
+  ``if world > 1: pmean`` gate is uniform and legal).
+
+Blind spots (docs/STATIC_ANALYSIS.md): value-level host variance the
+syntax doesn't show (a seed drawn from ``os.urandom`` then branched on),
+``lax.cond`` branches (traced — both sides compile), dynamic dispatch.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from distribuuuu_tpu.analysis.rules.common import (
+    ModuleModel,
+    RawFinding,
+    call_name,
+    dotted,
+)
+
+CODE = "DT101"
+AUTOFIXABLE = False
+
+# Atoms whose presence in an `if` test marks it rank-/host-varying. NB:
+# deliberately does NOT match process_count/device_count (uniform).
+_RANK_NAME_RE = re.compile(
+    r"(^|_)(rank|is_master|is_primary|is_main|is_chief|host_id|proc_id)($|_)"
+    r"|process_index|process_id|local_rank|global_rank"
+)
+_ENV_READS = {"os.environ", "os.getenv", "environ.get", "os.environb"}
+
+
+def _rank_varying(test: ast.AST) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Call):
+            d = dotted(n.func) or ""
+            cn = call_name(n) or ""
+            if _RANK_NAME_RE.search(cn) or d in _ENV_READS:
+                return True
+        elif isinstance(n, ast.Name) and _RANK_NAME_RE.search(n.id):
+            return True
+        elif isinstance(n, ast.Attribute):
+            if _RANK_NAME_RE.search(n.attr):
+                return True
+            if (dotted(n) or "") in _ENV_READS:
+                return True
+        elif isinstance(n, ast.Subscript):
+            if (dotted(n.value) or "").endswith("environ"):
+                return True
+    return False
+
+
+def _comm_seq(stmts: list, prog) -> tuple:
+    """Ordered (op, axes) keys of communicating collectives reachable from a
+    statement list, through helper summaries, skipping nested defs."""
+    out: list = []
+    stack = list(stmts)
+    calls: list = []
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            calls.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    calls.sort(key=lambda n: (n.lineno, n.col_offset))
+    for call in calls:
+        for c in prog.comm_collectives_at(call):
+            out.append(c.key())
+    return tuple(out)
+
+
+def check(tree: ast.AST, model: ModuleModel, ctx) -> list[RawFinding]:
+    prog = getattr(ctx, "program", None)
+    if prog is None:
+        return []
+    findings: list[RawFinding] = []
+
+    # (1) collective under a rank-varying guard — direct or through helpers
+    for call in model.calls:
+        comm = prog.comm_collectives_at(call)
+        if not comm:
+            continue
+        prev: ast.AST = call
+        guard = None
+        divergent = False
+        for anc in model.parents.ancestors(call):
+            if isinstance(anc, ast.If) and prev is not anc.test and _rank_varying(anc.test):
+                if anc.orelse:
+                    a = _comm_seq(anc.body, prog)
+                    b = _comm_seq(anc.orelse, prog)
+                    # an else-branch issuing the IDENTICAL collective
+                    # sequence means the rendezvous happens on every path —
+                    # this `if` only varies values, so keep climbing: an
+                    # ENCLOSING rank guard can still starve the rendezvous
+                    if a == b:
+                        prev = anc
+                        continue
+                    # both branches communicate but differently: ONE defect
+                    # at the `if`, reported once by check (2) below — not
+                    # once per collective call per branch
+                    if a and b:
+                        divergent = True
+                        break
+                guard = anc
+                break
+            prev = anc
+        if divergent:
+            continue
+        if guard is not None:
+            c = comm[0]
+            findings.append(
+                RawFinding(
+                    call.lineno,
+                    call.col_offset,
+                    CODE,
+                    f"collective `{c.describe()}` is reachable only under a "
+                    "rank-/host-varying guard (line "
+                    f"{guard.test.lineno}): the other participants never "
+                    "enter the rendezvous — this is the static form of the "
+                    "hang the runtime watchdog diagnoses at timeout. Hoist "
+                    "the collective out of the guard, or make the guard "
+                    "uniform across hosts",
+                )
+            )
+
+    # (2) if/else branches issuing different collective sequences
+    for node in model.nodes:
+        if not isinstance(node, ast.If) or not node.orelse:
+            continue
+        a = _comm_seq(node.body, prog)
+        b = _comm_seq(node.orelse, prog)
+        if a and b and a != b:
+            findings.append(
+                RawFinding(
+                    node.lineno,
+                    node.col_offset,
+                    CODE,
+                    "the two branches of this conditional issue different "
+                    f"collective sequences ({len(a)} vs {len(b)} op(s)): if "
+                    "the test can ever disagree across participants, the "
+                    "branches rendezvous different programs and the job "
+                    "hangs — make both branches issue the same collective "
+                    "order, or prove the test uniform and suppress",
+                )
+            )
+    return findings

@@ -1,0 +1,222 @@
+"""DT102: axis-name validity — interprocedural and scope-aware.
+
+DT005's census check covers a bare axis string at a direct collective call.
+This rule covers the three shapes that slip past it:
+
+* **Joint-axis tuples**: ``lax.pmean(x, ("data", "fsdpp"))`` — each member
+  of a tuple/list axis argument is checked against the repo-wide mesh-axis
+  census (DT005's pass-1 product). This is where the ``("data", "fsdp")``
+  joint reductions live; a typo'd or forgotten member reduces over the
+  wrong fleet subset.
+* **Helper indirection**: a literal axis passed to a *repo function* whose
+  interprocedural summary (:mod:`..ipa`) shows that parameter flowing into
+  collective axis positions — ``pmean_tree(grads, "dta")`` is an axis typo
+  even though no ``lax.*`` call is in sight.
+* **shard_map axis scope**: inside a ``shard_map`` whose mesh is
+  module-locally resolvable (``mesh=create_mesh({"data": ..., "seq": ...})``
+  or a name bound to one), every axis used by the body — collectives,
+  direct or through helpers — and every ``PartitionSpec`` string in
+  ``in_specs``/``out_specs`` must be an axis *that mesh actually binds*.
+  An axis that exists somewhere in the repo census but not in this mesh is
+  unbound in scope: a trace error at best, a silent wrong-group reduction
+  at worst. Calls whose mesh is opaque (a function parameter) are skipped —
+  conservative, like everything here.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from distribuuuu_tpu.analysis.rules.common import (
+    ModuleModel,
+    RawFinding,
+    call_name,
+    is_pspec_call,
+    is_shard_map_call,
+    resolve_local_callable,
+    scoped_unique_binding,
+    str_elts,
+)
+
+CODE = "DT102"
+AUTOFIXABLE = False
+
+_SPEC_KWARGS = {"in_specs", "out_specs"}
+
+
+def _unknown(node: ast.AST, axis: str, where: str, universe: str) -> RawFinding:
+    return RawFinding(
+        node.lineno,
+        node.col_offset,
+        CODE,
+        f"axis name {axis!r} in `{where}` is not {universe}; typo or missing "
+        "mesh axis",
+    )
+
+
+def _tuple_axis_literals(call: ast.Call, prog) -> list:
+    """(axis, node) for literal members of tuple/list axis arguments of a
+    direct collective (bare string constants are DT005's territory)."""
+    from distribuuuu_tpu.analysis.ipa import axis_expr_of
+
+    e = axis_expr_of(call, call_name(call) or "")
+    if not isinstance(e, (ast.Tuple, ast.List)):
+        return []
+    return [
+        (elt.value, elt)
+        for elt in e.elts
+        if isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+    ]
+
+
+def _mesh_axes_of(call: ast.Call, model: ModuleModel) -> set[str] | None:
+    """Literal axis set of the shard_map's mesh, when module-locally
+    resolvable; None when opaque."""
+    mesh_expr = None
+    for kw in call.keywords:
+        if kw.arg == "mesh":
+            mesh_expr = kw.value
+    if mesh_expr is None:
+        return None
+    return _axes_from_expr(mesh_expr, model, depth=0)
+
+
+def _axes_from_expr(expr: ast.AST, model: ModuleModel, depth: int) -> set[str] | None:
+    if depth > 3:
+        return None
+    if isinstance(expr, ast.Call):
+        cn = call_name(expr) or ""
+        if cn in {"create_mesh", "create_hybrid_device_mesh"}:
+            for arg in expr.args:
+                if isinstance(arg, ast.Dict):
+                    keys = set()
+                    for k in arg.keys:
+                        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                            keys.add(k.value)
+                        else:
+                            return None
+                    return keys
+            return None
+        if cn == "Mesh" and len(expr.args) >= 2:
+            names = set()
+            arg = expr.args[1]
+            if isinstance(arg, (ast.Tuple, ast.List)):
+                for e in arg.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                        names.add(e.value)
+                    else:
+                        return None
+                return names
+        return None
+    if isinstance(expr, ast.Name):
+        bound = scoped_unique_binding(expr.id, expr, model)
+        if bound is None:
+            return None  # parameter, rebound, or other-scope: conservative
+        return _axes_from_expr(bound, model, depth + 1)
+    return None
+
+
+def _body_axis_uses(fn: ast.AST, prog) -> list:
+    """(axis literal, node, where) used by a shard_map body, through helper
+    summaries; only fully-literal atoms participate."""
+    out = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        direct = prog.direct_collective(node)
+        if direct is not None:
+            for atom in direct.axes:
+                if atom and not atom.startswith("<"):
+                    out.append((atom, node, direct.op))
+            continue
+        for c in prog.collectives_at(node):
+            for atom in c.axes:
+                if atom and not atom.startswith("<"):
+                    out.append((atom, node, c.describe()))
+        for axis, arg_node in prog.axis_literals_at(node):
+            out.append((axis, arg_node, call_name(node) or "call"))
+    return out
+
+
+def check(tree: ast.AST, model: ModuleModel, ctx) -> list[RawFinding]:
+    prog = getattr(ctx, "program", None)
+    if prog is None:
+        return []
+    findings: list[RawFinding] = []
+    known = ctx.known_axes
+
+    for call in model.calls:
+        if prog.direct_collective(call) is not None:
+            if known:
+                for axis, node in _tuple_axis_literals(call, prog):
+                    if axis not in known:
+                        findings.append(
+                            _unknown(
+                                node,
+                                axis,
+                                call_name(call) or "collective",
+                                "declared by any mesh in the linted tree",
+                            )
+                        )
+            continue
+        if known:
+            # literal axis into a helper whose summary reaches collectives
+            for axis, node in prog.axis_literals_at(call):
+                if axis not in known:
+                    findings.append(
+                        _unknown(
+                            node,
+                            axis,
+                            f"{call_name(call)} (axis flows to a collective "
+                            "in its summary)",
+                            "declared by any mesh in the linted tree",
+                        )
+                    )
+
+    # shard_map axis scope
+    for call in model.calls:
+        if not is_shard_map_call(call):
+            continue
+        axes = _mesh_axes_of(call, model)
+        if not axes:
+            continue
+        for kw in call.keywords:
+            if kw.arg in _SPEC_KWARGS:
+                for n in ast.walk(kw.value):
+                    if is_pspec_call(n, model):
+                        for arg in n.args:
+                            for s in str_elts(arg):
+                                if s.value in axes:
+                                    continue
+                                if known and s.value not in known:
+                                    continue  # DT005's census reports it
+                                findings.append(
+                                    _unknown(
+                                        s,
+                                        s.value,
+                                        kw.arg,
+                                        f"bound by this shard_map's mesh "
+                                        f"(axes: {sorted(axes)})",
+                                    )
+                                )
+        fn = resolve_local_callable(call, model)
+        if fn is None:
+            continue
+        for axis, node, where in _body_axis_uses(fn, prog):
+            if axis in axes:
+                continue
+            if known and axis not in known:
+                # globally unknown axis: the census checks above (or DT005,
+                # for a bare string at a direct collective) already report
+                # it — one typo must not stack a second annotation here
+                continue
+            findings.append(
+                _unknown(
+                    node,
+                    axis,
+                    where,
+                    f"bound by the enclosing shard_map's mesh "
+                    f"(axes: {sorted(axes)})",
+                )
+            )
+    return findings

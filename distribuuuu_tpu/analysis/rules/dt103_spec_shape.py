@@ -1,0 +1,286 @@
+"""DT103: PartitionSpec arity and divisibility against known shapes.
+
+A ``PartitionSpec`` is only checkable against the array it shards — which a
+per-file linter almost never sees. Three cases ARE statically visible, and
+each is a trace-time (or worse, silent-layout) failure on the pod:
+
+* **Duplicate axis in one spec**: ``P("data", "data")`` — a mesh axis may
+  shard at most one dimension of an array; JAX rejects this at use time,
+  hours after submit.
+* **Spec arity > array rank** at an immediately-applied
+  ``shard_map(...)(args)`` or a ``device_put(x, NamedSharding(mesh, P(...)))``
+  where the argument's rank is inferable from a literal-shape constructor
+  (``jnp.zeros((a, b))``, ``rng.standard_normal((...))``, ``.reshape(...)``)
+  bound in the same module: more spec entries than dimensions.
+* **Divisibility**: when both the shape dims and the mesh axis sizes are
+  integer literals (``create_mesh({"fsdp": 4})`` + ``zeros((6, 8))`` with
+  ``P("fsdp")``), a sharded dimension not divisible by its axis (or joint
+  axes' product) is flagged — the static form of the fsdp partition rule's
+  divisibility assumption (`parallel/fsdp.py::partition_spec` refuses such
+  dims at runtime; hand-written specs have no such guard).
+
+Everything non-literal is skipped: this rule exists to catch fixture-grade
+mistakes in tests/tutorials and hand-rolled launch scripts, not to prove
+the trainer correct (the runtime does that).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from distribuuuu_tpu.analysis.rules.common import (
+    ModuleModel,
+    RawFinding,
+    call_name,
+    dotted,
+    is_pspec_call,
+    is_shard_map_call,
+    scoped_unique_binding,
+)
+
+CODE = "DT103"
+AUTOFIXABLE = False
+
+_SHAPE_CTORS = {
+    "zeros",
+    "ones",
+    "full",
+    "empty",
+    "standard_normal",
+    "uniform",
+    "normal",
+    "integers",
+    "randint",
+}
+_PASSTHROUGH = {"asarray", "array", "astype", "device_put", "abs", "copy"}
+
+_NP_MODULES = {"jnp", "np", "numpy", "jax.numpy"}
+
+
+def _np_module_of(call: ast.Call) -> str | None:
+    """'jnp'/'np'/... when the call is module-functional (``jnp.f(x, ...)``),
+    None for the method form (``x.f(...)``)."""
+    if isinstance(call.func, ast.Attribute):
+        mod = dotted(call.func.value)
+        if mod in _NP_MODULES:
+            return mod
+    return None
+
+
+def _spec_atoms(call: ast.Call) -> list:
+    """Per-entry axis atoms of a P(...) literal: one list element per array
+    dimension; each element is a tuple of axis-name strings (empty for
+    None/opaque entries)."""
+    entries = []
+    for arg in call.args:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            entries.append((arg.value,))
+        elif isinstance(arg, (ast.Tuple, ast.List)):
+            strs = tuple(
+                e.value
+                for e in arg.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            )
+            entries.append(strs)
+        else:
+            entries.append(())
+    return entries
+
+
+def _literal_shape(expr: ast.AST, model: ModuleModel, depth: int = 0):
+    """Tuple of dim sizes (int or None) when the expression's shape is
+    statically visible; None otherwise."""
+    if depth > 4 or expr is None:
+        return None
+    if isinstance(expr, ast.Call):
+        cn = call_name(expr) or ""
+        if cn == "reshape":
+            # two spellings: x.reshape(4, 8) / x.reshape((4, 8)) method form
+            # vs jnp.reshape(x, (4, 8)) functional form (the array is the
+            # first argument there, not a dimension)
+            if _np_module_of(expr) is not None:
+                if len(expr.args) >= 2 and isinstance(
+                    expr.args[1], (ast.Tuple, ast.List)
+                ):
+                    dims = expr.args[1].elts
+                else:
+                    return None
+            else:
+                dims = expr.args
+                if len(dims) == 1:
+                    if isinstance(dims[0], (ast.Tuple, ast.List)):
+                        dims = dims[0].elts
+                    elif not (
+                        isinstance(dims[0], ast.Constant)
+                        and isinstance(dims[0].value, int)
+                    ):
+                        # x.reshape(shape_var): the variable may hold an int
+                        # (rank 1) OR a tuple (rank len(tuple)) — unknowable
+                        return None
+            if any(isinstance(d, ast.Starred) for d in dims):
+                return None  # x.reshape(*dims): rank unknowable
+            return tuple(
+                d.value if isinstance(d, ast.Constant) and isinstance(d.value, int) else None
+                for d in dims
+            ) or None
+        if cn in _SHAPE_CTORS:
+            for arg in expr.args:
+                if isinstance(arg, (ast.Tuple, ast.List)):
+                    return tuple(
+                        e.value
+                        if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                        else None
+                        for e in arg.elts
+                    )
+            return None
+        if cn in _PASSTHROUGH:
+            if cn == "astype" and _np_module_of(expr) is None:
+                # x.astype(dtype): the array is the RECEIVER — args[0] is
+                # the dtype node, which must not hijack the shape chase
+                src = getattr(expr.func, "value", None)
+            else:
+                src = expr.args[0] if expr.args else getattr(expr.func, "value", None)
+            return _literal_shape(src, model, depth + 1)
+        return None
+    if isinstance(expr, ast.Name):
+        bound = scoped_unique_binding(expr.id, expr, model)
+        if bound is None:
+            return None
+        return _literal_shape(bound, model, depth + 1)
+    return None
+
+
+def _mesh_sizes(call_or_expr, model: ModuleModel, depth: int = 0):
+    """{axis: int size} for a module-locally resolvable mesh expr (literal
+    int sizes only; -1 and non-literals are omitted)."""
+    expr = call_or_expr
+    if depth > 3 or expr is None:
+        return {}
+    if isinstance(expr, ast.Call):
+        cn = call_name(expr) or ""
+        if cn in {"create_mesh", "create_hybrid_device_mesh"}:
+            for arg in expr.args:
+                if isinstance(arg, ast.Dict):
+                    out = {}
+                    for k, v in zip(arg.keys, arg.values):
+                        if (
+                            isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)
+                            and isinstance(v, ast.Constant)
+                            and isinstance(v.value, int)
+                            and v.value > 0
+                        ):
+                            out[k.value] = v.value
+                    return out
+        return {}
+    if isinstance(expr, ast.Name):
+        bound = scoped_unique_binding(expr.id, expr, model)
+        if bound is None:
+            return {}
+        return _mesh_sizes(bound, model, depth + 1)
+    return {}
+
+
+def _check_spec_against_shape(
+    spec_call: ast.Call, shape, sizes: dict, findings: list
+) -> None:
+    entries = _spec_atoms(spec_call)
+    if shape is None:
+        return
+    if len(entries) > len(shape):
+        findings.append(
+            RawFinding(
+                spec_call.lineno,
+                spec_call.col_offset,
+                CODE,
+                f"PartitionSpec has {len(entries)} entries but the array it "
+                f"shards has rank {len(shape)} — trace error on every "
+                "backend",
+            )
+        )
+        return
+    for i, atoms in enumerate(entries):
+        if not atoms or shape[i] is None:
+            continue
+        prod = 1
+        known = True
+        for a in atoms:
+            if a in sizes:
+                prod *= sizes[a]
+            else:
+                known = False
+        if known and prod > 1 and shape[i] % prod != 0:
+            findings.append(
+                RawFinding(
+                    spec_call.lineno,
+                    spec_call.col_offset,
+                    CODE,
+                    f"dimension {i} (size {shape[i]}) is sharded over "
+                    f"{'+'.join(atoms)} (total {prod}) but {shape[i]} % "
+                    f"{prod} != 0 — uneven shard, a trace error under "
+                    "shard_map",
+                )
+            )
+
+
+def check(tree: ast.AST, model: ModuleModel, ctx) -> list[RawFinding]:
+    findings: list[RawFinding] = []
+
+    # (1) duplicate axis within one spec
+    for call in model.calls:
+        if not is_pspec_call(call, model):
+            continue
+        seen: set = set()
+        for atoms in _spec_atoms(call):
+            for a in atoms:
+                if a in seen:
+                    findings.append(
+                        RawFinding(
+                            call.lineno,
+                            call.col_offset,
+                            CODE,
+                            f"axis {a!r} appears twice in one PartitionSpec: "
+                            "a mesh axis may shard at most one dimension",
+                        )
+                    )
+                seen.add(a)
+
+    # (2)+(3) immediately-applied shard_map: zip in_specs with the call args
+    for call in model.calls:
+        if not (isinstance(call.func, ast.Call) and is_shard_map_call(call.func)):
+            continue
+        sm = call.func
+        in_specs = None
+        mesh_expr = None
+        for kw in sm.keywords:
+            if kw.arg == "in_specs":
+                in_specs = kw.value
+            elif kw.arg == "mesh":
+                mesh_expr = kw.value
+        if not isinstance(in_specs, (ast.Tuple, ast.List)):
+            continue
+        sizes = _mesh_sizes(mesh_expr, model)
+        for spec, arg in zip(in_specs.elts, call.args):
+            if isinstance(spec, ast.Call) and is_pspec_call(spec, model):
+                _check_spec_against_shape(
+                    spec, _literal_shape(arg, model), sizes, findings
+                )
+
+    # (2)+(3) device_put(x, NamedSharding(mesh, P(...)))
+    for call in model.calls:
+        if (call_name(call) or "") != "device_put" or len(call.args) < 2:
+            continue
+        sharding = call.args[1]
+        if not (
+            isinstance(sharding, ast.Call)
+            and (call_name(sharding) or "") == "NamedSharding"
+            and len(sharding.args) >= 2
+        ):
+            continue
+        spec = sharding.args[1]
+        if isinstance(spec, ast.Call) and is_pspec_call(spec, model):
+            sizes = _mesh_sizes(sharding.args[0], model)
+            _check_spec_against_shape(
+                spec, _literal_shape(call.args[0], model), sizes, findings
+            )
+    return findings

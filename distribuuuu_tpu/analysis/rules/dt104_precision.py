@@ -1,0 +1,301 @@
+"""DT104: precision flow — low-precision accumulation and loss/grad downcasts.
+
+bf16 is the right *storage and matmul input* dtype on TPU; it is the wrong
+*accumulation* dtype. The MXU accumulates f32 internally, but only when the
+program asks for an f32 result (``preferred_element_type``) — otherwise the
+contraction output rounds to bf16 before anything downstream sees it, and a
+long reduction in bf16 loses mantissa monotonically (the overflow/underflow
+half is what the trainer's non-finite guard catches at runtime; the silent
+precision half is only visible statically). Three shapes:
+
+* **Contraction rounded then upcast**: ``einsum(q, k).astype(jnp.float32)``
+  (directly, or through a name: ``logits = einsum(...) + b`` ...
+  ``softmax(logits.astype(jnp.float32))``). The upcast *proves* downstream
+  wants f32, but the accumulation already happened in the input dtype —
+  the fix is ``preferred_element_type=jnp.float32`` on the contraction
+  itself. Contractions whose operands are all explicit f32 casts, or that
+  already carry ``preferred_element_type``, pass.
+* **bf16-cast value reduced**: a name bound from an explicit bfloat16 cast
+  flowing into ``jnp.sum/mean/prod/cumsum`` or ``lax.psum/pmean/
+  psum_scatter`` with no ``dtype=`` upcast on the reduction: the
+  accumulator inherits bf16.
+* **Loss/grad downcast**: ``.astype(jnp.bfloat16)`` applied to a name
+  matching ``loss``/``grad`` — the two value families the framework
+  guarantees f32 end to end (`metrics.cross_entropy_loss` computes in f32;
+  grads ride f32 params). A literal downcast there silently halves the
+  optimizer's signal.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from distribuuuu_tpu.analysis.rules.common import (
+    ModuleModel,
+    RawFinding,
+    call_name,
+    dotted,
+    pos_key,
+)
+
+CODE = "DT104"
+AUTOFIXABLE = False
+
+_BF16_DOTTED = {
+    "jnp.bfloat16",
+    "jax.numpy.bfloat16",
+    "np.bfloat16",
+    "numpy.bfloat16",
+}
+_F32_DOTTED = {
+    "jnp.float32",
+    "jax.numpy.float32",
+    "np.float32",
+    "numpy.float32",
+}
+_REDUCTIONS = {"sum", "mean", "prod", "cumsum", "psum", "pmean", "psum_scatter"}
+_CONTRACTIONS = {"einsum", "dot", "matmul", "tensordot", "dot_general"}
+_LOSS_GRAD_RE = re.compile(r"(^|_)(loss|grad|grads|gradients?)($|_|\d)", re.IGNORECASE)
+
+
+def _dtype_kind(expr: ast.AST) -> str | None:
+    """'bf16' / 'f32' for a dtype expression, else None."""
+    d = dotted(expr) or ""
+    if d in _BF16_DOTTED:
+        return "bf16"
+    if d in _F32_DOTTED:
+        return "f32"
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        if expr.value == "bfloat16":
+            return "bf16"
+        if expr.value == "float32":
+            return "f32"
+    return None
+
+
+def _cast_kind(expr: ast.AST) -> str | None:
+    """'bf16'/'f32' when expr is an explicit cast to that dtype."""
+    if not isinstance(expr, ast.Call):
+        return None
+    f = expr.func
+    if isinstance(f, ast.Attribute) and f.attr == "astype" and expr.args:
+        return _dtype_kind(expr.args[0])
+    cn = call_name(expr) or ""
+    if cn in {"asarray", "array"}:
+        if len(expr.args) >= 2:
+            k = _dtype_kind(expr.args[1])
+            if k:
+                return k
+        for kw in expr.keywords:
+            if kw.arg == "dtype":
+                return _dtype_kind(kw.value)
+    return None
+
+
+def _has_preferred(call: ast.Call) -> bool:
+    return any(kw.arg == "preferred_element_type" for kw in call.keywords)
+
+
+def _is_contraction(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in _CONTRACTIONS
+    )
+
+
+def _walk_scope(fn: ast.AST):
+    """Nodes of one scope: a function body (with nested defs — they share
+    its names), or the module top level EXCLUDING function bodies (their
+    names must not leak into module-level dataflow)."""
+    if not isinstance(fn, ast.Module):
+        yield from ast.walk(fn)
+        return
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class _Scope:
+    """Last-binding-wins name → cast-kind tracking within one function.
+
+    Built from ONE walk of the scope (``nodes``), which also feeds the three
+    checks — the rule never re-walks a function body (the --stats satellite).
+    """
+
+    def __init__(self, nodes: list):
+        self.nodes = nodes
+        self.bindings: dict[str, list] = {}  # name -> [(pos, value expr)]
+        for node in self.nodes:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    self.bindings.setdefault(t.id, []).append(
+                        (pos_key(node), node.value)
+                    )
+        for entries in self.bindings.values():
+            entries.sort()
+
+    def value_before(self, name: str, pos) -> ast.AST | None:
+        """The value expression of the last binding of ``name`` before pos."""
+        best = None
+        for p, v in self.bindings.get(name, ()):
+            if p < pos:
+                best = v
+            else:
+                break
+        return best
+
+    def cast_kind_at(self, expr: ast.AST, pos) -> str | None:
+        k = _cast_kind(expr)
+        if k:
+            return k
+        if isinstance(expr, ast.Name):
+            v = self.value_before(expr.id, pos)
+            if v is not None:
+                return _cast_kind(v)
+        return None
+
+
+def _operands(call: ast.Call) -> list:
+    args = list(call.args)
+    if args and isinstance(args[0], ast.Constant) and isinstance(args[0].value, str):
+        args = args[1:]  # einsum subscript
+    return args
+
+
+def _flag_contraction(node: ast.Call, scope: _Scope) -> RawFinding | None:
+    if _has_preferred(node):
+        return None
+    ops = _operands(node)
+    if ops and all(
+        scope.cast_kind_at(a, pos_key(node)) == "f32" for a in ops
+    ):
+        return None  # operands are f32: accumulation is f32 already
+    return RawFinding(
+        node.lineno,
+        node.col_offset,
+        CODE,
+        f"`{node.func.attr}` accumulates in its input dtype, and the result "
+        "is upcast to float32 *after* the contraction — the rounding already "
+        "happened. Pass preferred_element_type=jnp.float32 to the "
+        "contraction (the MXU accumulates f32 for free) and drop the "
+        "post-hoc astype",
+    )
+
+
+def _check_contractions_upcast(scope: _Scope) -> list[RawFinding]:
+    findings: list[RawFinding] = []
+    flagged: set[int] = set()
+    for node in scope.nodes:
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"
+            and node.args
+            and _dtype_kind(node.args[0]) == "f32"
+        ):
+            continue
+        target = node.func.value
+        exprs = [target]
+        if isinstance(target, ast.Name):
+            bound = scope.value_before(target.id, pos_key(node))
+            if bound is not None:
+                exprs = [bound]
+            else:
+                continue  # parameter or unknown: dtype unknowable
+        for e in exprs:
+            for sub in ast.walk(e):
+                if _is_contraction(sub) and id(sub) not in flagged:
+                    f = _flag_contraction(sub, scope)
+                    if f is not None:
+                        flagged.add(id(sub))
+                        findings.append(f)
+    return findings
+
+
+def _check_bf16_reductions(scope: _Scope) -> list[RawFinding]:
+    findings: list[RawFinding] = []
+    for node in scope.nodes:
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _REDUCTIONS
+            and node.args
+        ):
+            continue
+        if any(
+            kw.arg == "dtype" and _dtype_kind(kw.value) == "f32"
+            for kw in node.keywords
+        ):
+            continue
+        if scope.cast_kind_at(node.args[0], pos_key(node)) == "bf16":
+            findings.append(
+                RawFinding(
+                    node.lineno,
+                    node.col_offset,
+                    CODE,
+                    f"`{node.func.attr}` over an explicitly bfloat16-cast "
+                    "value accumulates in bf16 (8-bit mantissa): upcast the "
+                    "operand or pass dtype=jnp.float32 so the accumulator "
+                    "is f32",
+                )
+            )
+    return findings
+
+
+def _check_loss_grad_downcast(scope: _Scope) -> list[RawFinding]:
+    findings: list[RawFinding] = []
+    for node in scope.nodes:
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"
+            and node.args
+            and _dtype_kind(node.args[0]) == "bf16"
+        ):
+            continue
+        target = node.func.value
+        name = target.id if isinstance(target, ast.Name) else (
+            target.attr if isinstance(target, ast.Attribute) else ""
+        )
+        if name and _LOSS_GRAD_RE.search(name):
+            findings.append(
+                RawFinding(
+                    node.lineno,
+                    node.col_offset,
+                    CODE,
+                    f"`{name}` downcast to bfloat16: the loss/grad path is "
+                    "f32 end to end in this framework (f32 CE, f32 "
+                    "optimizer math) — a literal downcast here silently "
+                    "quantizes the optimizer's signal",
+                )
+            )
+    return findings
+
+
+def check(tree: ast.AST, model: ModuleModel, ctx) -> list[RawFinding]:
+    findings: list[RawFinding] = []
+    # top-level (non-nested) functions only: the scope walk already descends
+    # into nested defs (they share the enclosing names), so also visiting
+    # each nested def as its own scope would re-scan it quadratically
+    scopes = [
+        fn for fn in model.functions if model.enclosing_function(fn) is None
+    ]
+    for fn in scopes:
+        scope = _Scope(model.scope_nodes(fn))
+        findings.extend(_check_contractions_upcast(scope))
+        findings.extend(_check_bf16_reductions(scope))
+        findings.extend(_check_loss_grad_downcast(scope))
+    # module top level, excluding function bodies (their names must not
+    # leak into module-level dataflow)
+    scope = _Scope(list(_walk_scope(tree)))
+    findings.extend(_check_contractions_upcast(scope))
+    findings.extend(_check_bf16_reductions(scope))
+    findings.extend(_check_loss_grad_downcast(scope))
+    return findings

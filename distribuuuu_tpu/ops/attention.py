@@ -33,9 +33,20 @@ from jax.experimental import pallas as pl
 
 
 def xla_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, bias: jnp.ndarray):
-    """Reference path: plain einsums (q pre-scaled; bias = position logits)."""
-    logits = jnp.einsum("bnxd,bnyd->bnxy", q, k) + bias
-    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(v.dtype)
+    """Reference path: plain einsums (q pre-scaled; bias = position logits).
+
+    The QK contraction asks for an f32 result (preferred_element_type) so the
+    MXU accumulates in f32 — under bf16 inputs the old post-hoc
+    ``logits.astype(f32)`` upcast happened AFTER the accumulation had already
+    rounded (DT104), while the pallas kernel below always accumulated f32:
+    the two paths disagreed in exactly the low bits the softmax max-subtract
+    is most sensitive to.
+    """
+    logits = (
+        jnp.einsum("bnxd,bnyd->bnxy", q, k, preferred_element_type=jnp.float32)
+        + bias
+    )
+    weights = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum("bnxy,bnyd->bnxd", weights, v)
 
 
@@ -94,10 +105,12 @@ def _fwd(q, k, v, bias, interpret):
 
 def _bwd(interpret, res, g):
     q, k, v, bias = res
-    # recompute weights (XLA): standard attention gradients
-    logits = jnp.einsum("bnxd,bnyd->bnxy", q, k).astype(jnp.float32) + bias.astype(
-        jnp.float32
-    )
+    # recompute weights (XLA): standard attention gradients. f32 accumulation
+    # on the contraction itself (not a post-hoc astype): the recomputed
+    # weights must match the f32-accumulated forward or the VJP is biased.
+    logits = jnp.einsum(
+        "bnxd,bnyd->bnxy", q, k, preferred_element_type=jnp.float32
+    ) + bias.astype(jnp.float32)
     p = jax.nn.softmax(logits, axis=-1)
     g32 = g.astype(jnp.float32)
     v32 = v.astype(jnp.float32)

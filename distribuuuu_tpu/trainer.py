@@ -356,7 +356,11 @@ def create_train_state(model, key, mesh: Mesh, im_size: int):
         )
         return variables["params"], variables.get("batch_stats", {})
 
-    if fsdp_n > 1:
+    # fsdp_n derives from cfg.MESH (identical on every host), so the two
+    # branches below are entered uniformly fleet-wide; the collective
+    # difference DT101 sees (LAMB's fsdp-axis psum exists only in the
+    # sharded optimizer) can never disagree between participants.
+    if fsdp_n > 1:  # dtpu-lint: disable=DT101
         abs_params, _ = jax.eval_shape(model_init, key)
         param_specs = fsdp.tree_specs(abs_params, fsdp_n)
         # the optimizer update runs on the shard; LAMB's trust ratio needs

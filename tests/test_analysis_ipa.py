@@ -1,0 +1,891 @@
+"""dtpu-lint v2: interprocedural SPMD analyzer (analysis/ipa.py + DT101–DT104).
+
+One violating + one clean fixture per DT10x rule with exact codes and line
+numbers; cross-module summary propagation (a collective hidden two helpers
+deep, with the axis substituted through the chain); the shard_map
+axis-scope check; the seeded static deadlock (collective under a
+``process_index()`` guard, two helpers deep) the acceptance criteria pin;
+CLI `--format github` / `--stats` / baseline-prune satellites; regression
+pins for the real DT104 catches fixed in `ops/attention.py` and
+`tests/test_ring_attention.py`; and the repo-wide lint-clean + <5 s
+wall-time invariant extended to the new rules.
+"""
+
+import ast
+import os
+import time
+
+from distribuuuu_tpu.analysis import lint_paths, lint_sources
+from distribuuuu_tpu.analysis.__main__ import main as lint_main
+from distribuuuu_tpu.analysis.ipa import ProgramIndex
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(src: str, path: str = "snippet.py"):
+    return lint_sources({path: src.lstrip("\n")})
+
+
+def _hits(src_or_map, code: str):
+    if isinstance(src_or_map, str):
+        findings = _lint(src_or_map)
+    else:
+        findings = lint_sources(
+            {p: s.lstrip("\n") for p, s in src_or_map.items()}
+        )
+    return [(f.path, f.line) for f in findings if f.code == code]
+
+
+# ---------------------------------------------------------------------------
+# ipa.ProgramIndex: summaries, fixpoint, substitution
+# ---------------------------------------------------------------------------
+
+HELPERS_SRC = """
+import jax
+
+DATA_AXIS = "data"
+
+def inner_reduce(x, axis_name="data"):
+    return jax.lax.psum(x, axis_name)
+
+def outer_reduce(x):
+    return inner_reduce(x)
+
+def outer_reduce_seq(x):
+    return inner_reduce(x, "seq")
+
+def const_reduce(x):
+    return jax.lax.pmean(x, DATA_AXIS)
+"""
+
+
+def _index(sources: dict) -> ProgramIndex:
+    return ProgramIndex(
+        {p: ast.parse(s.lstrip("\n"), filename=p) for p, s in sources.items()}
+    )
+
+
+def test_summary_sees_through_one_helper_with_default_axis():
+    prog = _index({"h.py": HELPERS_SRC})
+    fi = prog.summary("outer_reduce")
+    assert [c.key() for c in fi.collectives] == [("psum", ("data",))]
+    assert fi.collectives[0].via == ("inner_reduce",)
+
+
+def test_summary_substitutes_caller_literal_over_default():
+    prog = _index({"h.py": HELPERS_SRC})
+    fi = prog.summary("outer_reduce_seq")
+    assert [c.key() for c in fi.collectives] == [("psum", ("seq",))]
+
+
+def test_summary_resolves_axis_vocabulary_constants():
+    prog = _index({"h.py": HELPERS_SRC})
+    fi = prog.summary("const_reduce")
+    assert [c.key() for c in fi.collectives] == [("pmean", ("data",))]
+
+
+def test_fixpoint_propagates_two_helpers_deep_across_modules():
+    prog = _index(
+        {
+            "a.py": HELPERS_SRC,
+            "b.py": """
+from a import outer_reduce
+
+def level_two(x):
+    return outer_reduce(x)
+""",
+        }
+    )
+    fi = prog.summary("level_two")
+    assert [c.key() for c in fi.collectives] == [("psum", ("data",))]
+    assert fi.collectives[0].via == ("outer_reduce", "inner_reduce")
+
+
+def test_ambiguous_function_names_are_dropped():
+    prog = _index(
+        {
+            "a.py": "import jax\ndef f(x):\n    return jax.lax.psum(x, 'data')\n",
+            "b.py": "def f(x):\n    return x\n",
+        }
+    )
+    assert prog.summary("f") is None
+
+
+# ---------------------------------------------------------------------------
+# DT101 — collective consistency (static deadlock)
+# ---------------------------------------------------------------------------
+
+# The acceptance-pinned seeded deadlock: the collective is TWO helpers deep
+# and only rank 0 ever reaches it.
+DT101_DEADLOCK = {
+    "lib_inner.py": """
+import jax
+
+def inner_reduce(x, axis_name="data"):
+    return jax.lax.psum(x, axis_name)
+""",
+    "lib_outer.py": """
+from lib_inner import inner_reduce
+
+def outer_reduce(x):
+    return inner_reduce(x)
+""",
+    "train.py": """
+import jax
+from lib_outer import outer_reduce
+
+def log_metrics(x):
+    if jax.process_index() == 0:
+        return outer_reduce(x)
+    return None
+""",
+}
+
+
+def test_dt101_flags_collective_under_process_index_two_helpers_deep():
+    assert _hits(DT101_DEADLOCK, "DT101") == [("train.py", 6)]
+
+
+def test_dt101_message_names_the_helper_chain():
+    findings = lint_sources({p: s.lstrip("\n") for p, s in DT101_DEADLOCK.items()})
+    (f,) = [f for f in findings if f.code == "DT101"]
+    assert "psum(data) via outer_reduce→inner_reduce" in f.message
+
+
+DT101_DIRECT_GUARDED = """
+import jax
+
+def sync(x, is_master):
+    if is_master:
+        return jax.lax.pmean(x, "data")
+    return x
+"""
+
+DT101_UNIFORM_GUARD = """
+import jax
+
+def sync(x):
+    if jax.process_count() > 1:
+        return jax.lax.pmean(x, "data")
+    return x
+"""
+
+
+def test_dt101_direct_collective_under_is_master_flag():
+    assert _hits(DT101_DIRECT_GUARDED, "DT101") == [("snippet.py", 5)]
+
+
+def test_dt101_uniform_world_size_guard_is_clean():
+    assert _hits(DT101_UNIFORM_GUARD, "DT101") == []
+
+
+DT101_DIVERGENT_BRANCHES = """
+import jax
+
+def reduce_stats(x, full):
+    if full:
+        y = jax.lax.psum(x, "data")
+    else:
+        y = jax.lax.pmean(x, "data")
+    return y
+"""
+
+DT101_MATCHED_BRANCHES = """
+import jax
+
+def reduce_stats(x, full):
+    if full:
+        y = jax.lax.psum(x * 2, "data")
+    else:
+        y = jax.lax.psum(x, "data")
+    return y
+"""
+
+
+def test_dt101_divergent_branch_sequences():
+    assert _hits(DT101_DIVERGENT_BRANCHES, "DT101") == [("snippet.py", 4)]
+
+
+def test_dt101_matched_branch_sequences_are_clean():
+    assert _hits(DT101_MATCHED_BRANCHES, "DT101") == []
+
+
+def test_dt101_inline_suppression_kills_the_finding():
+    # the rank-guard finding anchors at the COLLECTIVE call, not the `if`
+    suppressed = DT101_DIRECT_GUARDED.replace(
+        'pmean(x, "data")', 'pmean(x, "data")  # dtpu-lint: disable=DT101'
+    )
+    assert _hits(suppressed, "DT101") == []
+
+
+def test_dt101_identical_sequences_in_both_rank_guard_branches_are_clean():
+    # per-rank VALUES differ but the rendezvous happens on every path
+    src = """
+import jax
+
+def stamp(x):
+    if jax.process_index() == 0:
+        y = jax.lax.psum(x * 2, "data")
+    else:
+        y = jax.lax.psum(x, "data")
+    return y
+"""
+    assert _hits(src, "DT101") == []
+
+
+def test_dt101_divergent_rank_guard_is_one_finding_at_the_if():
+    # one defect — both branches communicate, differently, under a
+    # rank-varying test — must be ONE report (at the `if`), not one per
+    # branch collective plus one for the divergence
+    src = """
+import jax
+
+def broadcast(x):
+    if jax.process_index() == 0:
+        y = jax.lax.psum(x, "data")
+    else:
+        y = jax.lax.pmean(x, "data")
+    return y
+"""
+    assert _hits(src, "DT101") == [("snippet.py", 4)]
+
+
+def test_dt101_exempt_inner_guard_does_not_hide_an_enclosing_rank_guard():
+    # the inner if/else rendezvouses on every path (identical sequences) —
+    # but the OUTER rank guard still starves it: the ancestor search must
+    # keep climbing past an exempt guard, not abandon the call
+    src = """
+import jax
+
+def report(x):
+    if jax.process_index() == 0:
+        if jax.process_index() == 1:
+            y = jax.lax.psum(x * 2, "data")
+        else:
+            y = jax.lax.psum(x, "data")
+        return y
+    return x
+"""
+    hits = _hits(src, "DT101")
+    assert len(hits) == 2  # each branch's psum is rank-0-only
+    assert {ln for _, ln in hits} == {6, 8}
+
+
+def test_method_call_binds_past_the_implicit_self():
+    # obj.reduce("data", x) against `def reduce(self, axis, x)`: "data"
+    # binds `axis`, not `self` — the off-by-one made every method summary's
+    # axes opaque and DT101 saw falsely-divergent branches
+    src = """
+import jax
+
+class Reducer:
+    def reduce(self, axis, x):
+        return jax.lax.psum(x, axis)
+
+def combine(obj, x, flag):
+    if flag:
+        y = obj.reduce("data", x)
+    else:
+        y = jax.lax.psum(x, "data")
+    return y
+"""
+    assert _hits(src, "DT101") == []
+
+
+def test_nested_helper_defined_and_called_in_same_function_not_double_counted():
+    # the nested def's body folds into outer's summary; the call to it must
+    # not ALSO expand through the function index (a 2-vs-1 false divergence)
+    src = """
+import jax
+
+def outer(x):
+    def helper(y):
+        return jax.lax.pmean(y, "data")
+    return helper(x)
+
+def use(x, flag):
+    if flag:
+        z = outer(x)
+    else:
+        z = jax.lax.pmean(x, "data")
+    return z
+"""
+    prog = _index({"m.py": src})
+    assert [c.key() for c in prog.summary("outer").collectives] == [
+        ("pmean", ("data",))
+    ]
+    assert _hits(src, "DT101") == []
+
+
+# ---------------------------------------------------------------------------
+# DT102 — axis-name validity (joint tuples, helper indirection, shard_map)
+# ---------------------------------------------------------------------------
+
+MESH_DECL = """
+def build(create_mesh):
+    return create_mesh({"data": -1, "fsdp": 2, "seq": 8})
+"""
+
+DT102_JOINT_TYPO = {
+    "mesh.py": MESH_DECL,
+    "grads.py": """
+import jax
+
+def average_grads(g):
+    return jax.lax.pmean(g, ("data", "fsdpp"))
+""",
+}
+
+DT102_JOINT_OK = {
+    "mesh.py": MESH_DECL,
+    "grads.py": """
+import jax
+
+def average_grads(g):
+    return jax.lax.pmean(g, ("data", "fsdp"))
+""",
+}
+
+
+def test_dt102_joint_axis_tuple_member_typo():
+    assert _hits(DT102_JOINT_TYPO, "DT102") == [("grads.py", 4)]
+
+
+def test_dt102_joint_axis_tuple_clean():
+    assert _hits(DT102_JOINT_OK, "DT102") == []
+
+
+DT102_HELPER_TYPO = {
+    "mesh.py": MESH_DECL,
+    "helpers.py": """
+import jax
+
+def pmean_tree(tree, axis):
+    return jax.tree.map(lambda x: jax.lax.pmean(x, axis), tree)
+""",
+    "caller.py": """
+from helpers import pmean_tree
+
+def average(grads):
+    return pmean_tree(grads, "dta")
+""",
+}
+
+
+def test_dt102_literal_axis_into_helper_summary():
+    # no lax.* call in sight at the call site: the axis typo is visible only
+    # because pmean_tree's summary shows `axis` flowing into a collective
+    assert _hits(DT102_HELPER_TYPO, "DT102") == [("caller.py", 4)]
+
+
+def test_dt102_helper_axis_correct_is_clean():
+    fixed = dict(DT102_HELPER_TYPO)
+    fixed["caller.py"] = fixed["caller.py"].replace('"dta"', '"data"')
+    assert _hits(fixed, "DT102") == []
+
+
+# "data" exists in the repo census, but THIS shard_map's mesh binds only
+# "seq": unbound in scope (a trace error at best, a wrong-group reduction
+# at worst).
+DT102_SCOPE = {
+    "mesh.py": MESH_DECL,
+    "ring.py": """
+import jax
+from jax.sharding import PartitionSpec as P
+
+def run(x, create_mesh):
+    mesh = create_mesh({"seq": 8})
+
+    def body(q):
+        return jax.lax.pmean(q, "data")
+
+    f = jax.shard_map(body, mesh=mesh, in_specs=(P("seq"),), out_specs=P("seq"))
+    return f(x)
+""",
+}
+
+
+def test_dt102_shard_map_body_axis_not_bound_by_its_mesh():
+    assert _hits(DT102_SCOPE, "DT102") == [("ring.py", 8)]
+
+
+def test_dt102_shard_map_body_bound_axis_is_clean():
+    fixed = dict(DT102_SCOPE)
+    fixed["ring.py"] = fixed["ring.py"].replace('pmean(q, "data")', 'pmean(q, "seq")')
+    assert _hits(fixed, "DT102") == []
+
+
+def test_dt102_shard_map_in_specs_axis_not_bound_by_its_mesh():
+    bad = dict(DT102_SCOPE)
+    bad["ring.py"] = bad["ring.py"].replace(
+        'in_specs=(P("seq"),)', 'in_specs=(P("data"),)'
+    )
+    assert ("ring.py", 10) in _hits(bad, "DT102")
+
+
+def test_dt102_globally_unknown_axis_in_shard_map_body_reports_once():
+    # "dta" is unknown EVERYWHERE: the joint-tuple census check owns it —
+    # the shard_map scope check must not stack a second annotation on the
+    # same typo (one defect, one finding)
+    src = {
+        "mesh.py": MESH_DECL,
+        "ring.py": """
+import jax
+from jax.sharding import PartitionSpec as P
+
+def body(q):
+    return jax.lax.pmean(q, ("seq", "dta"))
+
+def run(q, create_mesh):
+    mesh = create_mesh({"seq": 8})
+    return jax.shard_map(body, mesh=mesh, in_specs=(P("seq"),), out_specs=P("seq"))(q)
+""",
+    }
+    assert _hits(src, "DT102") == [("ring.py", 5)]
+
+
+def test_dt102_parameter_mesh_is_never_resolved_to_another_functions_local():
+    # `mesh` is a PARAMETER of run(); the unrelated local binding in make()
+    # must not leak into its resolution (scope-aware conservatism)
+    src = {
+        "mesh.py": MESH_DECL,
+        "use.py": """
+import jax
+from jax.sharding import PartitionSpec as P
+
+def make(create_mesh):
+    mesh = create_mesh({"data": 4})
+    return mesh
+
+def run(body, mesh, x):
+    return jax.shard_map(body, mesh=mesh, in_specs=(P("seq"),), out_specs=P("seq"))(x)
+""",
+    }
+    assert _hits(src, "DT102") == []
+
+
+# ---------------------------------------------------------------------------
+# DT103 — PartitionSpec arity/divisibility
+# ---------------------------------------------------------------------------
+
+DT103_DUP_AXIS = """
+from jax.sharding import PartitionSpec as P
+
+SPEC = P("data", "data")
+"""
+
+DT103_DISTINCT = """
+from jax.sharding import PartitionSpec as P
+
+SPEC = P("data", "fsdp")
+"""
+
+
+def test_dt103_duplicate_axis_in_one_spec():
+    assert _hits(DT103_DUP_AXIS, "DT103") == [("snippet.py", 3)]
+
+
+def test_dt103_distinct_axes_are_clean():
+    assert _hits(DT103_DISTINCT, "DT103") == []
+
+
+DT103_INDIVISIBLE = """
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+def run(f, create_mesh):
+    mesh = create_mesh({"fsdp": 4})
+    x = jnp.zeros((6, 8))
+    return jax.shard_map(f, mesh=mesh, in_specs=(P("fsdp"),), out_specs=P())(x)
+"""
+
+
+def test_dt103_indivisible_sharded_dim():
+    # 6 % 4 != 0: the static form of parallel/fsdp.py's divisibility rule
+    assert _hits(DT103_INDIVISIBLE, "DT103") == [("snippet.py", 8)]
+
+
+def test_dt103_divisible_dim_is_clean():
+    ok = DT103_INDIVISIBLE.replace("(6, 8)", "(8, 8)")
+    assert _hits(ok, "DT103") == []
+
+
+DT103_ARITY = """
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+def run(f, create_mesh):
+    mesh = create_mesh({"data": 2})
+    x = jnp.zeros((4, 8))
+    return jax.shard_map(
+        f, mesh=mesh, in_specs=(P("data", None, None),), out_specs=P()
+    )(x)
+"""
+
+
+def test_dt103_spec_rank_exceeds_array_rank():
+    assert _hits(DT103_ARITY, "DT103") == [("snippet.py", 9)]
+
+
+def test_dt103_functional_reshape_rank_is_not_misread():
+    # jnp.reshape(x, (4, 8, 3)) is rank 3 — the array argument must not be
+    # counted as a dimension (the method-form x.reshape(...) assumption)
+    src = """
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+def run(f, x, create_mesh):
+    mesh = create_mesh({"data": 4})
+    y = jnp.reshape(x, (4, 8, 3))
+    return jax.shard_map(
+        f, mesh=mesh, in_specs=(P("data", None, None),), out_specs=P()
+    )(y)
+"""
+    assert _hits(src, "DT103") == []
+
+
+def test_dt103_reshape_through_a_shape_variable_is_rank_unknowable():
+    # x.reshape(new_shape) may be rank 1 (int) or rank len(new_shape)
+    # (tuple) — it must resolve to UNKNOWN, not rank 1 (which produced a
+    # false "spec arity > array rank" on idiomatic code); ditto *splat
+    src = """
+import jax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+def run(batch, new_shape, dims, create_mesh):
+    mesh = create_mesh({"data": 4})
+    x = batch.reshape(new_shape)
+    y = batch.reshape(*dims)
+    a = jax.device_put(x, NamedSharding(mesh, P("data", None)))
+    b = jax.device_put(y, NamedSharding(mesh, P("data", None, None)))
+    return a, b
+"""
+    assert _hits(src, "DT103") == []
+
+
+def test_dt103_shape_tracks_through_method_form_astype():
+    # x.astype(dtype): args[0] is the DTYPE, not the array — the shape chase
+    # must follow the receiver, or every astype in the chain silently kills
+    # the divisibility check
+    src = """
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+def run(create_mesh):
+    mesh = create_mesh({"data": 4})
+    x = jnp.zeros((10, 8))
+    y = x.astype(jnp.bfloat16)
+    return jax.device_put(y, NamedSharding(mesh, P("data", None)))
+"""
+    assert _hits(src, "DT103") == [("snippet.py", 10)]  # 10 % 4 != 0
+
+
+def test_dt103_device_put_named_sharding_divisibility():
+    src = """
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+def place(create_mesh):
+    mesh = create_mesh({"data": 4})
+    x = jnp.zeros((10, 8))
+    return jax.device_put(x, NamedSharding(mesh, P("data")))
+"""
+    assert _hits(src, "DT103") == [("snippet.py", 8)]
+
+
+# ---------------------------------------------------------------------------
+# DT104 — precision flow
+# ---------------------------------------------------------------------------
+
+DT104_UPCAST_AFTER = """
+import jax
+import jax.numpy as jnp
+
+def attn_logits(q, k):
+    logits = jnp.einsum("bqd,bkd->bqk", q, k)
+    return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+"""
+
+DT104_PREFERRED = """
+import jax
+import jax.numpy as jnp
+
+def attn_logits(q, k):
+    logits = jnp.einsum(
+        "bqd,bkd->bqk", q, k, preferred_element_type=jnp.float32
+    )
+    return jax.nn.softmax(logits, axis=-1)
+"""
+
+
+def test_dt104_contraction_rounded_then_upcast():
+    assert _hits(DT104_UPCAST_AFTER, "DT104") == [("snippet.py", 5)]
+
+
+def test_dt104_preferred_element_type_is_clean():
+    assert _hits(DT104_PREFERRED, "DT104") == []
+
+
+DT104_BF16_SUM = """
+import jax.numpy as jnp
+
+def total(x):
+    xb = x.astype(jnp.bfloat16)
+    return jnp.sum(xb)
+"""
+
+DT104_BF16_SUM_F32_ACC = """
+import jax.numpy as jnp
+
+def total(x):
+    xb = x.astype(jnp.bfloat16)
+    return jnp.sum(xb, dtype=jnp.float32)
+"""
+
+
+def test_dt104_bf16_cast_value_reduced():
+    assert _hits(DT104_BF16_SUM, "DT104") == [("snippet.py", 5)]
+
+
+def test_dt104_f32_accumulator_is_clean():
+    assert _hits(DT104_BF16_SUM_F32_ACC, "DT104") == []
+
+
+DT104_LOSS_DOWNCAST = """
+import jax.numpy as jnp
+
+def report(loss, grads):
+    return loss.astype(jnp.bfloat16)
+"""
+
+
+def test_dt104_loss_downcast():
+    assert _hits(DT104_LOSS_DOWNCAST, "DT104") == [("snippet.py", 4)]
+
+
+def test_dt104_activation_downcast_is_fine():
+    src = DT104_LOSS_DOWNCAST.replace("loss.astype", "hidden.astype").replace(
+        "def report(loss", "def report(hidden"
+    )
+    assert _hits(src, "DT104") == []
+
+
+# ---------------------------------------------------------------------------
+# regression pins: the real DT104/DT101 catches this PR fixed
+# ---------------------------------------------------------------------------
+
+# ops/attention.py pre-fix: both einsum contractions accumulated in the
+# input dtype and upcast AFTER (xla_attention fwd + custom-VJP bwd), while
+# the pallas kernel between them already asked for f32 accumulation.
+OLD_XLA_ATTENTION = """
+import jax
+import jax.numpy as jnp
+
+def xla_attention(q, k, v, bias):
+    logits = jnp.einsum("bnxd,bnyd->bnxy", q, k) + bias
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(v.dtype)
+    return jnp.einsum("bnxy,bnyd->bnxd", weights, v)
+"""
+
+OLD_BWD_RECOMPUTE = """
+import jax
+import jax.numpy as jnp
+
+def _bwd(res, g):
+    q, k, v, bias = res
+    logits = jnp.einsum("bnxd,bnyd->bnxy", q, k).astype(jnp.float32) + bias.astype(
+        jnp.float32
+    )
+    return jax.nn.softmax(logits, axis=-1)
+"""
+
+
+def test_pre_fix_attention_forward_was_a_dt104():
+    assert _hits(OLD_XLA_ATTENTION, "DT104") == [("snippet.py", 5)]
+
+
+def test_pre_fix_attention_backward_was_a_dt104():
+    assert _hits(OLD_BWD_RECOMPUTE, "DT104") == [("snippet.py", 6)]
+
+
+def test_fixed_ops_attention_is_dt104_clean():
+    path = os.path.join(REPO, "distribuuuu_tpu", "ops", "attention.py")
+    with open(path, encoding="utf-8") as fh:
+        findings = lint_sources({"attention.py": fh.read()})
+    assert [f for f in findings if f.code == "DT104"] == []
+
+
+def test_fixed_ring_attention_reference_is_dt104_clean():
+    path = os.path.join(REPO, "tests", "test_ring_attention.py")
+    with open(path, encoding="utf-8") as fh:
+        findings = lint_sources({"test_ring_attention.py": fh.read()})
+    assert [f for f in findings if f.code == "DT104"] == []
+
+
+def test_trainer_fsdp_branch_suppression_is_inline_not_baselined():
+    """create_train_state's fsdp_n branch is uniform fleet-wide: the DT101
+    divergent-branch report there is suppressed AT THE SOURCE, with the
+    reasoning in a comment — not grandfathered in the baseline."""
+    path = os.path.join(REPO, "distribuuuu_tpu", "trainer.py")
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    assert "# dtpu-lint: disable=DT101" in src
+    findings = lint_sources({"trainer.py": src})
+    assert [f for f in findings if f.code == "DT101"] == []
+
+
+# ---------------------------------------------------------------------------
+# CLI satellites: --format github, --stats, baseline pruning
+# ---------------------------------------------------------------------------
+
+BAD_SNIPPET = """
+import jax
+
+def broadcast(x):
+    if jax.process_index() == 0:
+        return jax.lax.pmean(x, "data")
+    return x
+"""
+
+
+def test_cli_github_format_emits_workflow_commands(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_SNIPPET.lstrip("\n"))
+    rc = lint_main([str(bad), "--no-baseline", "--format", "github"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    line = next(ln for ln in out.splitlines() if ln.startswith("::error "))
+    assert "file=" in line and ",line=5," in line
+    assert "title=dtpu-lint DT101" in line
+    assert "rank-/host-varying guard" in line
+
+
+def test_cli_select_prefix_runs_the_whole_series(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    # a DT101 violation AND a DT002 violation in one file
+    bad.write_text(
+        BAD_SNIPPET.lstrip("\n")
+        + "\ndef reseed(key):\n"
+        + "    k1, k2 = jax.random.split(key)\n"
+        + "    return jax.random.normal(key, (2,))\n"
+    )
+    assert lint_main([str(bad), "--no-baseline", "--select", "DT10"]) == 1
+    out = capsys.readouterr().out
+    assert "DT101" in out and "DT002" not in out
+
+
+def test_cli_stats_reports_per_rule_wall_time(tmp_path, capsys):
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+    rc = lint_main([str(ok), "--no-baseline", "--stats"])
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert "--stats" in err
+    for key in ("parse", "model", "ipa", "DT101", "DT104"):
+        assert key in err
+
+
+def test_cli_github_format_surfaces_stale_baseline_entries(tmp_path, capsys):
+    # the CI job is the only github-format consumer: the shrink-the-baseline
+    # signal must not be a text-format exclusive
+    bad = tmp_path / "mod.py"
+    bad.write_text(BAD_SNIPPET.lstrip("\n"))
+    bl = str(tmp_path / "bl.json")
+    assert lint_main([str(bad), "--baseline", bl, "--write-baseline"]) == 0
+    bad.write_text("x = 1\n")  # the finding is fixed; its entry goes stale
+    capsys.readouterr()
+    rc = lint_main([str(bad), "--baseline", bl, "--format", "github"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    line = next(ln for ln in out.splitlines() if ln.startswith("::warning "))
+    assert "stale baseline entry DT101" in line
+    assert "regenerate with --write-baseline" in line
+
+
+def test_cli_stats_prints_even_with_write_baseline(tmp_path, capsys):
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+    bl = str(tmp_path / "bl.json")
+    rc = lint_main([str(ok), "--baseline", bl, "--write-baseline", "--stats"])
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "--stats" in cap.err and "DT101" in cap.err  # not swallowed
+    assert "wrote 0 finding(s)" in cap.out
+
+
+def test_write_baseline_prunes_entries_for_deleted_files(tmp_path, capsys):
+    keep = tmp_path / "keep.py"
+    gone = tmp_path / "gone.py"
+    for p in (keep, gone):
+        p.write_text(BAD_SNIPPET.lstrip("\n"))
+    bl = str(tmp_path / "bl.json")
+    assert lint_main([str(keep), str(gone), "--baseline", bl, "--write-baseline"]) == 0
+    assert lint_main([str(keep), str(gone), "--baseline", bl]) == 0  # grandfathered
+    gone.unlink()
+    capsys.readouterr()
+    assert lint_main([str(keep), "--baseline", bl, "--write-baseline"]) == 0
+    out = capsys.readouterr().out
+    assert "pruned 1 stale entry for deleted files" in out
+    import json
+
+    entries = json.load(open(bl))["findings"]
+    assert [e["path"] for e in entries] == ["keep.py"]
+
+
+def test_write_baseline_preserves_entries_outside_linted_paths(tmp_path):
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    for p in (a, b):
+        p.write_text(BAD_SNIPPET.lstrip("\n"))
+    bl = str(tmp_path / "bl.json")
+    assert lint_main([str(a), str(b), "--baseline", bl, "--write-baseline"]) == 0
+    # re-write from a/ only: b's grandfathered entry must survive (its file
+    # still exists, it just wasn't linted this invocation)
+    assert lint_main([str(a), "--baseline", bl, "--write-baseline"]) == 0
+    assert lint_main([str(a), str(b), "--baseline", bl]) == 0
+
+
+# ---------------------------------------------------------------------------
+# acceptance invariants: repo DT10x-clean, analyzer wall time
+# ---------------------------------------------------------------------------
+
+def test_select_without_ipa_rules_skips_the_program_index():
+    stats = {}
+    lint_sources({"a.py": "x = 1\n"}, select={"DT001"}, stats=stats)
+    assert "ipa" not in stats  # the repo-wide fixpoint wasn't built
+    stats = {}
+    lint_sources({"a.py": "x = 1\n"}, select={"DT10"}, stats=stats)
+    assert "ipa" in stats
+
+
+def test_repo_is_dt10x_clean_and_analyzer_is_fast():
+    """DT001–DT104 over the full repo: no DT10x finding anywhere (library,
+    scripts, or tests — the new rules have NO baseline entries), in under
+    the 5 s wall-time budget the CI lint job rides on.
+
+    Best-of-two timing: the budget bounds the *analyzer*, not transient
+    scheduler noise on a shared CI runner — one clean run under 5 s is the
+    claim; two consecutive runs both over it is a real regression.
+    """
+    paths = [
+        os.path.join(REPO, "distribuuuu_tpu"),
+        os.path.join(REPO, "scripts"),
+        os.path.join(REPO, "tests"),
+    ]
+    t0 = time.perf_counter()
+    findings = lint_paths(paths)
+    elapsed = time.perf_counter() - t0
+    dt10x = [f for f in findings if f.code.startswith("DT1")]
+    assert dt10x == [], [f.render() for f in dt10x]
+    if elapsed >= 5.0:
+        t0 = time.perf_counter()
+        lint_paths(paths)
+        elapsed = min(elapsed, time.perf_counter() - t0)
+    assert elapsed < 5.0, f"full-repo analyzer run took {elapsed:.2f} s (budget 5 s)"

@@ -24,7 +24,7 @@ def _make_ring(mesh, **kw):
 
 def _global_attention(q, k, v, causal=False):
     scale = q.shape[-1] ** -0.5
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
     if causal:
         L = q.shape[2]
         mask = jnp.tril(jnp.ones((L, L), bool))
