@@ -81,7 +81,7 @@ from typing import Any, Callable
 from distribuuuu_tpu import resilience
 from distribuuuu_tpu.config import cfg, load_cfg_fom_args
 from distribuuuu_tpu.logging import logger
-from distribuuuu_tpu.obs.journal import Journal, _journal_parts, validate_record
+from distribuuuu_tpu.obs.journal import ValidatedJournal, _journal_parts
 
 # Env keys of the chaos injections (transient machine faults by
 # construction): disarmed in relaunched workers when
@@ -177,44 +177,28 @@ def merge_outcomes(codes: list[int | None]) -> str:
 # Supervisor journal (typed records into the run's telemetry journal)
 # ---------------------------------------------------------------------------
 
-class SupervisorJournal:
+class SupervisorJournal(ValidatedJournal):
     """Validated ``supervisor_*`` appends into OUT_DIR's telemetry journal.
 
-    The agent writes only while no worker is mid-record (between attempts,
-    or about to kill a wedged fleet), so sharing the workers' journal file
-    is safe on local filesystems (append-mode line writes). ``path=None``
-    (journaling impossible) degrades every call to a no-op — supervision
-    must never die of observability.
+    In training mode the agent writes only while no worker is mid-record
+    (between attempts, or about to kill a wedged fleet), so sharing the
+    workers' journal file is safe on local filesystems (append-mode line
+    writes). In serving mode the agent is the main file's ONLY writer —
+    replicas journal into per-replica ``.part<N>`` continuations (see
+    serve/frontend.ServeJournal) that `read_journal` reassembles.
+    ``path=None`` (journaling impossible) degrades every call to a no-op —
+    supervision must never die of observability.
     """
 
     def __init__(self, out_dir: str):
-        self.path: str | None = None
-        self._journal: Journal | None = None
         try:
             from distribuuuu_tpu.obs.telemetry import journal_path
 
-            self.path = journal_path(out_dir)
-            self._journal = Journal(self.path)
+            path = journal_path(out_dir)
         except Exception as exc:  # pragma: no cover - defensive
             logger.warning(f"supervisor journal unavailable: {exc!r}")
-
-    def event(self, kind: str, **fields: Any) -> None:
-        if self._journal is None:
-            return
-        record = {"ts": time.time(), "kind": kind, **fields}
-        errors = validate_record(record)
-        if errors:
-            logger.error(f"agent: invalid {kind!r} record dropped: {errors}")
-            return
-        try:
-            self._journal.append(record)
-        except Exception as exc:  # pragma: no cover - defensive
-            logger.warning(f"supervisor journal append failed: {exc!r}")
-
-    def close(self) -> None:
-        if self._journal is not None:
-            self._journal.close()
-            self._journal = None
+            path = None
+        super().__init__(path, label="supervisor journal")
 
 
 def _journal_bytes(path: str | None) -> int:
@@ -244,6 +228,7 @@ def preflight_checks(
     device_probe: bool,
     device_probe_timeout_s: float,
     probe_env: dict[str, str] | None = None,
+    check_resume: bool = True,
 ) -> tuple[bool, list[str], dict[str, Any]]:
     """Run the launch gate; returns ``(ok, failures, checks)``.
 
@@ -255,10 +240,14 @@ def preflight_checks(
       sees ≥ 1 device. Subprocess on purpose — backend init claims the
       accelerators, which must stay free for the workers.
     - ``rendezvous_port``: the fleet's MASTER_PORT is bindable (a stale
-      worker still holding it would fail every relaunched rank).
-    - ``resume_target``: the checkpoint auto-resume will pick (at the
-      current rollback depth) passes integrity verification. Corrupt
-      candidates are quarantined here — at preflight, not mid-restore.
+      worker still holding it would fail every relaunched rank). The serve
+      mode routes each replica's *frontend* port through the same check —
+      one `runtime.dist.port_is_free` gate for both subsystems.
+    - ``resume_target`` (``check_resume``; the serve mode skips it — a
+      serving replica restores nothing): the checkpoint auto-resume will
+      pick (at the current rollback depth) passes integrity verification.
+      Corrupt candidates are quarantined here — at preflight, not
+      mid-restore.
     """
     failures: list[str] = []
     checks: dict[str, Any] = {}
@@ -300,11 +289,12 @@ def preflight_checks(
         if not port_is_free(port):
             failures.append("rendezvous_port")
 
-    target, status = verify_resume_target(out_dir, rollback)
-    checks["resume_target"] = target or "fresh"
-    checks["resume_target_status"] = status
-    if status == "exhausted":  # every candidate was corrupt or rolled past
-        failures.append("resume_target")
+    if check_resume:
+        target, status = verify_resume_target(out_dir, rollback)
+        checks["resume_target"] = target or "fresh"
+        checks["resume_target_status"] = status
+        if status == "exhausted":  # every candidate was corrupt or rolled past
+            failures.append("resume_target")
 
     return not failures, failures, checks
 
@@ -334,6 +324,38 @@ def verify_resume_target(out_dir: str, rollback: int) -> tuple[str | None, str]:
             continue
         return path, status
     return None, "exhausted"
+
+
+def _rollback_history_exists() -> bool:
+    """Is there ANY resume candidate a poison rollback could escalate into?
+
+    A serving replica (or any resume-incapable worker) has none — for those
+    the poison policy must take the backoff path, not spend attempts
+    rolling back against empty history. A scan failure errs toward the
+    legacy escalation (the preflight's own exhausted-detection still bounds
+    it)."""
+    try:
+        # lazy: checkpoint pulls in jax/orbax, same discipline as preflight
+        from distribuuuu_tpu import checkpoint as ckpt
+
+        return bool(ckpt.resume_candidates(cfg.OUT_DIR))
+    except Exception as exc:  # pragma: no cover - defensive
+        logger.warning(f"agent: resume-candidate scan failed: {exc!r}")
+        return True
+
+
+def _serve_frontend_ports() -> set[int]:
+    """Frontend ports dtpu-serve replicas on this host are configured to
+    bind (SERVE.PORT, one per replica slot) — the rendezvous pick's
+    exclusion set. Port 0 (ephemeral frontend picks) excludes nothing here;
+    that direction of the collision is handled by the frontend's own pick
+    excluding `rendezvous_ports_in_play`."""
+    if "SERVE" not in cfg or int(cfg.SERVE.PORT) <= 0:
+        return set()
+    base = int(cfg.SERVE.PORT)
+    # cover a generous replica-slot window: an agent supervising trainers
+    # doesn't know how many replicas a serve agent beside it runs
+    return {base + i for i in range(16)}
 
 
 # ---------------------------------------------------------------------------
@@ -409,6 +431,7 @@ class Agent:
         self._workers: list[Worker] = []
         a = cfg.AGENT
         self.nprocs = int(a.NPROCS)
+        self.serve = bool(a.SERVE) if "SERVE" in a else False
         self.budget = RestartBudget(a.MAX_RESTARTS, a.RESTART_WINDOW_S)
         self.journal = SupervisorJournal(cfg.OUT_DIR)
 
@@ -433,11 +456,23 @@ class Agent:
     def _worker_cmd(self) -> list[str]:
         if cfg.AGENT.CMD:
             return shlex.split(cfg.AGENT.CMD)
+        if self.serve:
+            # serving mode's built-in worker is a dtpu-serve replica with
+            # this same --cfg/overrides argv; its port rides DTPU_SERVE_PORT
+            return [sys.executable, "-m", "distribuuuu_tpu.serve", *self._worker_argv]
         return [sys.executable, "-m", "distribuuuu_tpu.agent", "--worker", *self._worker_argv]
 
     def _worker_env(self, rank: int, attempt: int, rollback: int, port: int | None) -> dict[str, str]:
         env = dict(os.environ)
-        if self.nprocs > 1:
+        if self.serve:
+            # replicas are independent processes, NOT a collective fleet:
+            # no rendezvous env (RANK/WORLD_SIZE would make each replica
+            # wait on a jax.distributed bring-up that never completes); the
+            # per-replica frontend port is the only coordination state
+            env["DTPU_SERVE_REPLICA"] = str(rank)
+            if port is not None:
+                env["DTPU_SERVE_PORT"] = str(port)
+        elif self.nprocs > 1:
             env.update(
                 RANK=str(rank),
                 WORLD_SIZE=str(self.nprocs),
@@ -571,6 +606,8 @@ class Agent:
     # -- the supervision loop ------------------------------------------------
 
     def run(self) -> int:
+        if self.serve:
+            return self.run_serve()
         a = cfg.AGENT
         self._install_signals()
         tic = time.time()
@@ -601,7 +638,10 @@ class Agent:
             if self.nprocs > 1:
                 from distribuuuu_tpu.runtime.dist import pick_rendezvous_port
 
-                port = pick_rendezvous_port()
+                # never hand the fleet a rendezvous port a dtpu-serve
+                # frontend on this host is configured to bind (the two
+                # subsystems pick ports independently; see runtime/dist.py)
+                port = pick_rendezvous_port(exclude=_serve_frontend_ports())
 
             pf_tic = time.time()
             ok, failures, checks = preflight_checks(
@@ -710,7 +750,23 @@ class Agent:
                 verdict, reason = "preempted", f"signal {self._stop_signum}"
                 break
 
-            if outcome == resilience.EXIT_POISON:
+            recovery_reason = ""
+            if outcome == resilience.EXIT_POISON and not _rollback_history_exists():
+                # resume-incapable worker (a serving replica, a fresh run
+                # that never checkpointed): there is nothing to roll back
+                # against, and escalating DTPU_RESUME_ROLLBACK would only
+                # preflight-fail as "exhausted" one attempt later. Poison
+                # takes the ordinary crash backoff/budget path, with the
+                # why on the record.
+                action = "restart"
+                delay = backoff_delay(
+                    self.budget.in_window(), a.BACKOFF_BASE_S, a.BACKOFF_MAX_S
+                )
+                recovery_reason = (
+                    "poison exit with no checkpoint history to roll back — "
+                    "handled as a crash (backoff), not a rollback"
+                )
+            elif outcome == resilience.EXIT_POISON:
                 rollback += 1
                 rollbacks += 1
                 if rollback > int(a.MAX_ROLLBACKS):
@@ -738,6 +794,9 @@ class Agent:
                 )
                 break
             restarts += 1
+            rec_fields: dict[str, Any] = {}
+            if recovery_reason:
+                rec_fields["reason"] = recovery_reason
             self.journal.event(
                 "supervisor_recovery",
                 attempt=attempt,
@@ -746,11 +805,13 @@ class Agent:
                 backoff_s=round(delay, 3),
                 rollback=rollback,
                 restarts_in_window=self.budget.in_window(),
+                **rec_fields,
             )
             logger.warning(
                 f"agent: {outcome} -> {action} (backoff {delay:.1f}s, "
                 f"rollback {rollback}, "
                 f"{self.budget.in_window()}/{self.budget.max_restarts} restarts in window)"
+                + (f": {recovery_reason}" if recovery_reason else "")
             )
             if delay:
                 self._stop.wait(delay)
@@ -767,6 +828,296 @@ class Agent:
         (logger.info if verdict == "clean" else logger.error)(
             f"agent verdict: {verdict} after {attempt} attempt(s), "
             f"{restarts} restart(s), {rollbacks} rollback(s): {reason}"
+        )
+        self.journal.close()
+        if verdict == "clean":
+            return 0
+        if verdict == "preempted":
+            return 128 + (self._stop_signum or signal.SIGTERM)
+        return 1
+
+
+    # -- serving mode (AGENT.SERVE: keep N dtpu-serve replicas alive) --------
+
+    def _serve_ports(self) -> list[int]:
+        """Stable per-replica frontend ports for the whole supervision:
+        SERVE.PORT+rank when pinned, otherwise distinct ephemeral picks that
+        avoid the rendezvous ports in play. Stability matters — a restarted
+        replica must come back on the SAME port, or the clients retrying
+        against the replica set would never find it again."""
+        from distribuuuu_tpu.runtime.dist import (
+            pick_rendezvous_port,
+            rendezvous_ports_in_play,
+        )
+
+        base = int(cfg.SERVE.PORT) if "SERVE" in cfg else 0
+        if base > 0:
+            return [base + r for r in range(self.nprocs)]
+        exclude = set(rendezvous_ports_in_play())
+        ports: list[int] = []
+        for _ in range(self.nprocs):
+            p = pick_rendezvous_port(exclude=exclude)
+            exclude.add(p)
+            ports.append(p)
+        return ports
+
+    def _launch_replica(self, rank: int, attempt: int, port: int) -> Worker:
+        """Spawn ONE serve replica (serve mode restarts individually — the
+        healthy replicas keep serving while a dead one relaunches)."""
+        cmd = self._worker_cmd()
+        agent_dir = os.path.join(cfg.OUT_DIR, "agent", f"attempt_{attempt:03d}")
+        try:
+            worker = Worker(
+                rank,
+                cmd,
+                self._worker_env(rank, attempt, 0, port),
+                os.path.join(agent_dir, f"rank{rank}.log"),
+            )
+        except OSError as exc:
+            raise LaunchError(f"could not spawn {' '.join(cmd)!r}: {exc!r}") from exc
+        worker.attempt = attempt
+        self._workers.append(worker)
+        self.journal.event(
+            "supervisor_launch",
+            attempt=attempt,
+            nprocs=1,
+            rollback=0,
+            port=int(port),
+            cmd=" ".join(cmd),
+            replica=rank,
+        )
+        logger.info(
+            f"agent[serve]: attempt {attempt}: replica {rank} launched on "
+            f"port {port}: {' '.join(cmd)}"
+        )
+        return worker
+
+    def _reap_replica(self, worker: Worker, wall_s: float) -> str:
+        worker.finish()
+        self._workers.remove(worker)
+        code = worker.returncode
+        outcome = resilience.classify_exit_code(code)
+        self.journal.event(
+            "supervisor_exit",
+            attempt=int(getattr(worker, "attempt", 0)),
+            outcome=outcome,
+            codes=[code if code is not None else -1],
+            wall_s=round(wall_s, 3),
+            replica=worker.rank,
+        )
+        logger.info(
+            f"agent[serve]: replica {worker.rank} exited {code} -> {outcome}"
+        )
+        return outcome
+
+    def run_serve(self) -> int:
+        """The serving supervision loop (docs/SERVING.md).
+
+        Differences from the training loop, all forced by what serving is:
+        replicas are independent (per-replica preflight/launch/restart, no
+        exit barrier — one death must not take down the healthy replicas
+        that clients are failing over to), preflight checks the replica's
+        *frontend* port and skips the resume-target scan, and poison exits
+        never escalate rollback (nothing to roll back) — they take the
+        backoff/budget path with a typed reason.
+        """
+        a = cfg.AGENT
+        self._install_signals()
+        tic = time.time()
+        ports = self._serve_ports()
+        self.journal.event(
+            "supervisor_start",
+            nprocs=self.nprocs,
+            max_restarts=int(a.MAX_RESTARTS),
+            restart_window_s=float(a.RESTART_WINDOW_S),
+            cmd=" ".join(self._worker_cmd()),
+            out_dir=str(cfg.OUT_DIR),
+        )
+        attempt = 0
+        restarts = 0
+        verdict: str | None = None
+        reason = ""
+        done: set[int] = set()  # replicas that exited clean (deliberate stop)
+        launch_tic: dict[int, float] = {}
+        slot_attempts: dict[int, int] = {}  # per-replica-slot attempt count
+        # per-slot "don't retry before" deadlines: a backing-off slot must
+        # never block the OTHER slots' relaunches or reaping (replica
+        # independence is the whole point of serve mode), so backoff is a
+        # timestamp gate, not a sleep
+        retry_at: dict[int, float] = {}
+
+        def recover_restart(
+            rank: int, attempt_no: int, outcome: str, reason_txt: str = ""
+        ) -> None:
+            """One replica's restart decision: journal + arm its backoff gate
+            (hang/preempt restart immediately — the replica stopped at a
+            deliberate point; everything else backs off). ``attempt_no`` is
+            the attempt whose failure is being recovered — NOT the global
+            launch counter, which may already belong to another replica."""
+            delay = (
+                0.0
+                if outcome in (resilience.EXIT_HANG, resilience.EXIT_PREEMPTED)
+                else backoff_delay(
+                    self.budget.in_window(), a.BACKOFF_BASE_S, a.BACKOFF_MAX_S
+                )
+            )
+            rec_fields: dict[str, Any] = {"reason": reason_txt} if reason_txt else {}
+            self.journal.event(
+                "supervisor_recovery",
+                attempt=attempt_no,
+                outcome=outcome,
+                action="restart",
+                backoff_s=round(delay, 3),
+                restarts_in_window=self.budget.in_window(),
+                replica=rank,
+                **rec_fields,
+            )
+            logger.warning(
+                f"agent[serve]: replica {rank} {outcome} -> restart "
+                f"(backoff {delay:.1f}s)"
+                + (f": {reason_txt}" if reason_txt else "")
+            )
+            retry_at[rank] = time.monotonic() + delay
+
+        while verdict is None:
+            if self._stop.is_set():
+                verdict, reason = "preempted", f"signal {self._stop_signum}"
+                break
+            # (re)launch every replica slot that should be serving and whose
+            # backoff gate has passed
+            running = {w.rank for w in self._workers}
+            for rank in range(self.nprocs):
+                if (
+                    rank in done
+                    or rank in running
+                    or verdict is not None
+                    or retry_at.get(rank, 0.0) > time.monotonic()
+                ):
+                    continue
+                attempt += 1
+                # a slot's first attempt is the free initial launch; every
+                # further attempt for that slot is a restart under budget
+                is_restart = slot_attempts.get(rank, 0) > 0
+                slot_attempts[rank] = slot_attempts.get(rank, 0) + 1
+                if is_restart and not self.budget.try_spend():
+                    verdict, reason = "gave_up", (
+                        f"{self.budget.max_restarts} replica restarts inside "
+                        f"{self.budget.window_s:.0f}s — crash loop, not a blip"
+                    )
+                    break
+                if is_restart:
+                    restarts += 1
+                pf_tic = time.time()
+                ok, failures, checks = preflight_checks(
+                    cfg.OUT_DIR,
+                    rollback=0,
+                    port=ports[rank],
+                    min_free_disk_gb=float(a.MIN_FREE_DISK_GB),
+                    device_probe=bool(a.PREFLIGHT_DEVICE_PROBE),
+                    device_probe_timeout_s=float(a.DEVICE_PROBE_TIMEOUT_S),
+                    probe_env=self._worker_env(rank, attempt, 0, ports[rank]),
+                    check_resume=False,
+                )
+                self.journal.event(
+                    "supervisor_preflight",
+                    attempt=attempt,
+                    ok=ok,
+                    failures=failures,
+                    checks=checks,
+                    wall_s=round(time.time() - pf_tic, 3),
+                    replica=rank,
+                )
+                failed_how = None
+                if not ok:
+                    failed_how = f"preflight_failed ({', '.join(failures)}): {checks}"
+                    fail_outcome = "preflight_failed"
+                else:
+                    try:
+                        self._launch_replica(rank, attempt, ports[rank])
+                        launch_tic[rank] = time.time()
+                        retry_at.pop(rank, None)
+                    except LaunchError as exc:
+                        failed_how = str(exc)
+                        fail_outcome = "launch_failed"
+                if failed_how is not None:
+                    logger.error(f"agent[serve]: replica {rank}: {failed_how}")
+                    # a failed FIRST attempt spends budget too (the launch
+                    # itself was free only if it worked)
+                    if not is_restart:
+                        if not self.budget.try_spend():
+                            verdict, reason = "gave_up", (
+                                f"replica {rank} could not start "
+                                f"({fail_outcome}) with the restart budget "
+                                f"exhausted"
+                            )
+                            break
+                        restarts += 1
+                    recover_restart(rank, attempt, fail_outcome)
+            if verdict is not None:
+                break
+            if not self._workers and len(done) == self.nprocs:
+                verdict, reason = "clean", "every replica exited cleanly"
+                break
+            # short poll: exits, stop signals and due backoff gates all get
+            # picked up within 0.2s, none blocking the others
+            if not self._stop.is_set() and all(
+                w.returncode is None for w in self._workers
+            ):
+                self._stop.wait(0.2)
+            for worker in [w for w in self._workers if w.returncode is not None]:
+                rank = worker.rank
+                outcome = self._reap_replica(
+                    worker, time.time() - launch_tic.get(rank, time.time())
+                )
+                if self._stop.is_set():
+                    continue  # the loop top turns this into the preempted verdict
+                if outcome == resilience.EXIT_CLEAN:
+                    done.add(rank)
+                    continue
+                recover_restart(
+                    rank,
+                    int(getattr(worker, "attempt", attempt)),
+                    outcome,
+                    (
+                        "serving replica has no checkpoints to roll back — "
+                        "poison handled as a crash (backoff)"
+                        if outcome == resilience.EXIT_POISON
+                        else ""
+                    ),
+                )
+
+        if self._workers:
+            # leave NOTHING behind, whatever the verdict: a preempted agent's
+            # replicas already got the forwarded SIGTERM; a gave_up verdict
+            # (one slot crash-looping) must also take the healthy replicas
+            # down, or they'd orphan — still bound to ports, unsupervised
+            if verdict != "preempted":
+                for w in self._workers:
+                    w.signal(signal.SIGTERM)
+            deadline = time.monotonic() + float(a.EXIT_BARRIER_S)
+            while time.monotonic() < deadline and any(
+                w.returncode is None for w in self._workers
+            ):
+                time.sleep(0.1)
+            for w in list(self._workers):
+                if w.returncode is None:
+                    w.signal(signal.SIGKILL)
+            for w in list(self._workers):
+                w.proc.wait()
+                self._reap_replica(w, 0.0)
+
+        self.journal.event(
+            "supervisor_verdict",
+            verdict=verdict,
+            attempts=attempt,
+            restarts=restarts,
+            rollbacks=0,
+            reason=reason,
+            wall_s=round(time.time() - tic, 3),
+        )
+        (logger.info if verdict == "clean" else logger.error)(
+            f"agent[serve] verdict: {verdict} after {attempt} attempt(s), "
+            f"{restarts} restart(s): {reason}"
         )
         self.journal.close()
         if verdict == "clean":

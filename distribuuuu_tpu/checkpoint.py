@@ -692,6 +692,67 @@ def load_checkpoint(path: str, state: Any, load_opt: bool = True):
     return new_state, 0, 0.0
 
 
+def load_weights(
+    path: str,
+    params_template: Any,
+    batch_stats_template: Any,
+    *,
+    verify_integrity: bool = True,
+):
+    """Read-only weights load: ``(params, batch_stats)`` from any checkpoint.
+
+    The serving engine's load path (docs/SERVING.md): accepts every weights
+    source the repo produces — converted-torch dirs (scripts/convert_torch.py:
+    ``{params, batch_stats}`` only), trained epoch checkpoints (full payload
+    with optimizer state) and ``best`` weights-only saves — and restores
+    ONLY the params/batch_stats subtrees (``transforms={}`` makes the partial
+    item legal), so hosting a trained checkpoint never pays the optimizer
+    state's bytes. Leaves land with the templates' shardings (the same
+    target-sharding-driven elastic contract as `_restore`); the checkpoint
+    directory is never written to — no quarantine, no manifest repair — a
+    serving host must not mutate the training run's artifacts. A corrupt
+    integrity verify raises instead (refusing to serve poisoned weights);
+    "unverified" (no manifest, e.g. a converted dir) loads with a log line.
+    """
+    if verify_integrity:
+        status, errors = verify_checkpoint(path)
+        if status == "corrupt":
+            raise OSError(
+                f"refusing to serve weights from {path}: integrity manifest "
+                f"verification failed ({'; '.join(errors[:5])})"
+            )
+        if status == "unverified":
+            logger.info(f"weights {path}: no integrity manifest (load unverified)")
+
+    def one(leaf):
+        # jax.ShapeDtypeStruct templates (e.g. eval_shape results with a
+        # target sharding attached) pass through untouched — re-templating
+        # could drop the sharding the restore is supposed to land on
+        if isinstance(leaf, jax.ShapeDtypeStruct):
+            return leaf
+        return ocp.utils.to_shape_dtype_struct(leaf)
+
+    template = {
+        "params": jax.tree.map(one, params_template),
+        "batch_stats": jax.tree.map(one, batch_stats_template),
+    }
+    ckptr = _checkpointer()
+    tic = time.time()
+    restored = resilience.retry(
+        ckptr.restore,
+        path,
+        args=ocp.args.PyTreeRestore(
+            item=template,
+            transforms={},  # partial item: untouched payload keys are skipped
+            restore_args=_restore_args_for(template),
+        ),
+        retry_on=(OSError,),
+        desc=f"weights load {path}",
+    )
+    obs.current().event("restore", path=str(path), wall_s=round(time.time() - tic, 4))
+    return restored["params"], restored["batch_stats"]
+
+
 def load_mid_checkpoint(path: str, state: Any, samples_per_step: int | None = None):
     """Restore an emergency checkpoint: (state, epoch, step, best_acc1,
     rng_key). ``epoch`` is the in-progress 0-based epoch to re-enter and

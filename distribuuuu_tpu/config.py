@@ -266,6 +266,68 @@ _C.AGENT.CMD = ""
 # worker's XLA_FLAGS (0 = leave the environment alone). How the CPU chaos
 # tier gives every rank its own single-device "host".
 _C.AGENT.CPU_DEVICES_PER_WORKER = 0
+# Serving mode (docs/SERVING.md): supervise NPROCS independent dtpu-serve
+# replicas instead of one collective training fleet. Replicas get per-rank
+# frontend ports (SERVE.PORT + rank via DTPU_SERVE_PORT, preflight-checked
+# with port_is_free) and are restarted INDIVIDUALLY on death — a replica
+# kill is invisible to clients retrying across the replica set. Poison
+# exits never attempt checkpoint rollback here (a serving replica has no
+# checkpoints): they take the backoff/budget path with a typed reason.
+_C.AGENT.SERVE = False
+
+# Serving (TPU addition; docs/SERVING.md). `dtpu-serve --cfg ...` hosts the
+# model zoo behind a batched inference engine: AOT-compiled forward passes at
+# the BATCH_SIZES ladder, Clipper-style dynamic micro-batching (coalesce
+# pending requests, pad to the next compiled size, dispatch when full or when
+# the queueing-delay bound expires), typed serve_* SLO records through the
+# obs journal.
+_C.SERVE = CN()
+# The compiled batch ladder, ascending. Every request batch is padded up to
+# the smallest listed size ≥ its example count; each size is AOT-compiled
+# (jit().lower().compile()) per hosted model at startup, so steady-state
+# serving never traces or compiles (CompileGuard-pinned in tests).
+_C.SERVE.BATCH_SIZES = [1, 8, 32]
+# Dynamic micro-batching: a dispatch happens when pending examples fill the
+# largest compiled size OR the oldest queued request has waited this long —
+# the knob trading p99 latency (low values) against batch fill (high values).
+_C.SERVE.MAX_QUEUE_DELAY_MS = 5.0
+# Backpressure: max pending examples per hosted model. A request that would
+# exceed it is shed with HTTP 503 + a typed `serve_shed` journal record
+# (never silently); the client-side retry (serve/client.py) absorbs sheds.
+_C.SERVE.MAX_QUEUE_DEPTH = 256
+# Hosted models: "name=arch@weights_path" entries, where weights_path is a
+# converted-torch Orbax dir (scripts/convert_torch.py) or a trained
+# checkpoint dir (OUT_DIR/checkpoints/ckpt_ep_NNN). Requests route by name.
+# Empty: host one model from MODEL.ARCH + MODEL.WEIGHTS.
+_C.SERVE.MODELS = []
+# Frontend bind address. PORT 0 picks a free ephemeral port (printed and
+# journaled); the DTPU_SERVE_PORT env var overrides (how the dtpu-agent
+# serve mode gives each replica its own port without editing YAMLs).
+_C.SERVE.HOST = "127.0.0.1"
+_C.SERVE.PORT = 0
+# "http" (ThreadingHTTPServer, POST /v1/predict + GET /healthz) or "stdin"
+# (JSONL request per line on stdin, JSONL response per line on stdout).
+_C.SERVE.MODE = "http"
+# Input image side the ladder is compiled for (0 → TEST.CROP_SIZE) and the
+# wire dtype ("uint8" raw pixels normalized on device — 4x smaller payloads —
+# or "float32" pre-normalized).
+_C.SERVE.IM_SIZE = 0
+_C.SERVE.INPUT_DTYPE = "uint8"
+# Served classes / compute dtype (0/"" → MODEL.NUM_CLASSES / MODEL.DTYPE).
+_C.SERVE.NUM_CLASSES = 0
+_C.SERVE.DTYPE = ""
+# Execute each compiled ladder entry once at startup (loads executables,
+# flushes lazy backend init) so the first real request doesn't pay it.
+_C.SERVE.WARMUP = True
+# Verify checkpoint integrity manifests before loading weights (corrupt
+# weights fail the load loudly; unverified = no manifest is allowed).
+_C.SERVE.VERIFY_INTEGRITY = True
+# SLO accounting: a `serve_slo` record (p50/p99 latency, QPS, shed count,
+# batch-fill histogram) per model every WINDOW_S seconds (and at shutdown).
+# JOURNAL_REQUESTS additionally journals every request (serve_request) —
+# exact but heavy; turn off for high-QPS deployments and keep the slo rollup.
+_C.SERVE.SLO_WINDOW_S = 10.0
+_C.SERVE.JOURNAL_REQUESTS = True
 
 # Resume policy (TPU addition). Epoch checkpoints stay the primary contract;
 # these govern the extra step-granular/robustness behavior on top.

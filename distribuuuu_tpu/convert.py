@@ -747,6 +747,88 @@ def load_torch_file(path: str, *, unsafe: bool = False) -> Mapping[str, Any]:
         return torch.load(path, map_location="cpu", weights_only=False)
 
 
+# ---------------------------------------------------------------------------
+# Golden-logits fixtures (scripts/validate_pretrained.py --synthetic-init;
+# the serving tests' correctness oracle, docs/SERVING.md)
+# ---------------------------------------------------------------------------
+
+def golden_inputs(n: int, size: int, seed: int = 0) -> np.ndarray:
+    """The fixtures' fixed inputs: seeded standard-normal ``(n, s, s, 3)``
+    float32 — post-normalization scale, like real batches after
+    transforms.normalize. Deterministic across platforms (PCG64)."""
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, size, size, 3), dtype=np.float32)
+
+
+def synthetic_variables(
+    arch: str, init_seed: int, im_size: int, num_classes: int
+) -> dict:
+    """Deterministic seeded-init variables for ``arch`` as host numpy.
+
+    The weights side of a *synthetic* golden fixture: `(arch, init_seed,
+    im_size, num_classes)` fully determines the model (threefry init is
+    platform-stable), so a CPU-sized fixture checked into the repo can be
+    re-derived — and served — anywhere without torch, network, or large
+    checked-in weight files.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distribuuuu_tpu.models import build_model
+
+    model = build_model(arch, num_classes=num_classes, dtype=jnp.float32)
+    variables = model.init(
+        jax.random.PRNGKey(init_seed),
+        jnp.zeros((1, im_size, im_size, 3), jnp.float32),
+        train=False,
+    )
+    out = {k: jax.tree.map(np.asarray, dict(v)) for k, v in variables.items()}
+    out.setdefault("batch_stats", {})
+    return out
+
+
+def golden_fixture(
+    arch: str,
+    *,
+    init_seed: int,
+    im_size: int,
+    num_classes: int,
+    n: int = 4,
+    input_seed: int = 0,
+) -> dict:
+    """Compute a synthetic golden-logits fixture (JSON-ready dict).
+
+    Provenance fields (arch/init_seed/im_size/num_classes/input_seed/n plus
+    the sha256 of the raw input bytes) ride along so a checker can refuse a
+    fixture that does not describe the run being checked — the same gate
+    validate_pretrained.py applies to its torch goldens.
+    """
+    import hashlib
+
+    import jax.numpy as jnp
+
+    from distribuuuu_tpu.models import build_model
+
+    variables = synthetic_variables(arch, init_seed, im_size, num_classes)
+    x = golden_inputs(n, im_size, input_seed)
+    model = build_model(arch, num_classes=num_classes, dtype=jnp.float32)
+    logits = model.apply(
+        {"params": variables["params"], "batch_stats": variables["batch_stats"]},
+        jnp.asarray(x),
+        train=False,
+    )
+    return {
+        "arch": arch,
+        "init_seed": int(init_seed),
+        "im_size": int(im_size),
+        "num_classes": int(num_classes),
+        "input_seed": int(input_seed),
+        "n": int(n),
+        "input_sha256": hashlib.sha256(x.tobytes()).hexdigest(),
+        "logits": np.asarray(logits, dtype=np.float32).tolist(),
+    }
+
+
 def verify_against_model(converted: dict, arch: str, num_classes: int = 1000) -> None:
     """Raise if the converted tree doesn't match the model's expected tree."""
     import jax

@@ -31,6 +31,7 @@ import io
 import json
 import os
 import threading
+import time
 from typing import Any, Iterator
 
 from distribuuuu_tpu.runtime import pathio
@@ -159,32 +160,82 @@ SCHEMA: dict[str, tuple[dict[str, tuple], dict[str, tuple]]] = {
     # gate lists which checks failed and counts against the restart budget
     "supervisor_preflight": (
         {"attempt": _INT, "ok": _BOOL},
-        {"failures": _LIST, "checks": _DICT, "wall_s": _NUM},
+        {"failures": _LIST, "checks": _DICT, "wall_s": _NUM, "replica": _INT},
     ),
     # a worker fleet was launched (attempt is 1-based across the whole
     # supervision, rollback is the resume depth the fleet was launched at)
     "supervisor_launch": (
         {"attempt": _INT, "nprocs": _INT},
-        {"rollback": _INT, "port": _INT, "cmd": _STR},
+        {"rollback": _INT, "port": _INT, "cmd": _STR, "replica": _INT},
     ),
     # a fleet finished one way or another: per-rank exit codes + the merged
     # classification (resilience.classify_exit_code, worst rank wins)
     "supervisor_exit": (
         {"attempt": _INT, "outcome": _STR, "codes": _LIST},
-        {"wall_s": _NUM, "heartbeat_kill": _BOOL},
+        {"wall_s": _NUM, "heartbeat_kill": _BOOL, "replica": _INT},
     ),
     # the recovery policy's decision for a non-clean exit: action is
     # restart | rollback | give_up | preempt_exit, with the parameters the
     # next attempt will use
     "supervisor_recovery": (
         {"attempt": _INT, "outcome": _STR, "action": _STR},
-        {"backoff_s": _NUM, "rollback": _INT, "restarts_in_window": _INT},
+        {
+            "backoff_s": _NUM,
+            "rollback": _INT,
+            "restarts_in_window": _INT,
+            "reason": _STR,
+            "replica": _INT,
+        },
     ),
     # the agent's final word: verdict is clean | gave_up | preempted, with
     # the whole supervision's totals — the record tests and operators gate on
     "supervisor_verdict": (
         {"verdict": _STR, "attempts": _INT, "restarts": _INT},
         {"rollbacks": _INT, "reason": _STR, "wall_s": _NUM},
+    ),
+    # serving (dtpu-serve, docs/SERVING.md) -------------------------------
+    # a serve replica came up: hosted models, compiled batch ladder, bind
+    "serve_start": (
+        {"models": _LIST, "batch_sizes": _LIST, "port": _INT, "replica": _INT},
+        {"host": _STR, "aot_compiles": _INT, "warmup_s": _NUM, "input_dtype": _STR},
+    ),
+    # one served request (SERVE.JOURNAL_REQUESTS; the slo rollup is always on)
+    "serve_request": (
+        {"model": _STR, "n": _INT, "latency_ms": _NUM, "ok": _BOOL},
+        {"queue_ms": _NUM},
+    ),
+    # one dispatched micro-batch: examples packed, compiled size chosen,
+    # fill = examples/batch_size (the padding waste the ladder sizing tunes)
+    "serve_batch": (
+        {
+            "model": _STR,
+            "batch_size": _INT,
+            "examples": _INT,
+            "requests": _INT,
+            "fill": _NUM,
+            "queue_ms": _NUM,
+            "compute_ms": _NUM,
+        },
+        {},
+    ),
+    # periodic per-model SLO rollup: latency percentiles, throughput, sheds,
+    # and the batch-fill histogram (compiled size -> dispatch count)
+    "serve_slo": (
+        {
+            "model": _STR,
+            "window_s": _NUM,
+            "requests": _INT,
+            "shed": _INT,
+            "qps": _NUM,
+            "p50_ms": _NUM,
+            "p99_ms": _NUM,
+        },
+        {"examples": _INT, "mean_fill": _NUM, "fill_hist": _DICT, "batches": _INT},
+    ),
+    # backpressure: a request was shed at the bounded queue (never silent)
+    "serve_shed": (
+        {"model": _STR, "depth": _INT, "max_depth": _INT},
+        {"n": _INT},
     ),
     # counters / memory / profiler ---------------------------------------
     "counters": (
@@ -370,6 +421,52 @@ def _truncate_torn_tail(path: str) -> None:
             f.truncate(0)  # the whole file is one torn line
     except (OSError, FileNotFoundError):
         pass  # nothing to heal / not seekable: append still works
+
+
+class ValidatedJournal:
+    """Schema-validated appends that degrade to a no-op on any failure.
+
+    The shared writer for processes that observe OTHER work — the
+    dtpu-agent supervisor and dtpu-serve replicas: a record that fails
+    validation is dropped loudly (log line), an unopenable journal turns
+    every call into a no-op — supervision/serving must never die of
+    observability. ``path=None`` after construction means degraded.
+    """
+
+    def __init__(self, path: str | None, *, label: str = "journal"):
+        self.path: str | None = None
+        self._label = label
+        self._journal: "Journal | None" = None
+        if path is None:
+            return
+        try:
+            self.path = str(path)
+            self._journal = Journal(self.path)
+        except Exception as exc:  # pragma: no cover - defensive
+            from distribuuuu_tpu.logging import logger
+
+            self.path = None
+            logger.warning(f"{label} unavailable: {exc!r}")
+
+    def event(self, kind: str, **fields: Any) -> None:
+        if self._journal is None:
+            return
+        from distribuuuu_tpu.logging import logger
+
+        record = {"ts": time.time(), "kind": kind, **fields}
+        errors = validate_record(record)
+        if errors:
+            logger.error(f"{self._label}: invalid {kind!r} record dropped: {errors}")
+            return
+        try:
+            self._journal.append(record)
+        except Exception as exc:  # pragma: no cover - defensive
+            logger.warning(f"{self._label} append failed: {exc!r}")
+
+    def close(self) -> None:
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
 
 
 class Journal:

@@ -169,6 +169,78 @@ def render(records: Iterable[dict]) -> str:
         if n_recover == 0 and not by_kind["supervisor_verdict"]:
             out("  (supervision still in progress)")
 
+    # -- serving (dtpu-serve) -----------------------------------------------
+    # only present for serving runs; omitted otherwise so training reports
+    # (and the golden test) are unchanged
+    if by_kind["serve_start"] or by_kind["serve_slo"] or by_kind["serve_shed"]:
+        out("")
+        if by_kind["serve_start"]:
+            s = by_kind["serve_start"][-1]
+            out(
+                f"serving: replica {s.get('replica', '?')} hosting "
+                f"{', '.join(s.get('models', []))} on port {s.get('port', '?')} "
+                f"(ladder {s.get('batch_sizes', [])}, "
+                f"{s.get('aot_compiles', 0)} AOT compile(s), "
+                f"warmup {s.get('warmup_s', 0.0):.2f}s)"
+            )
+        else:
+            out("serving:")
+        # per-model SLO: aggregate every window so the report covers the
+        # whole run, not just the last rollup
+        slo_by_model: dict[str, list[dict]] = defaultdict(list)
+        for r in by_kind["serve_slo"]:
+            slo_by_model[r["model"]].append(r)
+        sheds_by_model: dict[str, int] = defaultdict(int)
+        for r in by_kind["serve_shed"]:
+            sheds_by_model[r["model"]] += 1
+        for model in sorted(set(slo_by_model) | set(sheds_by_model)):
+            rolls = slo_by_model.get(model, [])
+            n_req = sum(r["requests"] for r in rolls)
+            # service-wide elapsed = the wall-clock SPAN the windows cover
+            # (each record's ts is its window end). Summing window_s instead
+            # would double-count time when N replicas journal into one
+            # reassembled journal and understate QPS by a factor of N.
+            window = (
+                max(r["ts"] for r in rolls)
+                - min(r["ts"] - r["window_s"] for r in rolls)
+                if rolls
+                else 0.0
+            )
+            shed = sum(r["shed"] for r in rolls) or sheds_by_model.get(model, 0)
+            # p50: requests-WEIGHTED median of the per-window medians, so an
+            # idle tail window of 1 slow request cannot outvote a window of
+            # 10k fast ones; p99: the worst window's p99 (conservative — the
+            # per-window records keep the precise numbers)
+            weighted = sorted(
+                (r["p50_ms"], r["requests"]) for r in rolls if r["requests"]
+            )
+            p50, half, seen = 0.0, n_req / 2.0, 0
+            for value, weight in weighted:
+                seen += weight
+                if seen >= half:
+                    p50 = value
+                    break
+            p99 = max([r["p99_ms"] for r in rolls if r["requests"]], default=0.0)
+            fill_hist: dict[str, int] = defaultdict(int)
+            fills = []
+            for r in rolls:
+                for size, count in (r.get("fill_hist") or {}).items():
+                    fill_hist[size] += count
+                if r.get("batches"):
+                    fills.append((r.get("mean_fill", 0.0), r["batches"]))
+            mean_fill = (
+                sum(f * b for f, b in fills) / sum(b for _, b in fills) if fills else 0.0
+            )
+            hist_s = ", ".join(
+                f"{size}x{count}" for size, count in sorted(fill_hist.items(), key=lambda kv: int(kv[0]))
+            )
+            out(
+                f"  {model}: {n_req} request(s), "
+                f"qps {n_req / max(window, 1e-9):.1f}, "
+                f"p50 {p50:.1f}ms / p99 {p99:.1f}ms, shed {shed}, "
+                f"batch fill {100.0 * mean_fill:.0f}% [{hist_s or 'no batches'}]"
+            )
+
     # -- checkpoints ---------------------------------------------------------
     saves = [r for r in by_kind["checkpoint"] if r.get("ckpt_kind") != "emergency"]
     if saves or by_kind["restore"]:

@@ -32,6 +32,8 @@ import socket
 import subprocess
 from dataclasses import dataclass
 
+from typing import Iterable
+
 import jax
 
 
@@ -75,16 +77,44 @@ def port_is_free(port: int, host: str = "127.0.0.1") -> bool:
             return False
 
 
-def pick_rendezvous_port() -> int:
+def pick_rendezvous_port(exclude: "Iterable[int]" = ()) -> int:
     """A currently-free ephemeral port for an agent-owned fleet rendezvous.
 
     Best-effort by construction (the probe socket is released before the
     coordinator binds), which is why `port_is_free` re-checks in the
     preflight gate immediately before each launch.
+
+    ``exclude`` names ports this pick must avoid even if the OS offers them —
+    the serve-vs-rendezvous collision case: a host running both a supervised
+    training fleet and dtpu-serve replicas has two subsystems choosing ports
+    independently, and the ephemeral pick landing on a replica's (not yet
+    bound) frontend port would fail every rank's rendezvous one preflight
+    later. The agent passes its replicas' frontend ports here; the serve
+    frontend's own port-0 pick excludes the rendezvous ports in play.
     """
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    excluded = {int(p) for p in exclude}
+    last = 0
+    for _ in range(64):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            last = s.getsockname()[1]
+        if last not in excluded:
+            return last
+    raise OSError(
+        f"could not find a free port outside the excluded set {sorted(excluded)} "
+        f"(last OS offer: {last})"
+    )
+
+
+def rendezvous_ports_in_play() -> set[int]:
+    """Ports the rendezvous machinery may bind on this host — the exclusion
+    set a port-0 serve frontend pick must avoid (the other half of the
+    serve-vs-rendezvous collision fix; see `pick_rendezvous_port`)."""
+    ports = {_DEFAULT_PORT}
+    mp = os.environ.get("MASTER_PORT", "")
+    if mp.isdigit():
+        ports.add(int(mp))
+    return ports
 
 
 def _first_slurm_hostname(nodelist: str) -> str:

@@ -1,0 +1,40 @@
+"""`dtpu-serve`: AOT-compiled batched inference engine (docs/SERVING.md).
+
+The serving surface of the framework — the north star's "heavy traffic"
+path. Three layers, each independently testable:
+
+- **engine** (`serve.engine`): multi-model hosting. Each hosted model (any
+  zoo arch, weights from converted-torch dirs or trained Orbax checkpoints
+  via the integrity-verified `checkpoint.load_weights` path) is AOT-compiled
+  (``jit().lower().compile()``) at a fixed ladder of batch sizes
+  (``SERVE.BATCH_SIZES``) through the persistent compile cache, so
+  steady-state serving never traces or compiles — CompileGuard-pinned.
+- **batcher** (`serve.batcher`): Clipper-style dynamic micro-batching
+  (Crankshaw et al., NSDI'17): coalesce pending requests, pad to the next
+  compiled size, dispatch when full or when ``SERVE.MAX_QUEUE_DELAY_MS``
+  expires; bounded queue depth sheds with a typed ``serve_shed`` journal
+  record, never silently.
+- **frontend** (`serve.frontend` + `serve.client`): a minimal HTTP
+  (``POST /v1/predict``, ``GET /healthz``) or stdin-JSONL frontend with the
+  same ``--cfg``/overrides contract as train_net.py (``dtpu-serve`` console
+  script), and a retrying client that makes a supervised replica kill
+  invisible (zero dropped requests — chaos-tested).
+
+Every request/batch/SLO window flows typed records (``serve_request``,
+``serve_batch``, ``serve_slo``, ``serve_shed``) through the obs journal;
+``python -m distribuuuu_tpu.obs summarize`` renders p50/p99 latency, QPS
+and the batch-fill histogram.
+"""
+
+from distribuuuu_tpu.serve.batcher import (  # noqa: F401
+    MicroBatcher,
+    QueueFullError,
+    SLOTracker,
+)
+from distribuuuu_tpu.serve.client import ServeClient  # noqa: F401
+from distribuuuu_tpu.serve.engine import (  # noqa: F401
+    HostedModel,
+    InferenceEngine,
+    ModelSpec,
+    parse_model_specs,
+)

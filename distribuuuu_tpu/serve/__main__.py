@@ -1,0 +1,6 @@
+"""``python -m distribuuuu_tpu.serve`` — the dtpu-serve replica CLI."""
+
+from distribuuuu_tpu.serve.frontend import serve_main
+
+if __name__ == "__main__":
+    raise SystemExit(serve_main())

@@ -1,0 +1,311 @@
+"""Dynamic micro-batching: the latency/throughput half of dtpu-serve.
+
+Clipper-style adaptive batching (Crankshaw et al., NSDI'17) mapped onto the
+engine's fixed compiled ladder: requests coalesce in a per-model queue, a
+dispatcher thread packs as many whole requests as fit the largest compiled
+size, pads the packed examples up to the *smallest* compiled size that
+holds them, and dispatches when the batch is full or when the oldest
+request has waited ``max_delay_ms`` — the one knob trading p99 latency
+against batch fill. Backpressure is a bounded per-model queue (in
+examples): a request that would exceed it is **shed** — typed
+``serve_shed`` journal record plus a `QueueFullError` the frontend maps to
+HTTP 503 — never silently dropped; the retrying client absorbs sheds the
+same way it absorbs a killed replica.
+
+Eval-mode forward passes are per-example independent (no cross-batch
+statistics), so the padding rows cannot perturb real rows: the engine's
+sliced output for a request is bitwise the direct forward of its examples
+at the same compiled shape (pinned in tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Callable
+
+import numpy as np
+
+from distribuuuu_tpu.logging import logger
+
+
+class QueueFullError(RuntimeError):
+    """The bounded request queue shed this request (backpressure)."""
+
+
+class _Pending:
+    """One queued request: inputs + a done-event the submitter blocks on."""
+
+    __slots__ = ("inputs", "n", "t_enqueue", "event", "result", "error")
+
+    def __init__(self, inputs: np.ndarray):
+        self.inputs = inputs
+        self.n = int(inputs.shape[0])
+        self.t_enqueue = time.monotonic()
+        self.event = threading.Event()
+        self.result: np.ndarray | None = None
+        self.error: BaseException | None = None
+
+
+class SLOTracker:
+    """Per-model SLO accounting → periodic ``serve_slo`` journal records.
+
+    Thread-safe; fed by the batcher (batches, sheds) and the frontend
+    (request latencies). ``maybe_emit`` rolls the window when ``window_s``
+    elapsed; ``flush`` force-emits whatever the window holds (shutdown and
+    the CI smoke call it, so short runs still journal their SLO story).
+    """
+
+    def __init__(self, journal_event: Callable[..., None], window_s: float = 10.0):
+        self._event = journal_event
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._lat: dict[str, list[float]] = {}
+        self._shed: dict[str, int] = {}
+        self._examples: dict[str, int] = {}
+        self._fill: dict[str, dict[int, int]] = {}
+        self._fill_sum: dict[str, float] = {}
+        self._batches: dict[str, int] = {}
+
+    @staticmethod
+    def _rank(sorted_vals: list[float], q: float) -> float:
+        """Nearest-rank percentile (exact for the window's sample set)."""
+        if not sorted_vals:
+            return 0.0
+        return sorted_vals[max(0, min(len(sorted_vals) - 1, math.ceil(q * len(sorted_vals)) - 1))]
+
+    def request(self, model: str, latency_ms: float) -> None:
+        with self._lock:
+            self._lat.setdefault(model, []).append(float(latency_ms))
+
+    def shed(self, model: str) -> None:
+        with self._lock:
+            self._shed[model] = self._shed.get(model, 0) + 1
+
+    def batch(self, model: str, batch_size: int, examples: int) -> None:
+        with self._lock:
+            self._examples[model] = self._examples.get(model, 0) + int(examples)
+            hist = self._fill.setdefault(model, {})
+            hist[int(batch_size)] = hist.get(int(batch_size), 0) + 1
+            self._fill_sum[model] = self._fill_sum.get(model, 0.0) + examples / batch_size
+            self._batches[model] = self._batches.get(model, 0) + 1
+
+    def maybe_emit(self) -> None:
+        if time.monotonic() - self._t0 >= self.window_s:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            window = time.monotonic() - self._t0
+            models = (
+                set(self._lat) | set(self._shed) | set(self._examples)
+            )
+            snapshot = []
+            for m in sorted(models):
+                lat = sorted(self._lat.get(m, []))
+                n = len(lat)
+                batches = self._batches.get(m, 0)
+                snapshot.append(
+                    dict(
+                        model=m,
+                        window_s=round(window, 3),
+                        requests=n,
+                        shed=self._shed.get(m, 0),
+                        qps=round(n / max(window, 1e-9), 3),
+                        p50_ms=round(self._rank(lat, 0.50), 3),
+                        p99_ms=round(self._rank(lat, 0.99), 3),
+                        examples=self._examples.get(m, 0),
+                        mean_fill=(
+                            round(self._fill_sum.get(m, 0.0) / batches, 4) if batches else 0.0
+                        ),
+                        fill_hist={str(k): v for k, v in sorted(self._fill.get(m, {}).items())},
+                        batches=batches,
+                    )
+                )
+            self._lat.clear()
+            self._shed.clear()
+            self._examples.clear()
+            self._fill.clear()
+            self._fill_sum.clear()
+            self._batches.clear()
+            self._t0 = time.monotonic()
+        for fields in snapshot:  # journal outside the lock
+            self._event("serve_slo", **fields)
+
+
+class MicroBatcher:
+    """Per-model coalescing queues in front of an engine runner."""
+
+    def __init__(
+        self,
+        runner: Callable[[str, np.ndarray], np.ndarray],
+        ladders: dict[str, list[int]],
+        *,
+        max_delay_ms: float,
+        max_depth: int,
+        journal_event: Callable[..., None] | None = None,
+        slo: SLOTracker | None = None,
+    ):
+        self._runner = runner
+        self._ladders = {m: sorted(int(b) for b in ladder) for m, ladder in ladders.items()}
+        self.max_delay_s = float(max_delay_ms) / 1000.0
+        self.max_depth = int(max_depth)
+        self._event = journal_event or (lambda kind, **fields: None)
+        self._slo = slo
+        self._cond: dict[str, threading.Condition] = {}
+        self._queue: dict[str, list[_Pending]] = {}
+        self._depth: dict[str, int] = {}
+        self._threads: list[threading.Thread] = []
+        self._stop = False
+        for model in self._ladders:
+            self._cond[model] = threading.Condition()
+            self._queue[model] = []
+            self._depth[model] = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "MicroBatcher":
+        for model in self._ladders:
+            t = threading.Thread(
+                target=self._dispatch_loop,
+                args=(model,),
+                daemon=True,
+                name=f"dtpu-serve-batcher-{model}",
+            )
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        """Drain-free shutdown: queued requests fail with a clear error."""
+        self._stop = True
+        for model, cond in self._cond.items():
+            with cond:
+                for req in self._queue[model]:
+                    req.error = RuntimeError("batcher stopped")
+                    req.event.set()
+                self._queue[model].clear()
+                self._depth[model] = 0
+                cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, model: str, inputs: np.ndarray, timeout_s: float = 60.0) -> np.ndarray:
+        """Block until the request's logits are ready; sheds raise.
+
+        ``inputs`` is ``(n, H, W, C)`` with ``n`` ≤ the model's largest
+        compiled size (a bigger request can't fit any executable — the
+        caller splits, the server never does: split responses would
+        reorder against other requests).
+        """
+        ladder = self._ladders.get(model)
+        if ladder is None:
+            raise KeyError(f"unknown model {model!r}; serving: {sorted(self._ladders)}")
+        n = int(inputs.shape[0])
+        if n < 1:
+            raise ValueError("empty request")
+        if n > ladder[-1]:
+            raise ValueError(
+                f"request of {n} examples exceeds {model!r}'s largest compiled "
+                f"batch {ladder[-1]} — split the request client-side"
+            )
+        req = _Pending(inputs)
+        cond = self._cond[model]
+        with cond:
+            if self._depth[model] + n > self.max_depth:
+                depth = self._depth[model]
+                self._event("serve_shed", model=model, depth=depth, max_depth=self.max_depth, n=n)
+                if self._slo is not None:
+                    self._slo.shed(model)
+                raise QueueFullError(
+                    f"{model!r} queue at {depth}/{self.max_depth} examples — "
+                    f"request of {n} shed (retry against another replica)"
+                )
+            self._queue[model].append(req)
+            self._depth[model] += n
+            cond.notify_all()
+        if not req.event.wait(timeout_s):
+            raise TimeoutError(f"request not served within {timeout_s:.1f}s")
+        if req.error is not None:
+            raise req.error
+        assert req.result is not None
+        return req.result
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _take_batch(self, model: str) -> list[_Pending]:
+        """Wait for work, then coalesce until full or the deadline passes.
+
+        Returns [] only at shutdown. Runs on the model's dispatcher thread.
+        """
+        cond = self._cond[model]
+        max_size = self._ladders[model][-1]
+        with cond:
+            while not self._queue[model] and not self._stop:
+                cond.wait(0.1)
+            if self._stop:
+                return []
+            deadline = self._queue[model][0].t_enqueue + self.max_delay_s
+            while self._depth[model] < max_size and not self._stop:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                cond.wait(remaining)
+            # pack whole requests while they fit the largest executable
+            taken: list[_Pending] = []
+            total = 0
+            queue = self._queue[model]
+            while queue and total + queue[0].n <= max_size:
+                req = queue.pop(0)
+                total += req.n
+                taken.append(req)
+            self._depth[model] -= total
+            return taken
+
+    def _dispatch_loop(self, model: str) -> None:
+        ladder = self._ladders[model]
+        while not self._stop:
+            taken = self._take_batch(model)
+            if not taken:
+                continue
+            n = sum(r.n for r in taken)
+            batch_size = next(b for b in ladder if b >= n)
+            t_dispatch = time.monotonic()
+            queue_ms = 1000.0 * (t_dispatch - min(r.t_enqueue for r in taken))
+            try:
+                first = taken[0].inputs
+                padded = np.zeros((batch_size, *first.shape[1:]), dtype=first.dtype)
+                row = 0
+                for req in taken:
+                    padded[row : row + req.n] = req.inputs
+                    row += req.n
+                logits = self._runner(model, padded)
+                compute_ms = 1000.0 * (time.monotonic() - t_dispatch)
+                row = 0
+                for req in taken:
+                    req.result = logits[row : row + req.n]
+                    row += req.n
+                    req.event.set()
+                self._event(
+                    "serve_batch",
+                    model=model,
+                    batch_size=batch_size,
+                    examples=n,
+                    requests=len(taken),
+                    fill=round(n / batch_size, 4),
+                    queue_ms=round(queue_ms, 3),
+                    compute_ms=round(compute_ms, 3),
+                )
+                if self._slo is not None:
+                    self._slo.batch(model, batch_size, n)
+                    self._slo.maybe_emit()
+            except Exception as exc:  # a bad request must not kill the loop
+                logger.error(f"serve: batch dispatch for {model!r} failed: {exc!r}")
+                for req in taken:
+                    if not req.event.is_set():
+                        req.error = exc
+                        req.event.set()

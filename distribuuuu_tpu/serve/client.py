@@ -1,0 +1,136 @@
+"""Retrying serve client: the zero-dropped-requests half of the chaos story.
+
+A supervised replica dying mid-request surfaces to callers as a connection
+error (or a 503 shed under backpressure). This client turns both into
+bounded retries across the replica set: round-robin over the configured
+ports, full-jitter backoff between attempts, a hard deadline per request.
+With the dtpu-agent's serving mode restarting dead replicas, the retry
+window covers the restart gap — a replica SIGKILL is invisible to callers
+(pinned by the chaos tier in tests/test_serve.py: kill a replica mid-load,
+every in-flight and subsequent request still completes).
+
+Stdlib-only (urllib), so operators can lift it into any client codebase.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import random
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+
+class ServeUnavailable(RuntimeError):
+    """No replica answered within the retry deadline."""
+
+
+class ServeRequestError(RuntimeError):
+    """The server rejected the request as malformed (4xx — retrying is
+    pointless; fix the request)."""
+
+
+class ServeClient:
+    def __init__(
+        self,
+        ports: list[int],
+        host: str = "127.0.0.1",
+        *,
+        deadline_s: float = 30.0,
+        backoff_base_s: float = 0.05,
+        backoff_max_s: float = 1.0,
+        timeout_s: float = 30.0,
+    ):
+        if not ports:
+            raise ValueError("ServeClient needs at least one replica port")
+        self.urls = [f"http://{host}:{int(p)}" for p in ports]
+        self.deadline_s = float(deadline_s)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.timeout_s = float(timeout_s)
+        self.retries = 0  # total retry attempts across the client's lifetime
+        self._next = 0
+        self._rng = random.Random(0x5E17E)
+
+    # -- health --------------------------------------------------------------
+
+    def healthz(self, replica: int = 0, timeout_s: float = 2.0) -> dict | None:
+        """One replica's /healthz, or None when unreachable."""
+        try:
+            with urllib.request.urlopen(
+                f"{self.urls[replica]}/healthz", timeout=timeout_s
+            ) as resp:
+                return json.loads(resp.read())
+        except (urllib.error.URLError, OSError, json.JSONDecodeError, TimeoutError):
+            return None
+
+    def wait_ready(self, deadline_s: float = 120.0) -> dict:
+        """Block until every replica answers /healthz (startup gate)."""
+        deadline = time.monotonic() + deadline_s
+        last: dict | None = None
+        while time.monotonic() < deadline:
+            states = [self.healthz(i) for i in range(len(self.urls))]
+            if all(s is not None for s in states):
+                return states[0]  # type: ignore[return-value]
+            last = next((s for s in states if s), None)
+            time.sleep(0.2)
+        raise ServeUnavailable(
+            f"replicas {self.urls} not all healthy within {deadline_s:.0f}s "
+            f"(last healthy answer: {last})"
+        )
+
+    # -- predict -------------------------------------------------------------
+
+    def predict(self, model: str, inputs: np.ndarray) -> np.ndarray:
+        """Batched inference with retry; returns float32 logits ``(n, K)``.
+
+        Retries connection failures, timeouts and 5xx/503 (shed) responses
+        against the next replica until the deadline; 4xx raises immediately
+        (the request itself is wrong — replaying it can only fail again).
+        """
+        body = json.dumps(
+            {
+                "model": model,
+                "inputs": {
+                    "b64": base64.b64encode(np.ascontiguousarray(inputs).tobytes()).decode(),
+                    "shape": list(inputs.shape),
+                },
+            }
+        ).encode()
+        deadline = time.monotonic() + self.deadline_s
+        attempt = 0
+        last_err: Exception | None = None
+        while time.monotonic() < deadline:
+            url = self.urls[self._next % len(self.urls)]
+            self._next += 1
+            req = urllib.request.Request(
+                f"{url}/v1/predict", data=body, headers={"Content-Type": "application/json"}
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                    payload = json.loads(resp.read())
+                return np.asarray(payload["logits"], dtype=np.float32)
+            except urllib.error.HTTPError as exc:
+                if 400 <= exc.code < 500 and exc.code != 429:
+                    detail = ""
+                    try:
+                        detail = exc.read().decode(errors="replace")
+                    except OSError:
+                        pass
+                    raise ServeRequestError(f"HTTP {exc.code}: {detail}") from exc
+                last_err = exc  # 503 shed / 5xx: retryable
+            except (urllib.error.URLError, OSError, TimeoutError, json.JSONDecodeError) as exc:
+                last_err = exc  # replica down / mid-kill: retryable
+            attempt += 1
+            self.retries += 1
+            delay = self._rng.uniform(
+                0.0, min(self.backoff_max_s, self.backoff_base_s * (2.0**attempt))
+            )
+            time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
+        raise ServeUnavailable(
+            f"no replica served the request within {self.deadline_s:.1f}s "
+            f"(last error: {last_err!r})"
+        )

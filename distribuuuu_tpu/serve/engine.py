@@ -1,0 +1,260 @@
+"""Multi-model AOT inference engine: the compute half of dtpu-serve.
+
+Each hosted model is compiled **ahead of time** at every ladder size with
+``jax.jit(fwd).lower(...).compile()`` — the executables exist before the
+first request arrives, warmed through the persistent XLA compile cache
+(`runtime/compile_cache.py`), so a replica restart re-serves without paying
+compile again and steady-state serving performs **zero** traces/compiles
+(the AOT executables cannot retrace by construction; CompileGuard pins it
+in tests/test_serve.py). This is the XLA-native realization of the
+Clipper/TF-Serving fixed-shape contract: dynamic request sizes are the
+batcher's problem (pad up), never the compiler's (retrace).
+
+Weights load read-only through `checkpoint.load_weights` — converted-torch
+dirs and trained Orbax checkpoints both work, integrity-verified — and are
+committed replicated over the serve mesh; the batch dimension shards over
+the ``data`` axis whenever the compiled size divides the mesh (``MESH.DATA``
+says how many chips serve), falling back to replicated execution for ladder
+sizes smaller than the mesh (batch 1 on an 8-chip host).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distribuuuu_tpu import checkpoint as ckpt
+from distribuuuu_tpu.data.transforms import device_normalize
+from distribuuuu_tpu.logging import logger
+from distribuuuu_tpu.models import build_model
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    """One hosted model: routing name, zoo arch, weights directory."""
+
+    name: str
+    arch: str
+    weights: str
+
+
+def parse_model_specs(entries: list[str]) -> list[ModelSpec]:
+    """Parse ``SERVE.MODELS`` entries (``"name=arch@weights_path"``).
+
+    The separators are fixed and the failure is loud with the full entry —
+    a typo'd spec must not silently host the wrong model under a load
+    balancer. Duplicate names are rejected (routing would be ambiguous).
+    """
+    specs: list[ModelSpec] = []
+    seen: set[str] = set()
+    for entry in entries:
+        head, sep, weights = str(entry).partition("@")
+        name, sep2, arch = head.partition("=")
+        if not (sep and sep2 and name and arch and weights):
+            raise ValueError(
+                f"SERVE.MODELS entry {entry!r} is not 'name=arch@weights_path' "
+                f"(e.g. 'rn50=resnet50@/ckpts/converted_resnet50')"
+            )
+        if name in seen:
+            raise ValueError(f"SERVE.MODELS: duplicate model name {name!r}")
+        seen.add(name)
+        specs.append(ModelSpec(name=name, arch=arch, weights=weights))
+    return specs
+
+
+@dataclass
+class HostedModel:
+    """One model's loaded weights + its compiled batch ladder."""
+
+    spec: ModelSpec
+    params: Any
+    batch_stats: Any
+    # ladder size -> (AOT executable, the sharding its image arg was
+    # compiled for — device_put targets it explicitly before each call)
+    compiled: dict[int, tuple[Any, NamedSharding]] = field(default_factory=dict)
+    load_s: float = 0.0
+    compile_s: float = 0.0
+
+    @property
+    def batch_sizes(self) -> list[int]:
+        return sorted(self.compiled)
+
+    def ladder_size_for(self, n: int) -> int | None:
+        """Smallest compiled batch size ≥ n (None: n exceeds the ladder)."""
+        for b in self.batch_sizes:
+            if b >= n:
+                return b
+        return None
+
+
+class InferenceEngine:
+    """Hosts N models on one mesh behind fixed-shape AOT executables."""
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        *,
+        batch_sizes: list[int],
+        im_size: int,
+        num_classes: int,
+        input_dtype: str = "uint8",
+        compute_dtype: str = "float32",
+        verify_integrity: bool = True,
+    ):
+        if not batch_sizes or sorted(set(int(b) for b in batch_sizes)) != sorted(
+            int(b) for b in batch_sizes
+        ):
+            raise ValueError(f"SERVE.BATCH_SIZES must be distinct, got {batch_sizes}")
+        if any(b < 1 for b in batch_sizes):
+            raise ValueError(f"SERVE.BATCH_SIZES must be >= 1, got {batch_sizes}")
+        if input_dtype not in ("uint8", "float32"):
+            raise ValueError(f"SERVE.INPUT_DTYPE must be uint8/float32, got {input_dtype!r}")
+        self.mesh = mesh
+        self.batch_sizes = sorted(int(b) for b in batch_sizes)
+        self.im_size = int(im_size)
+        self.num_classes = int(num_classes)
+        self.input_dtype = np.dtype(input_dtype)
+        self.compute_dtype = (
+            jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
+        )
+        self.verify_integrity = verify_integrity
+        self.models: dict[str, HostedModel] = {}
+        self._replicated = NamedSharding(mesh, P())
+        self.aot_compiles = 0  # ladder entries compiled (cache hits included)
+
+    # -- loading -------------------------------------------------------------
+
+    def load(self, spec: ModelSpec) -> HostedModel:
+        """Load one model's weights and AOT-compile its ladder."""
+        if spec.name in self.models:
+            raise ValueError(f"model {spec.name!r} already hosted")
+        tic = time.time()
+        model = build_model(
+            spec.arch, num_classes=self.num_classes, dtype=self.compute_dtype
+        )
+
+        def model_init(key):
+            variables = model.init(
+                key,
+                jnp.zeros((1, self.im_size, self.im_size, 3), jnp.float32),
+                train=False,
+            )
+            return variables["params"], variables.get("batch_stats", {})
+
+        # templates priced on abstract shapes (nothing allocated), with the
+        # replicated target sharding attached so load_weights lands restored
+        # leaves directly on the serve mesh
+        abs_params, abs_stats = jax.eval_shape(model_init, jax.random.PRNGKey(0))
+        rep = self._replicated
+
+        def with_sharding(t):
+            return jax.ShapeDtypeStruct(t.shape, t.dtype, sharding=rep)
+
+        params, batch_stats = ckpt.load_weights(
+            spec.weights,
+            jax.tree.map(with_sharding, abs_params),
+            jax.tree.map(with_sharding, abs_stats),
+            verify_integrity=self.verify_integrity,
+        )
+        load_s = time.time() - tic
+        hosted = HostedModel(
+            spec=spec, params=params, batch_stats=batch_stats, load_s=load_s
+        )
+
+        def fwd(p, stats, images):
+            x = device_normalize(images)
+            logits = model.apply({"params": p, "batch_stats": stats}, x, train=False)
+            return logits.astype(jnp.float32)
+
+        # one traced callable reused across the whole ladder: each .lower()
+        # below traces with a different batch shape, each .compile() consults
+        # the persistent cache, and the resulting executables are immutable —
+        # a request can never trigger a retrace, whatever sizes arrive
+        jfwd = jax.jit(fwd, out_shardings=rep)
+        tic = time.time()
+        for b in self.batch_sizes:
+            img_sharding = (
+                NamedSharding(self.mesh, P("data"))
+                if b % int(self.mesh.devices.size) == 0
+                else rep
+            )
+            images_sds = jax.ShapeDtypeStruct(
+                (b, self.im_size, self.im_size, 3),
+                self.input_dtype,
+                sharding=img_sharding,
+            )
+            compiled = jfwd.lower(params, batch_stats, images_sds).compile()
+            hosted.compiled[b] = (compiled, img_sharding)
+            self.aot_compiles += 1
+        hosted.compile_s = time.time() - tic
+        self.models[spec.name] = hosted
+        logger.info(
+            f"serve: hosted {spec.name} ({spec.arch}) from {spec.weights}: "
+            f"weights {load_s:.2f}s, ladder {self.batch_sizes} AOT-compiled in "
+            f"{hosted.compile_s:.2f}s"
+        )
+        return hosted
+
+    def load_all(self, specs: list[ModelSpec]) -> None:
+        for spec in specs:
+            self.load(spec)
+
+    def warmup(self) -> float:
+        """Execute each ladder entry once on zeros: loads executables and
+        flushes any lazy backend init off the first request's latency."""
+        tic = time.time()
+        for hosted in self.models.values():
+            for b, (compiled, sharding) in sorted(hosted.compiled.items()):
+                zeros = np.zeros(
+                    (b, self.im_size, self.im_size, 3), self.input_dtype
+                )
+                np.asarray(
+                    compiled(hosted.params, hosted.batch_stats, jax.device_put(zeros, sharding))
+                )
+        wall = time.time() - tic
+        logger.info(f"serve: warmup ran every (model, batch) pair in {wall:.2f}s")
+        return wall
+
+    # -- inference -----------------------------------------------------------
+
+    def hosted(self, name: str) -> HostedModel:
+        try:
+            return self.models[name]
+        except KeyError:
+            raise KeyError(
+                f"unknown model {name!r}; hosting: {', '.join(sorted(self.models))}"
+            ) from None
+
+    def forward(self, name: str, batch: np.ndarray) -> np.ndarray:
+        """Run one *exactly-ladder-sized* batch; returns float32 logits.
+
+        The batcher owns padding; this layer refuses non-ladder shapes
+        loudly (a silently-retracing fallback would defeat the whole AOT
+        design). ``np.asarray`` is the one host sync of a dispatch — the
+        result IS the response payload, so the fetch is the point.
+        """
+        hosted = self.hosted(name)
+        b = int(batch.shape[0])
+        if b not in hosted.compiled:
+            raise ValueError(
+                f"batch size {b} is not in {name!r}'s compiled ladder "
+                f"{hosted.batch_sizes} — pad to a ladder size first"
+            )
+        if batch.dtype != self.input_dtype:
+            raise ValueError(
+                f"batch dtype {batch.dtype} != compiled input dtype "
+                f"{self.input_dtype} (SERVE.INPUT_DTYPE)"
+            )
+        compiled, sharding = hosted.compiled[b]
+        out = compiled(hosted.params, hosted.batch_stats, jax.device_put(batch, sharding))
+        return np.asarray(out)
+
+    def runner(self) -> Callable[[str, np.ndarray], np.ndarray]:
+        """The batcher-facing dispatch callable."""
+        return self.forward

@@ -134,7 +134,70 @@ def main():
     ap.add_argument("--url", help="override the built-in checkpoint URL")
     ap.add_argument("--tol", type=float, default=1e-4)
     ap.add_argument("--golden", help="write/check a torch-free golden JSON here")
+    ap.add_argument(
+        "--synthetic-init", type=int, default=None, metavar="SEED",
+        help="torch-free SYNTHETIC golden mode: deterministically init the "
+        "arch from this seed (convert.synthetic_variables) instead of "
+        "loading torch weights, and write/check --golden against its "
+        "logits on the fixed seeded inputs. CPU-sized fixtures built this "
+        "way (e.g. --arch resnet18 --im-size 32 --num-classes 8) are the "
+        "serving tests' correctness oracle (tests/fixtures/, docs/SERVING.md)",
+    )
+    ap.add_argument("--im-size", type=int, default=224, help="synthetic mode input side")
+    ap.add_argument("--num-classes", type=int, default=1000, help="synthetic mode classes")
+    ap.add_argument("--n", type=int, default=4, help="synthetic mode fixture batch")
     args = ap.parse_args()
+
+    if args.synthetic_init is not None:
+        if not args.golden:
+            ap.error("--synthetic-init requires --golden (the fixture file)")
+        from distribuuuu_tpu.convert import golden_fixture
+
+        import numpy as np
+
+        fixture = golden_fixture(
+            args.arch,
+            init_seed=args.synthetic_init,
+            im_size=args.im_size,
+            num_classes=args.num_classes,
+            n=args.n,
+        )
+        if os.path.exists(args.golden):
+            with open(args.golden) as f:
+                gold = json.load(f)
+            provenance = (
+                "arch", "init_seed", "im_size", "num_classes", "input_seed",
+                "n", "input_sha256",
+            )
+            mismatches = [
+                f"{k}: golden has {gold.get(k)!r}, this run derives {fixture[k]!r}"
+                for k in provenance
+                if gold.get(k) != fixture[k]
+            ]
+            if mismatches:
+                print(
+                    f"synthetic golden check: {args.golden} does not describe "
+                    f"this check ({'; '.join(mismatches)})"
+                )
+                sys.exit(2)
+            diff = float(
+                np.max(
+                    np.abs(
+                        np.asarray(fixture["logits"], np.float32)
+                        - np.asarray(gold["logits"], np.float32)
+                    )
+                )
+            )
+            print(f"synthetic golden check: max|Δlogit| = {diff:.3e} (tol {args.tol})")
+            sys.exit(0 if diff <= args.tol else 1)
+        with open(args.golden, "w") as f:
+            json.dump(fixture, f)
+        print(
+            f"synthetic golden written to {args.golden} "
+            f"({args.arch}, init_seed={args.synthetic_init}, "
+            f"im_size={args.im_size}, num_classes={args.num_classes})"
+        )
+        sys.exit(0)
 
     from distribuuuu_tpu.convert import (
         convert_state_dict,

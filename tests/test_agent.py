@@ -277,6 +277,14 @@ def test_agent_cli_crash_loop_exhausts_budget(tmp_path):
 
 
 def test_agent_cli_poison_escalates_rollback_then_gives_up(tmp_path):
+    # rollback escalation needs checkpoint history to roll back THROUGH —
+    # a poison exit with an empty OUT_DIR takes the backoff path instead
+    # (the resume-capability guard; tests/test_serve.py pins that side).
+    # Bare ckpt_ep_* dirs scan as candidates and verify as "unverified".
+    for epoch in (1, 2, 3):
+        d = tmp_path / "checkpoints" / f"ckpt_ep_{epoch:03d}"
+        d.mkdir(parents=True)
+        (d / "payload").write_text("x")
     p = _run_agent_cli(tmp_path, [
         "AGENT.CMD", f"sh -c 'exit {resilience.POISON_EXIT_CODE}'",
         "AGENT.MAX_ROLLBACKS", "1",

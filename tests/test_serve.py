@@ -1,0 +1,700 @@
+"""dtpu-serve tests (docs/SERVING.md).
+
+Tiers:
+
+- **units** — micro-batcher (coalesce/pad/deadline/backpressure/shed),
+  SLO tracker, model-spec parsing, input decoding, port-collision fix,
+  partial weights restore. No model compiles.
+- **engine tier** (module-scoped hosted engine, amortized AOT compiles) —
+  multi-model routing, golden-logit equality against the checked-in
+  synthetic fixtures (tests/fixtures/, written by
+  ``scripts/validate_pretrained.py --synthetic-init``), bitwise
+  engine-vs-direct-forward equality, CompileGuard zero steady-state
+  recompiles under mixed batch sizes, in-process HTTP round trip.
+- **agent tier** — poison-with-no-history takes the backoff path (the
+  resume-capability guard), serve-mode supervision chaos: kill a replica
+  mid-load, the retrying client sees zero dropped requests (slow/chaos).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures")
+
+from distribuuuu_tpu import agent, resilience  # noqa: E402
+from distribuuuu_tpu.obs.journal import read_journal, validate_journal  # noqa: E402
+from distribuuuu_tpu.serve.batcher import MicroBatcher, QueueFullError, SLOTracker  # noqa: E402
+from distribuuuu_tpu.serve.engine import parse_model_specs  # noqa: E402
+from distribuuuu_tpu.serve.frontend import BadRequest, decode_inputs  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _journal_path(out_dir):
+    return os.path.join(str(out_dir), "telemetry.jsonl")
+
+
+def _by_kind(records, kind):
+    return [r for r in records if r.get("kind") == kind]
+
+
+def _save_weights(path, arch, init_seed, im_size, num_classes, manifest=True):
+    """Write a synthetic weights dir the engine can host (convert-style
+    Orbax layout; manifest optional to cover the integrity-verified path)."""
+    import orbax.checkpoint as ocp
+
+    from distribuuuu_tpu import checkpoint as ckpt
+    from distribuuuu_tpu.convert import synthetic_variables
+
+    variables = synthetic_variables(arch, init_seed, im_size, num_classes)
+    if not variables["batch_stats"]:
+        variables = {"params": variables["params"]}  # BN-free arch (vit)
+    ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).save(
+        os.path.abspath(str(path)), variables, force=True
+    )
+    if manifest:
+        ckpt.write_manifest(str(path))
+    return str(path)
+
+
+# ---------------------------------------------------------------------------
+# units: spec parsing / input decoding / ports
+# ---------------------------------------------------------------------------
+
+def test_parse_model_specs():
+    specs = parse_model_specs(["a=resnet18@/w/a", "b=vit_s16@/w/b"])
+    assert [(s.name, s.arch, s.weights) for s in specs] == [
+        ("a", "resnet18", "/w/a"), ("b", "vit_s16", "/w/b"),
+    ]
+    with pytest.raises(ValueError, match="name=arch@weights_path"):
+        parse_model_specs(["resnet18@/w/a"])  # no name
+    with pytest.raises(ValueError, match="name=arch@weights_path"):
+        parse_model_specs(["a=resnet18"])  # no weights
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_model_specs(["a=resnet18@/w/a", "a=resnet18@/w/b"])
+
+
+def test_decode_inputs_shapes_and_b64():
+    import base64
+
+    x = np.arange(2 * 4 * 4 * 3, dtype=np.float32).reshape(2, 4, 4, 3)
+    got = decode_inputs(x.tolist(), 4, np.dtype("float32"))
+    assert np.array_equal(got, x)
+    got = decode_inputs(
+        {"b64": base64.b64encode(x.tobytes()).decode(), "shape": [2, 4, 4, 3]},
+        4, np.dtype("float32"),
+    )
+    assert np.array_equal(got, x)
+    # single example gets an implicit batch dim
+    assert decode_inputs(x[0].tolist(), 4, np.dtype("float32")).shape == (1, 4, 4, 3)
+    with pytest.raises(BadRequest, match="shape"):
+        decode_inputs(np.zeros((2, 5, 5, 3), np.float32).tolist(), 4, np.dtype("float32"))
+    with pytest.raises(BadRequest, match="b64"):
+        decode_inputs({"b64": "!!!", "shape": [1, 4, 4, 3]}, 4, np.dtype("float32"))
+
+
+def test_pick_rendezvous_port_respects_exclusion():
+    from distribuuuu_tpu.runtime.dist import pick_rendezvous_port
+
+    p = pick_rendezvous_port()
+    # asking again while excluding the first pick must return a different port
+    q = pick_rendezvous_port(exclude={p})
+    assert q != p
+
+
+def test_serve_frontend_ports_excluded_from_rendezvous(fresh_cfg):
+    fresh_cfg.SERVE.PORT = 18000
+    fresh_cfg.AGENT.NPROCS = 2
+    ports = agent._serve_frontend_ports()
+    assert 18000 in ports and 18001 in ports
+    fresh_cfg.SERVE.PORT = 0
+    assert agent._serve_frontend_ports() == set()
+
+
+# ---------------------------------------------------------------------------
+# units: micro-batcher
+# ---------------------------------------------------------------------------
+
+class _Recorder:
+    """Fake engine runner: identity-ish logits recording dispatched sizes."""
+
+    def __init__(self, block_event=None):
+        self.batches = []
+        self.block = block_event
+
+    def __call__(self, model, batch):
+        if self.block is not None:
+            self.block.wait(5.0)
+        self.batches.append((model, batch.shape[0]))
+        # logits = per-row checksum so request slicing is verifiable
+        return batch.reshape(batch.shape[0], -1).sum(axis=1, keepdims=True)
+
+
+def _events_sink():
+    events = []
+
+    def event(kind, **fields):
+        events.append({"kind": kind, **fields})
+
+    return events, event
+
+
+def test_batcher_coalesces_concurrent_requests_into_one_padded_batch():
+    runner = _Recorder()
+    events, sink = _events_sink()
+    b = MicroBatcher(
+        runner, {"m": [1, 8]}, max_delay_ms=100, max_depth=64, journal_event=sink
+    ).start()
+    try:
+        xs = [np.full((1, 2, 2, 3), i, np.float32) for i in range(5)]
+        results = {}
+        threads = [
+            threading.Thread(target=lambda i=i: results.update({i: b.submit("m", xs[i])}))
+            for i in range(5)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i in range(5):
+            assert results[i].shape == (1, 1)
+            assert results[i][0, 0] == pytest.approx(12.0 * i)
+        # 5 examples coalesced into one batch padded to the next ladder size
+        assert runner.batches == [("m", 8)]
+        (batch_rec,) = _by_kind(events, "serve_batch")
+        assert batch_rec["examples"] == 5 and batch_rec["batch_size"] == 8
+        assert batch_rec["requests"] == 5 and batch_rec["fill"] == pytest.approx(5 / 8)
+    finally:
+        b.stop()
+
+
+def test_batcher_deadline_dispatches_partial_batch():
+    runner = _Recorder()
+    b = MicroBatcher(runner, {"m": [4]}, max_delay_ms=30, max_depth=64).start()
+    try:
+        tic = time.monotonic()
+        out = b.submit("m", np.ones((1, 2, 2, 3), np.float32))
+        wall = time.monotonic() - tic
+        assert out.shape == (1, 1)
+        assert runner.batches == [("m", 4)]  # padded up, dispatched alone
+        assert wall < 5.0  # deadline fired, not a full-batch wait
+    finally:
+        b.stop()
+
+
+def test_batcher_sheds_over_depth_with_typed_event():
+    gate = threading.Event()
+    runner = _Recorder(block_event=gate)
+    events, sink = _events_sink()
+    slo = SLOTracker(sink, window_s=9999)
+    b = MicroBatcher(
+        runner, {"m": [1, 2]}, max_delay_ms=1, max_depth=2,
+        journal_event=sink, slo=slo,
+    ).start()
+    try:
+        x = np.ones((1, 2, 2, 3), np.float32)
+        threads = [
+            threading.Thread(target=lambda: b.submit("m", x, timeout_s=30))
+            for _ in range(2)
+        ]
+        for t in threads:
+            t.start()
+        # give the 2 queued examples time to hit the depth bound; the 3rd
+        # must shed loudly while the runner is still blocked
+        deadline = time.monotonic() + 5.0
+        shed = False
+        while time.monotonic() < deadline and not shed:
+            try:
+                b.submit("m", x, timeout_s=0.05)
+            except QueueFullError:
+                shed = True
+            except TimeoutError:
+                pass
+        gate.set()
+        for t in threads:
+            t.join()
+        assert shed, "third request never shed at depth 2"
+        assert _by_kind(events, "serve_shed"), "shed was not journaled"
+        rec = _by_kind(events, "serve_shed")[0]
+        assert rec["model"] == "m" and rec["max_depth"] == 2
+    finally:
+        gate.set()
+        b.stop()
+
+
+def test_batcher_rejects_oversize_and_unknown():
+    b = MicroBatcher(_Recorder(), {"m": [1, 4]}, max_delay_ms=1, max_depth=64).start()
+    try:
+        with pytest.raises(ValueError, match="exceeds"):
+            b.submit("m", np.ones((5, 2, 2, 3), np.float32))
+        with pytest.raises(KeyError, match="unknown model"):
+            b.submit("nope", np.ones((1, 2, 2, 3), np.float32))
+    finally:
+        b.stop()
+
+
+def test_slo_tracker_rollup_fields():
+    events, sink = _events_sink()
+    slo = SLOTracker(sink, window_s=9999)
+    for ms in (1.0, 2.0, 3.0, 100.0):
+        slo.request("m", ms)
+    slo.batch("m", 8, 5)
+    slo.batch("m", 1, 1)
+    slo.shed("m")
+    slo.flush()
+    (rec,) = _by_kind(events, "serve_slo")
+    assert rec["requests"] == 4 and rec["shed"] == 1 and rec["examples"] == 6
+    assert rec["p50_ms"] == pytest.approx(2.0)  # nearest-rank: ceil(0.5*4)-1
+    assert rec["p99_ms"] == pytest.approx(100.0)  # ceil(0.99*4)-1 = 3
+    assert rec["fill_hist"] == {"1": 1, "8": 1}
+    assert rec["mean_fill"] == pytest.approx((5 / 8 + 1) / 2)
+    slo.flush()  # empty window emits nothing
+    assert len(_by_kind(events, "serve_slo")) == 1
+
+
+# ---------------------------------------------------------------------------
+# checkpoint.load_weights (read-only partial restore)
+# ---------------------------------------------------------------------------
+
+def test_load_weights_partial_restore_from_full_checkpoint(tmp_path):
+    """A full trainer checkpoint (params+opt_state+epoch) loads weights-only
+    — the serving path never restores (or needs templates for) opt state."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    from distribuuuu_tpu import checkpoint as ckpt
+
+    params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    stats = {"bn": {"mean": np.ones(3, np.float32)}}
+    full = {
+        "epoch": np.int32(4),
+        "params": params,
+        "batch_stats": stats,
+        "opt_state": {"momentum": np.full((2, 3), 7.0, np.float32)},
+        "best_acc1": np.float32(0.5),
+    }
+    path = str(tmp_path / "ck")
+    ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).save(path, full)
+    ckpt.write_manifest(path)
+    got_params, got_stats = ckpt.load_weights(path, params, stats)
+    assert np.array_equal(np.asarray(got_params["w"]), params["w"])
+    assert np.array_equal(np.asarray(got_stats["bn"]["mean"]), stats["bn"]["mean"])
+
+    # corrupt weights refuse to serve (and the dir is NOT quarantined:
+    # load_weights is read-only over someone else's artifacts)
+    data_files = [
+        f for f in os.listdir(path)
+        if f != "dtpu_manifest.json" and os.path.isfile(os.path.join(path, f))
+    ]
+    victim = os.path.join(path, sorted(data_files)[0])
+    with open(victim, "r+b") as f:
+        f.seek(0)
+        byte = f.read(1)
+        f.seek(0)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    with pytest.raises(OSError, match="integrity"):
+        ckpt.load_weights(path, params, stats)
+    assert os.path.isdir(path), "read-only load path must not quarantine"
+
+
+# ---------------------------------------------------------------------------
+# engine tier: two hosted models, shared across tests (AOT compiles amortized)
+# ---------------------------------------------------------------------------
+
+IM = 32
+NC = 8
+LADDER = [1, 4, 8]
+RN_SEED, VIT_SEED = 7, 11  # must match tests/fixtures/golden_*_s32.json
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    """A live in-process replica: engine (resnet18 + vit_s16 from synthetic
+    weights dirs), batcher, SLO, journal, HTTP ingress on an ephemeral port."""
+    from distribuuuu_tpu import config
+    from distribuuuu_tpu.runtime import data_mesh
+    from distribuuuu_tpu.serve.engine import ModelSpec
+    from distribuuuu_tpu.serve.frontend import ServeReplica, run_http
+
+    tmp = tmp_path_factory.mktemp("serve")
+    rn = _save_weights(tmp / "rn18", "resnet18", RN_SEED, IM, NC)
+    vit = _save_weights(tmp / "vit", "vit_s16", VIT_SEED, IM, NC, manifest=False)
+
+    config.reset_cfg()
+    c = config.cfg
+    c.OUT_DIR = str(tmp)
+    c.MODEL.NUM_CLASSES = NC
+    c.SERVE.BATCH_SIZES = list(LADDER)
+    c.SERVE.IM_SIZE = IM
+    c.SERVE.INPUT_DTYPE = "float32"
+    c.SERVE.DTYPE = "float32"
+    c.SERVE.MAX_QUEUE_DELAY_MS = 5.0
+    c.SERVE.MAX_QUEUE_DEPTH = 64
+    c.SERVE.SLO_WINDOW_S = 9999.0
+    c.SERVE.HOST = "127.0.0.1"
+    c.SERVE.PORT = 0
+
+    mesh = data_mesh(-1)
+    replica = ServeReplica(
+        mesh,
+        [ModelSpec("rn18", "resnet18", rn), ModelSpec("vit", "vit_s16", vit)],
+        str(tmp),
+    )
+    stop = threading.Event()
+    server_thread = threading.Thread(
+        target=run_http, args=(replica, stop), daemon=True
+    )
+    server_thread.start()
+    deadline = time.monotonic() + 60
+    while replica.port == 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert replica.port, "http ingress never bound"
+    yield replica
+    stop.set()
+    server_thread.join(timeout=10)
+    replica.shutdown()
+    config.reset_cfg()
+
+
+def _golden(name):
+    with open(os.path.join(FIXTURES, f"golden_{name}_s32.json")) as f:
+        return json.load(f)
+
+
+def test_engine_golden_logits_and_routing(served):
+    """Engine output == checked-in golden fixture == direct forward, and
+    requests route to the model they named."""
+    import hashlib
+
+    from distribuuuu_tpu.convert import golden_inputs
+
+    for model_name, arch in (("rn18", "resnet18"), ("vit", "vit_s16")):
+        gold = _golden(arch)
+        assert gold["im_size"] == IM and gold["num_classes"] == NC
+        x = golden_inputs(gold["n"], IM, gold["input_seed"])
+        assert hashlib.sha256(x.tobytes()).hexdigest() == gold["input_sha256"]
+        got = served.batcher.submit(model_name, x)
+        want = np.asarray(gold["logits"], np.float32)
+        assert got.shape == want.shape == (gold["n"], NC)
+        diff = float(np.max(np.abs(got - want)))
+        assert diff <= 1e-4, f"{model_name}: engine vs golden max|Δlogit|={diff:.3e}"
+        assert np.array_equal(got.argmax(1), want.argmax(1))
+
+
+def test_engine_matches_direct_forward_bitwise(served):
+    """The batched+padded engine path is BITWISE the direct jitted forward
+    of the same program at the same compiled shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from distribuuuu_tpu.convert import golden_inputs
+    from distribuuuu_tpu.data.transforms import device_normalize
+    from distribuuuu_tpu.models import build_model
+
+    x = golden_inputs(3, IM, 5)
+    got = served.batcher.submit("rn18", x)  # pads 3 -> ladder size 4
+
+    model = build_model("resnet18", num_classes=NC, dtype=jnp.float32)
+    hosted = served.engine.models["rn18"]
+
+    def fwd(p, stats, images):
+        logits = model.apply(
+            {"params": p, "batch_stats": stats}, device_normalize(images), train=False
+        )
+        return logits.astype(jnp.float32)
+
+    padded = np.zeros((4, IM, IM, 3), np.float32)
+    padded[:3] = x
+    jfwd = jax.jit(fwd)  # bound once; one-shot oracle call (not a loop)
+    direct = np.asarray(jfwd(hosted.params, hosted.batch_stats, padded))
+    assert np.array_equal(got, direct[:3]), (
+        f"engine vs direct forward differ by "
+        f"{np.max(np.abs(got - direct[:3])):.3e}"
+    )
+
+
+def test_engine_zero_recompiles_under_mixed_batch_sizes(served):
+    """The AOT ladder serves every arriving size with ZERO compiles after
+    warmup — the CompileGuard proof of the fixed-shape design."""
+    from distribuuuu_tpu.analysis.guards import CompileGuard
+
+    sizes = [1, 4, 8, 3, 1, 8, 2, 4]
+    with CompileGuard(exact=0, name="serve steady state") as guard:
+        for i, n in enumerate(sizes):
+            for model in ("rn18", "vit"):
+                x = np.random.default_rng(i).standard_normal(
+                    (n, IM, IM, 3), dtype=np.float32
+                )
+                out = served.batcher.submit(model, x)
+                assert out.shape == (n, NC)
+    assert guard.compiles == 0
+
+
+def test_engine_rejects_non_ladder_batch_and_wrong_dtype(served):
+    with pytest.raises(ValueError, match="compiled ladder"):
+        served.engine.forward("rn18", np.zeros((3, IM, IM, 3), np.float32))
+    with pytest.raises(ValueError, match="dtype"):
+        served.engine.forward("rn18", np.zeros((4, IM, IM, 3), np.uint8))
+
+
+def test_http_round_trip_and_journal(served):
+    """Mixed-size concurrent requests over real HTTP: zero drops, correct
+    routing, journal schema-validates, summarize renders the serving
+    section with p50/p99/QPS and the batch-fill histogram."""
+    from distribuuuu_tpu.obs.summarize import render
+    from distribuuuu_tpu.serve.client import ServeClient, ServeRequestError
+
+    client = ServeClient([served.port], deadline_s=30)
+    health = client.healthz()
+    assert health and sorted(health["models"]) == ["rn18", "vit"]
+
+    errors = []
+    results = {}
+
+    def fire(i):
+        model = ("rn18", "vit")[i % 2]
+        n = (1, 2, 4, 8)[i % 4]
+        # per-thread rng: np.random.Generator is not thread-safe
+        x = np.random.default_rng(i).standard_normal((n, IM, IM, 3), dtype=np.float32)
+        try:
+            results[i] = (model, client.predict(model, x))
+        except Exception as exc:  # noqa: BLE001 - the assertion IS "no errors"
+            errors.append((i, exc))
+
+    threads = [threading.Thread(target=fire, args=(i,)) for i in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, f"dropped/failed requests: {errors}"
+    assert len(results) == 12
+    for i, (model, logits) in results.items():
+        assert logits.shape == ((1, 2, 4, 8)[i % 4], NC)
+
+    # a malformed request is a 4xx, not a retry loop (and an oversize
+    # request must be a 400, not a retryable 500 replayed until deadline)
+    with pytest.raises(ServeRequestError):
+        client.predict("rn18", np.zeros((1, IM + 1, IM + 1, 3), np.float32))
+    with pytest.raises(ServeRequestError):
+        client.predict("no_such_model", np.zeros((1, IM, IM, 3), np.float32))
+    with pytest.raises(ServeRequestError):
+        client.predict("rn18", np.zeros((LADDER[-1] + 1, IM, IM, 3), np.float32))
+    with pytest.raises(ServeRequestError):
+        client.predict("rn18", np.zeros((0, IM, IM, 3), np.float32))
+
+    served.slo.flush()
+    path = served.journal.path
+    assert validate_journal(path) == []
+    recs = list(read_journal(path))
+    assert _by_kind(recs, "serve_start"), "serve_start record missing"
+    start = _by_kind(recs, "serve_start")[-1]
+    assert start["batch_sizes"] == LADDER and start["aot_compiles"] == 2 * len(LADDER)
+    assert _by_kind(recs, "serve_batch") and _by_kind(recs, "serve_request")
+    slo = _by_kind(recs, "serve_slo")
+    assert {r["model"] for r in slo} >= {"rn18", "vit"}
+    report = render(recs)
+    assert "serving: replica" in report
+    assert "rn18:" in report and "p99" in report and "batch fill" in report
+
+
+# ---------------------------------------------------------------------------
+# agent tier: poison guard + serve-mode supervision
+# ---------------------------------------------------------------------------
+
+def _run_agent_inproc(out_dir, overrides):
+    """Drive Agent.run() in-process (signal install degrades off main thread
+    only in embedded use; here we ARE on the main thread)."""
+    from distribuuuu_tpu import config
+
+    config.reset_cfg()
+    config.cfg.merge_from_list(
+        [
+            "OUT_DIR", str(out_dir),
+            "AGENT.PREFLIGHT_DEVICE_PROBE", "False",
+            "AGENT.MIN_FREE_DISK_GB", "0",
+            "AGENT.BACKOFF_BASE_S", "0.01",
+            "AGENT.BACKOFF_MAX_S", "0.05",
+            *[str(x) for x in overrides],
+        ]
+    )
+    prev = {s: signal.getsignal(s) for s in (signal.SIGTERM, signal.SIGINT)}
+    try:
+        ag = agent.Agent([])
+        code = ag.run()
+    finally:
+        for s, handler in prev.items():
+            signal.signal(s, handler)
+        config.reset_cfg()
+    return code
+
+
+def test_agent_poison_without_history_takes_backoff_path(tmp_path):
+    """A resume-incapable worker (serving replica: no checkpoints) exiting
+    poison must ride the crash backoff/budget path with a typed reason —
+    never escalate DTPU_RESUME_ROLLBACK against empty history."""
+    code = _run_agent_inproc(tmp_path, [
+        "AGENT.CMD", f"sh -c 'exit {resilience.POISON_EXIT_CODE}'",
+        "AGENT.MAX_RESTARTS", "2", "AGENT.MAX_ROLLBACKS", "5",
+    ])
+    assert code == 1
+    recs = list(read_journal(_journal_path(tmp_path)))
+    assert validate_journal(_journal_path(tmp_path)) == []
+    exits = _by_kind(recs, "supervisor_exit")
+    assert all(r["outcome"] == resilience.EXIT_POISON for r in exits)
+    recoveries = _by_kind(recs, "supervisor_recovery")
+    assert recoveries and all(r["action"] == "restart" for r in recoveries)
+    assert all(r["rollback"] == 0 for r in recoveries)
+    assert all("no checkpoint history" in r.get("reason", "") for r in recoveries)
+    # every relaunch stayed at rollback depth 0
+    assert all(r["rollback"] == 0 for r in _by_kind(recs, "supervisor_launch"))
+    (verdict,) = _by_kind(recs, "supervisor_verdict")
+    assert verdict["verdict"] == "gave_up" and verdict["rollbacks"] == 0
+    assert "crash loop" in verdict["reason"]
+
+
+@pytest.mark.slow
+def test_serve_stdin_jsonl_mode(tmp_path):
+    """SERVE.MODE stdin: JSONL request per line in, JSONL response per line
+    out — the zero-socket smoke path, through the real CLI contract."""
+    weights = _save_weights(tmp_path / "w", "resnet18", RN_SEED, 16, 4)
+    req = json.dumps(
+        {"model": "rn", "inputs": np.zeros((1, 16, 16, 3), np.float32).tolist()}
+    )
+    bad = json.dumps(
+        {"model": "nope", "inputs": np.zeros((1, 16, 16, 3), np.float32).tolist()}
+    )
+    p = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "tests", "_serve_worker.py"),
+            "OUT_DIR", str(tmp_path), "MODEL.NUM_CLASSES", "4",
+            "SERVE.MODELS", f"['rn=resnet18@{weights}']",
+            "SERVE.BATCH_SIZES", "[1,2]", "SERVE.IM_SIZE", "16",
+            "SERVE.INPUT_DTYPE", "float32", "SERVE.DTYPE", "float32",
+            "SERVE.MODE", "stdin",
+        ],
+        input=req + "\n" + bad + "\n",
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    lines = [json.loads(line) for line in p.stdout.splitlines() if line.startswith("{")]
+    assert len(lines) == 2, p.stdout
+    assert np.asarray(lines[0]["logits"]).shape == (1, 4)
+    assert lines[1].get("error") == "bad_request"
+    assert validate_journal(_journal_path(tmp_path)) == []
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_serve_chaos_replica_kill_zero_drops(tmp_path):
+    """Kill a supervised serve replica mid-load: the agent restarts it on
+    the SAME port, the retrying client completes every request (zero
+    drops), and the whole story is typed journal records."""
+    from distribuuuu_tpu.runtime.dist import pick_rendezvous_port
+    from distribuuuu_tpu.serve.client import ServeClient
+
+    weights = _save_weights(tmp_path / "w_rn", "resnet18", RN_SEED, 16, 4)
+    port = pick_rendezvous_port()
+    # AGENT.CMD is shlex-split: the list literal needs quoting that SURVIVES
+    # the split so the replica's own merge_from_list sees valid python
+    worker_overrides = (
+        f"OUT_DIR {tmp_path} MODEL.NUM_CLASSES 4 "
+        f'SERVE.MODELS "[\'rn=resnet18@{weights}\']" SERVE.BATCH_SIZES [1,4] '
+        f"SERVE.IM_SIZE 16 SERVE.INPUT_DTYPE float32 SERVE.DTYPE float32 "
+        f"SERVE.MAX_QUEUE_DELAY_MS 2 SERVE.SLO_WINDOW_S 1 SERVE.HOST 127.0.0.1"
+    )
+    cmd = [
+        sys.executable, "-m", "distribuuuu_tpu.agent",
+        "OUT_DIR", str(tmp_path),
+        "AGENT.SERVE", "True",
+        "AGENT.NPROCS", "1",
+        "AGENT.PREFLIGHT_DEVICE_PROBE", "False",
+        "AGENT.MIN_FREE_DISK_GB", "0",
+        "AGENT.BACKOFF_BASE_S", "0.01",
+        "AGENT.BACKOFF_MAX_S", "0.05",
+        "AGENT.MAX_RESTARTS", "5",
+        "SERVE.PORT", str(port),
+        "AGENT.CMD",
+        f"{sys.executable} {os.path.join(REPO, 'tests', '_serve_worker.py')} "
+        + worker_overrides,
+    ]
+    # anchored: the AGENT's cmdline also CONTAINS the worker command (inside
+    # its AGENT.CMD argument) — an unanchored pkill would kill the supervisor
+    marker = f"^{sys.executable} {os.path.join(REPO, 'tests', '_serve_worker.py')}"
+    proc = subprocess.Popen(
+        cmd, cwd=REPO, env=dict(os.environ), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        client = ServeClient([port], deadline_s=60)
+        client.wait_ready(deadline_s=180)  # replica up + ladder compiled
+
+        rng = np.random.default_rng(3)
+        n_requests = 24
+        killed = threading.Event()
+
+        def killer():
+            # let a few requests land, then SIGKILL the replica process
+            time.sleep(0.5)
+            out = subprocess.run(
+                ["pkill", "-9", "-f", marker], capture_output=True, text=True
+            )
+            killed.set()
+            assert out.returncode == 0, f"no replica process matched: {marker}"
+
+        kt = threading.Thread(target=killer)
+        kt.start()
+        failures = []
+        for i in range(n_requests):
+            x = rng.standard_normal(((1, 2)[i % 2], 16, 16, 3), dtype=np.float32)
+            try:
+                logits = client.predict("rn", x)
+                assert logits.shape == (x.shape[0], 4)
+            except Exception as exc:  # noqa: BLE001
+                failures.append((i, repr(exc)))
+            time.sleep(0.1)
+        kt.join()
+        assert killed.is_set()
+        assert not failures, f"dropped requests across the replica kill: {failures}"
+        assert client.retries > 0, "the kill was never even visible — dead test"
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        subprocess.run(["pkill", "-9", "-f", marker], capture_output=True)
+
+    recs = list(read_journal(_journal_path(tmp_path)))
+    assert validate_journal(_journal_path(tmp_path)) == []
+    exits = _by_kind(recs, "supervisor_exit")
+    assert any(r["outcome"] == resilience.EXIT_KILLED for r in exits), exits
+    recoveries = _by_kind(recs, "supervisor_recovery")
+    assert any(
+        r["action"] == "restart" and r.get("replica") == 0 for r in recoveries
+    ), recoveries
+    # exit→recovery records correlate by attempt (the killed replica's own
+    # attempt, never the global launch counter)
+    killed_attempts = {
+        r["attempt"] for r in exits if r["outcome"] == resilience.EXIT_KILLED
+    }
+    assert any(r["attempt"] in killed_attempts for r in recoveries), (
+        exits, recoveries,
+    )
+    launches = _by_kind(recs, "supervisor_launch")
+    assert len(launches) >= 2  # initial + the restart
+    assert all(r["port"] == port for r in launches)  # SAME port across restarts
+    assert len(_by_kind(recs, "serve_start")) >= 2  # both replica incarnations
+    (verdict,) = _by_kind(recs, "supervisor_verdict")
+    assert verdict["verdict"] == "preempted"  # our SIGTERM, not a give-up
