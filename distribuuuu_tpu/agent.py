@@ -153,12 +153,14 @@ def backoff_delay(
 
 # Merge precedence for a fleet's per-rank exits: the most actionable
 # classification wins (a SIGKILL'd rank is the root cause; its survivors'
-# watchdog 124s are the symptom).
+# watchdog 124s are the symptom). A cooperative resize exit outranks plain
+# preemption so a mixed gang still surfaces "re-form the gang now".
 _OUTCOME_PRECEDENCE = (
     resilience.EXIT_POISON,
     resilience.EXIT_KILLED,
     resilience.EXIT_CRASH,
     resilience.EXIT_HANG,
+    resilience.EXIT_RESIZE,
     resilience.EXIT_PREEMPTED,
     resilience.EXIT_CLEAN,
 )
@@ -185,34 +187,130 @@ class SupervisorJournal(ValidatedJournal):
     workers' journal file is safe on local filesystems (append-mode line
     writes). In serving mode the agent is the main file's ONLY writer —
     replicas journal into per-replica ``.part<N>`` continuations (see
-    serve/frontend.ServeJournal) that `read_journal` reassembles.
+    serve/frontend.ServeJournal) that `read_journal` reassembles. In
+    fleet-managed mode several host agents supervise one OUT_DIR at once, so
+    each takes its own ``.part<2000+host>`` continuation (``part=``) — the
+    main file stays single-writer for the global rank-0 worker.
     ``path=None`` (journaling impossible) degrades every call to a no-op —
     supervision must never die of observability.
     """
 
-    def __init__(self, out_dir: str):
+    def __init__(self, out_dir: str, *, part: int | None = None):
         try:
             from distribuuuu_tpu.obs.telemetry import journal_path
 
             path = journal_path(out_dir)
+            if part is not None:
+                path = f"{path}.part{int(part)}"
         except Exception as exc:  # pragma: no cover - defensive
             logger.warning(f"supervisor journal unavailable: {exc!r}")
             path = None
         super().__init__(path, label="supervisor journal")
 
 
-def _journal_bytes(path: str | None) -> int:
+# Part numbers at or above this are SUPERVISORY writers (serve replicas
+# 1000+R, fleet host agents 2000+H, the fleet controller 3000), not worker
+# telemetry. The journal heartbeat must not count their records as worker
+# beats — a controller's own fleet_launch append saying "the gang is alive"
+# would arm (and then erode) the cold-start grace before any worker wrote.
+_SUPERVISORY_PART_BASE = 1000
+# first part number in the name: a supervisory part's own remote-commit
+# continuations (.part2001.part1) are supervisory too
+_PART_SUFFIX_RE = re.compile(r"\.part(\d+)")
+
+
+def _journal_bytes(path: str | None, *, workers_only: bool = False) -> int:
     """Total bytes across the journal and its ``.partN`` continuations —
-    the heartbeat signal (rank 0 appends a record every PRINT_FREQ window)."""
+    the heartbeat signal (rank 0 appends a record every PRINT_FREQ window).
+    ``workers_only`` skips the supervisory part files (see above); the main
+    file and low-numbered parts (remote-commit continuations) always count."""
     if not path:
         return 0
     total = 0
     for p in _journal_parts(path):
+        if workers_only:
+            m = _PART_SUFFIX_RE.search(os.path.basename(p))
+            if m and int(m.group(1)) >= _SUPERVISORY_PART_BASE:
+                continue
         try:
             total += os.path.getsize(p)
         except OSError:
             pass
     return total
+
+
+def _worker_journal_bytes(path: str | None) -> int:
+    return _journal_bytes(path, workers_only=True)
+
+
+class JournalHeartbeat:
+    """Journal-growth heartbeat with cold-start arming.
+
+    The stall timeout (``timeout_s``) is armed only once the journal has
+    actually grown — a fleet that is still bringing the backend up has not
+    "stopped" beating, it has not *started*, and killing it on the steady-
+    state timeout punished every cold start whose first compile outlasted
+    ``AGENT.HEARTBEAT_TIMEOUT_S``. Phases:
+
+    - **before the first beat** (no growth yet): only the separate
+      ``startup_grace_s`` budget applies (0 disables the pre-beat kill
+      entirely). Sized for worst-case bring-up: backend init + restore +
+      cold compile.
+    - **after the first beat**: the first record (``run_start``) lands
+      *before* the train-step compile, so the first armed interval still
+      spans the cold compile — it gets ``max(timeout_s, startup_grace_s)``.
+    - **steady state** (two beats seen): plain ``timeout_s``.
+
+    ``poll()`` returns ``None`` while healthy, else ``(phase, stalled_s)``
+    with phase ``"startup"`` or ``"stalled"``. Shared by the dtpu-agent's
+    per-host wait loop and the dtpu-fleet controller's gang supervision.
+    """
+
+    def __init__(
+        self,
+        path: str | None,
+        timeout_s: float,
+        startup_grace_s: float,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        size_fn: Callable[[str | None], int] = _worker_journal_bytes,
+    ):
+        self.path = path
+        self.timeout_s = float(timeout_s)
+        self.startup_grace_s = float(startup_grace_s)
+        self._clock = clock
+        self._size_fn = size_fn
+        self._start = clock()
+        self._size = size_fn(path)
+        self._last_beat = self._start
+        self._beats = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.timeout_s > 0
+
+    def poll(self) -> tuple[str, float] | None:
+        if not self.enabled:
+            return None
+        now = self._clock()
+        size = self._size_fn(self.path)
+        if size != self._size:
+            self._size = size
+            self._last_beat = now
+            self._beats += 1
+            return None
+        if self._beats == 0:
+            if 0 < self.startup_grace_s < now - self._start:
+                return ("startup", now - self._start)
+            return None
+        allowed = (
+            self.timeout_s
+            if self._beats >= 2
+            else max(self.timeout_s, self.startup_grace_s)
+        )
+        if now - self._last_beat > allowed:
+            return ("stalled", now - self._last_beat)
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -306,6 +404,15 @@ def verify_resume_target(out_dir: str, rollback: int) -> tuple[str | None, str]:
     restart discovering them). Returns ``(None, "fresh")`` when nothing is
     restorable and ``(None, "exhausted")`` when rollback skipped everything
     — the signal the poison escalation has run out of history."""
+    # fast path: a local OUT_DIR with no checkpoints directory cannot have
+    # candidates — skip the heavy import entirely (every fresh launch,
+    # including each fleet gang's host agents, hits this)
+    from distribuuuu_tpu.runtime import pathio
+
+    if not pathio.is_remote(out_dir) and not os.path.isdir(
+        os.path.join(out_dir, "checkpoints")
+    ):
+        return None, "fresh"
     # lazy: checkpoint pulls in jax/orbax, which the supervisor avoids until
     # a preflight actually needs the scan
     from distribuuuu_tpu import checkpoint as ckpt
@@ -335,6 +442,12 @@ def _rollback_history_exists() -> bool:
     legacy escalation (the preflight's own exhausted-detection still bounds
     it)."""
     try:
+        from distribuuuu_tpu.runtime import pathio
+
+        if not pathio.is_remote(cfg.OUT_DIR) and not os.path.isdir(
+            os.path.join(cfg.OUT_DIR, "checkpoints")
+        ):
+            return False  # no checkpoints dir: nothing to roll back into
         # lazy: checkpoint pulls in jax/orbax, same discipline as preflight
         from distribuuuu_tpu import checkpoint as ckpt
 
@@ -371,15 +484,36 @@ class LaunchError(RuntimeError):
 
 
 class Worker:
-    """One supervised rank: process handle + log multiplexer thread."""
+    """One supervised child process: handle + log multiplexer thread.
 
-    def __init__(self, rank: int, cmd: list[str], env: dict[str, str], log_path: str):
+    ``label`` names the child in the multiplexed console stream (defaults to
+    ``rank N``; the fleet controller labels its children ``host N``)."""
+
+    def __init__(
+        self,
+        rank: int,
+        cmd: list[str],
+        env: dict[str, str],
+        log_path: str,
+        *,
+        label: str | None = None,
+        new_session: bool = False,
+    ):
         self.rank = rank
+        self.label = label or f"rank {rank}"
         self.log_path = log_path
+        # new_session puts the child in its own process group so a last-
+        # resort kill can take its whole subtree (the fleet controller's
+        # host agents have worker children of their own)
+        self.new_session = bool(new_session)
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
         self._log = open(log_path, "wb")
         self.proc = subprocess.Popen(
-            cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT
+            cmd,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            start_new_session=self.new_session,
         )
         self._pump = threading.Thread(
             target=self._pump_lines, daemon=True, name=f"dtpu-agent-log-r{rank}"
@@ -387,10 +521,10 @@ class Worker:
         self._pump.start()
 
     def _pump_lines(self) -> None:
-        # line-level multiplexing: every rank's output lands in its own log
-        # file AND, prefixed, on the agent's stdout — the operator watches
-        # one stream, the postmortem reads per-rank files
-        prefix = f"[rank {self.rank}] ".encode()
+        # line-level multiplexing: every child's output lands in its own log
+        # file AND, prefixed, on the supervisor's stdout — the operator
+        # watches one stream, the postmortem reads per-child files
+        prefix = f"[{self.label}] ".encode()
         stdout = getattr(sys.stdout, "buffer", None)
         assert self.proc.stdout is not None
         for line in self.proc.stdout:
@@ -413,6 +547,15 @@ class Worker:
         except (ProcessLookupError, OSError):
             pass
 
+    def signal_group(self, signum: int) -> None:
+        """Signal the child's whole process group (requires ``new_session``);
+        falls back to the child alone. The fleet controller's SIGKILL stage
+        uses this so a hard-killed host agent cannot orphan wedged ranks."""
+        try:
+            os.killpg(self.proc.pid, signum)
+        except (ProcessLookupError, PermissionError, OSError):
+            self.signal(signum)
+
     def finish(self) -> None:
         self._pump.join(timeout=10.0)
         try:
@@ -432,8 +575,27 @@ class Agent:
         a = cfg.AGENT
         self.nprocs = int(a.NPROCS)
         self.serve = bool(a.SERVE) if "SERVE" in a else False
+        # fleet-managed mode (launched by the dtpu-fleet controller): the
+        # recovery policy moves up to the controller — this agent runs ONE
+        # attempt and forwards the merged outcome as its own exit code
+        self.fleet_host: int | None = (
+            int(os.environ.get("DTPU_FLEET_HOST", "0"))
+            if "DTPU_FLEET_CONTROLLER" in os.environ
+            else None
+        )
         self.budget = RestartBudget(a.MAX_RESTARTS, a.RESTART_WINDOW_S)
-        self.journal = SupervisorJournal(cfg.OUT_DIR)
+        self.journal = SupervisorJournal(
+            cfg.OUT_DIR,
+            part=(2000 + self.fleet_host) if self.fleet_host is not None else None,
+        )
+        # the heartbeat watches the WHOLE journal (main file + every part),
+        # not just this agent's own writer
+        try:
+            from distribuuuu_tpu.obs.telemetry import journal_path
+
+            self._hb_path: str | None = journal_path(cfg.OUT_DIR)
+        except Exception:  # pragma: no cover - defensive
+            self._hb_path = self.journal.path
 
     # -- signals ------------------------------------------------------------
 
@@ -464,7 +626,16 @@ class Agent:
 
     def _worker_env(self, rank: int, attempt: int, rollback: int, port: int | None) -> dict[str, str]:
         env = dict(os.environ)
-        if self.serve:
+        if self.fleet_host is not None:
+            # gang-scheduled worker: the CONTROLLER owns the topology. The
+            # worker registers with the rendezvous service at startup
+            # (runtime/dist.maybe_fleet_rendezvous) using the fleet env the
+            # controller set plus this local rank; stale launcher vars from
+            # the controller's own shell must not pre-empt that answer.
+            for k in ("RANK", "WORLD_SIZE", "MASTER_ADDR", "MASTER_PORT"):
+                env.pop(k, None)
+            env["DTPU_FLEET_LOCAL_RANK"] = str(rank)
+        elif self.serve:
             # replicas are independent processes, NOT a collective fleet:
             # no rendezvous env (RANK/WORLD_SIZE would make each replica
             # wait on a jax.distributed bring-up that never completes); the
@@ -496,7 +667,15 @@ class Agent:
         any rank fails to even start — a bad AGENT.CMD must end in a typed
         verdict via the restart budget, never an unwound supervisor."""
         cmd = self._worker_cmd()
-        agent_dir = os.path.join(cfg.OUT_DIR, "agent", f"attempt_{attempt:03d}")
+        # fleet-managed: several host agents share one OUT_DIR — each keeps
+        # its rank logs under its own host directory or they would clobber
+        # each other's attempt_NNN/rankN.log
+        agent_dir = os.path.join(
+            cfg.OUT_DIR,
+            "agent",
+            *( (f"host{self.fleet_host}",) if self.fleet_host is not None else () ),
+            f"attempt_{attempt:03d}",
+        )
         self._workers = []
         try:
             for rank in range(self.nprocs):
@@ -521,6 +700,7 @@ class Agent:
             rollback=rollback,
             port=int(port) if port is not None else 0,
             cmd=" ".join(cmd),
+            **self._host_fields(),
         )
         logger.info(
             f"agent: attempt {attempt}: launched {self.nprocs} worker(s) "
@@ -554,58 +734,92 @@ class Agent:
         - **journal heartbeat** (``AGENT.HEARTBEAT_TIMEOUT_S``): the fleet is
           wedged if rank 0's journal stops growing — the backstop for the
           case the in-process watchdog can't cover (whole process stalled,
-          watchdog thread included).
+          watchdog thread included). The stall clock arms only after the
+          first beat, with ``AGENT.HEARTBEAT_STARTUP_GRACE_S`` budgeting the
+          cold start (see `JournalHeartbeat`) — a long first compile is not
+          a hang.
         - **exit barrier** (``AGENT.EXIT_BARRIER_S``): once ANY rank exits,
           the rest get this long to follow before being killed — a dead peer
           leaves survivors wedged in a collective, and their own watchdogs
           may be disabled.
         """
-        hb_timeout = float(cfg.AGENT.HEARTBEAT_TIMEOUT_S)
-        hb_path = self.journal.path
-        hb_size = _journal_bytes(hb_path)
-        hb_t = time.monotonic()
-        barrier_deadline: float | None = None
+        hb: JournalHeartbeat | None = JournalHeartbeat(
+            self._hb_path,
+            float(cfg.AGENT.HEARTBEAT_TIMEOUT_S),
+            float(cfg.AGENT.HEARTBEAT_STARTUP_GRACE_S),
+        )
+        exit_deadline: float | None = None
+        stop_deadline: float | None = None
+        killed = False
         hb_kill = False
         while True:
             alive = [w for w in self._workers if w.returncode is None]
             if not alive:
                 break
             now = time.monotonic()
-            if len(alive) < len(self._workers):
-                if barrier_deadline is None:
-                    barrier_deadline = now + float(cfg.AGENT.EXIT_BARRIER_S)
-                elif now > barrier_deadline:
-                    self._kill_fleet(
-                        f"{len(alive)} rank(s) still running "
-                        f"{cfg.AGENT.EXIT_BARRIER_S:.0f}s after the first exit"
+            if len(alive) < len(self._workers) and exit_deadline is None:
+                exit_deadline = now + float(cfg.AGENT.EXIT_BARRIER_S)
+            # a barrier also arms when the agent itself was signaled: the
+            # forwarded SIGTERM makes healthy workers checkpoint and exit,
+            # but a worker wedged in a collective never reaches a step
+            # boundary — without it a preempted (or fleet-drained) agent
+            # would wait forever and orphan the worker on its own SIGKILL.
+            # Budgeted separately (STOP_BARRIER_S, generous): a cooperating
+            # fleet needs time for the agreed stop + the synchronous
+            # emergency save, and must never be SIGKILLed mid-checkpoint on
+            # the drain constant sized for 'the rest follow the first exit'.
+            if self._stop.is_set() and stop_deadline is None:
+                stop_deadline = now + max(
+                    float(cfg.AGENT.EXIT_BARRIER_S), float(cfg.AGENT.STOP_BARRIER_S)
+                )
+            deadlines = [d for d in (exit_deadline, stop_deadline) if d is not None]
+            if deadlines:
+                due = min(deadlines)
+                if not killed and now > due:
+                    which = (
+                        "stop-signal" if due == stop_deadline else "first-exit"
                     )
-                    barrier_deadline = None  # killed; loop drains
-            elif hb_timeout > 0:
-                size = _journal_bytes(hb_path)
-                if size != hb_size:
-                    hb_size, hb_t = size, now
-                elif now - hb_t > hb_timeout:
+                    self._kill_fleet(
+                        f"{len(alive)} rank(s) still running past the "
+                        f"{which} barrier"
+                    )
+                    killed = True  # loop drains the SIGKILLed fleet
+            elif hb is not None:
+                fired = hb.poll()
+                if fired is not None:
+                    phase, stalled = fired
                     hb_kill = True
+                    budget = hb.startup_grace_s if phase == "startup" else hb.timeout_s
                     self.journal.event(  # journaled BEFORE the kill (the
                         "hang",  # fleet is wedged, not writing); the single
                         # supervisor_exit record follows once the fleet drains
-                        timeout_s=hb_timeout,
-                        stalled_s=round(now - hb_t, 3),
-                        phase="supervisor_heartbeat",
+                        timeout_s=budget,
+                        stalled_s=round(stalled, 3),
+                        phase=(
+                            "supervisor_startup_grace"
+                            if phase == "startup"
+                            else "supervisor_heartbeat"
+                        ),
                     )
                     self._kill_fleet(
-                        f"journal heartbeat stalled {now - hb_t:.0f}s "
-                        f"(timeout {hb_timeout:.0f}s)"
+                        f"journal heartbeat {'never started' if phase == 'startup' else 'stalled'} "
+                        f"after {stalled:.0f}s (budget {budget:.0f}s)"
                     )
-                    hb_t = now  # killed; loop drains
+                    hb = None  # killed; loop drains
             self._stop.wait(poll_s)
         for w in self._workers:
             w.finish()
         return [w.returncode for w in self._workers], hb_kill
 
+    def _host_fields(self) -> dict[str, int]:
+        """The ``host`` field fleet-managed records carry (empty otherwise)."""
+        return {} if self.fleet_host is None else {"host": self.fleet_host}
+
     # -- the supervision loop ------------------------------------------------
 
     def run(self) -> int:
+        if self.fleet_host is not None:
+            return self.run_fleet_host()
         if self.serve:
             return self.run_serve()
         a = cfg.AGENT
@@ -777,9 +991,13 @@ class Agent:
                     )
                     break
                 action, delay = "rollback", 0.0
-            elif outcome in (resilience.EXIT_HANG, resilience.EXIT_PREEMPTED):
-                # the run stopped at (hang) or committed (preempt) a durable
-                # point; relaunch immediately into elastic resume
+            elif outcome in (
+                resilience.EXIT_HANG,
+                resilience.EXIT_PREEMPTED,
+                resilience.EXIT_RESIZE,
+            ):
+                # the run stopped at (hang) or committed (preempt/resize) a
+                # durable point; relaunch immediately into elastic resume
                 action, delay = "restart", 0.0
             else:  # crash / killed: back off against tight crash loops
                 action = "restart"
@@ -836,6 +1054,104 @@ class Agent:
             return 128 + (self._stop_signum or signal.SIGTERM)
         return 1
 
+
+    # -- fleet-managed mode (launched by the dtpu-fleet controller) ----------
+
+    def run_fleet_host(self) -> int:
+        """One supervised attempt on behalf of the fleet controller.
+
+        Recovery policy lives fleet-side (distribuuuu_tpu/fleet.py): a host-
+        local restart would re-rendezvous into a gang the controller already
+        declared dead, so this agent launches its ranks ONCE, waits them out
+        (heartbeat + exit barrier still apply), and exits with the merged
+        outcome translated back to an exit code
+        (`resilience.outcome_exit_code`) — the controller classifies host
+        exits exactly like this agent classifies rank exits. All journal
+        records ride this host's own ``.part<2000+host>`` continuation and
+        carry a ``host`` field.
+        """
+        a = cfg.AGENT
+        self._install_signals()
+        tic = time.time()
+        attempt = int(os.environ.get("DTPU_FLEET_ATTEMPT", "1"))
+        self._attempt = attempt
+        rollback = int(os.environ.get("DTPU_RESUME_ROLLBACK", cfg.RESUME.ROLLBACK))
+        self.journal.event(
+            "supervisor_start",
+            nprocs=self.nprocs,
+            max_restarts=0,  # fleet-managed: the controller owns the budget
+            restart_window_s=0.0,
+            cmd=" ".join(self._worker_cmd()),
+            out_dir=str(cfg.OUT_DIR),
+            **self._host_fields(),
+        )
+        pf_tic = time.time()
+        # no rendezvous-port probe: the gang's MASTER_PORT is bound by the
+        # global rank-0 process, which usually lives on another host
+        ok, failures, checks = preflight_checks(
+            cfg.OUT_DIR,
+            rollback=rollback,
+            port=None,
+            min_free_disk_gb=float(a.MIN_FREE_DISK_GB),
+            device_probe=bool(a.PREFLIGHT_DEVICE_PROBE),
+            device_probe_timeout_s=float(a.DEVICE_PROBE_TIMEOUT_S),
+            probe_env=self._worker_env(0, attempt, rollback, None),
+        )
+        self.journal.event(
+            "supervisor_preflight",
+            attempt=attempt,
+            ok=ok,
+            failures=failures,
+            checks=checks,
+            wall_s=round(time.time() - pf_tic, 3),
+            **self._host_fields(),
+        )
+        outcome: str
+        reason: str
+        if checks.get("resume_target_status") == "exhausted":
+            outcome, reason = resilience.EXIT_CRASH, (
+                f"rollback {rollback} exhausted the known-good checkpoint history"
+            )
+        elif not ok:
+            outcome, reason = resilience.EXIT_CRASH, (
+                f"preflight failed ({', '.join(failures)}): {checks}"
+            )
+        elif self._stop.is_set():
+            outcome, reason = resilience.EXIT_PREEMPTED, f"signal {self._stop_signum}"
+        else:
+            launch_tic = time.time()
+            try:
+                self._launch(attempt, rollback, None)
+            except LaunchError as exc:
+                outcome, reason = resilience.EXIT_CRASH, str(exc)
+            else:
+                codes, hb_kill = self._wait_fleet()
+                outcome = resilience.EXIT_HANG if hb_kill else merge_outcomes(codes)
+                reason = f"ranks exited {codes}"
+                self.journal.event(
+                    "supervisor_exit",
+                    attempt=attempt,
+                    outcome=outcome,
+                    codes=[c if c is not None else -1 for c in codes],
+                    wall_s=round(time.time() - launch_tic, 3),
+                    heartbeat_kill=hb_kill,
+                    **self._host_fields(),
+                )
+        self.journal.event(
+            "supervisor_verdict",
+            verdict=outcome,
+            attempts=1,
+            restarts=0,
+            rollbacks=0,
+            reason=reason,
+            wall_s=round(time.time() - tic, 3),
+            **self._host_fields(),
+        )
+        (logger.info if outcome == resilience.EXIT_CLEAN else logger.error)(
+            f"agent[fleet host {self.fleet_host}]: {outcome}: {reason}"
+        )
+        self.journal.close()
+        return resilience.outcome_exit_code(outcome)
 
     # -- serving mode (AGENT.SERVE: keep N dtpu-serve replicas alive) --------
 

@@ -243,7 +243,14 @@ def quarantine_checkpoint(ckpt_path: str, errors: list[str]) -> str | None:
     exact-name resume regexes never match the prefix, so a quarantined
     directory is invisible to auto-resume even if the rename target varies.
     Returns the quarantine path, or None when the rename itself failed (the
-    caller must still skip the checkpoint)."""
+    caller must still skip the checkpoint).
+
+    Concurrency: in a fleet run every host's agent preflight verifies the
+    same resume candidates at once, so two processes can race to quarantine
+    the same corrupt directory. Losing that race (the source vanished under
+    us because a peer already renamed it) is benign — the checkpoint IS
+    quarantined; report it as such instead of journaling a second
+    ``ckpt_quarantined`` event for a rename that never happened."""
     parent, name = str(ckpt_path).rstrip("/").rsplit("/", 1)
     target = pathio.join(parent, f"{_CORRUPT_PREFIX}{name}")
     n = 0
@@ -253,6 +260,12 @@ def quarantine_checkpoint(ckpt_path: str, errors: list[str]) -> str | None:
     try:
         pathio.rename(str(ckpt_path), target)
     except Exception as exc:
+        if not pathio.exists(str(ckpt_path)):
+            logger.warning(
+                f"checkpoint {ckpt_path} was already quarantined by a "
+                f"concurrent process (fleet preflight race); skipping"
+            )
+            return None
         logger.error(f"could not quarantine corrupt checkpoint {ckpt_path}: {exc!r}")
         target = None
     logger.error(

@@ -241,7 +241,12 @@ _C.AGENT.MAX_ROLLBACKS = 2
 # journal stops growing for this long (0 disables). Complements the
 # in-process watchdog (FAULT.HANG_TIMEOUT_S), which cannot fire when the
 # whole process — watchdog thread included — is wedged or swapped out.
+# The stall clock arms only after the journal's FIRST growth; until then
+# (and for the first armed interval, which spans the cold compile) the
+# separate HEARTBEAT_STARTUP_GRACE_S budget applies — a long first compile
+# must never be killed as a hang. Grace 0 disables the pre-beat kill.
 _C.AGENT.HEARTBEAT_TIMEOUT_S = 0.0
+_C.AGENT.HEARTBEAT_STARTUP_GRACE_S = 900.0
 # Preflight gate thresholds (every failed preflight is journaled and counts
 # against the restart budget). MIN_FREE_DISK_GB 0 disables the disk check.
 _C.AGENT.MIN_FREE_DISK_GB = 1.0
@@ -251,6 +256,13 @@ _C.AGENT.DEVICE_PROBE_TIMEOUT_S = 120.0
 # before the agent kills the stragglers (a dead peer leaves them wedged in a
 # collective; the in-process watchdog usually beats this timer).
 _C.AGENT.EXIT_BARRIER_S = 120.0
+# After the agent itself is signaled (SIGTERM forwarded to the workers), how
+# long a still-running fleet gets before being killed. Separate from (and
+# effectively floored by) EXIT_BARRIER_S because a COOPERATING fleet needs
+# this window for the agreed stop + the synchronous emergency checkpoint —
+# a multi-GB save must never be SIGKILLed on the drain constant; the barrier
+# here is only the backstop for a worker wedged in a dead collective.
+_C.AGENT.STOP_BARRIER_S = 600.0
 # Disarm the *chaos* fault injections (INJECT_KILL_STEP / INJECT_HANG_STEP /
 # INJECT_PREEMPT_STEP) in relaunched workers: they model transient machine
 # faults, and a gstep-keyed injection would otherwise re-fire on every
@@ -328,6 +340,77 @@ _C.SERVE.VERIFY_INTEGRITY = True
 # exact but heavy; turn off for high-QPS deployments and keep the slo rollup.
 _C.SERVE.SLO_WINDOW_S = 10.0
 _C.SERVE.JOURNAL_REQUESTS = True
+
+# Fleet orchestration (TPU addition; docs/FAULT_TOLERANCE.md "Fleet runs").
+# `dtpu-fleet --cfg ...` promotes supervision from host scope (dtpu-agent)
+# to cluster scope: gang-scheduled multi-host launches through a lightweight
+# rendezvous service (the controller assigns RANK/WORLD_SIZE/MASTER_ADDR/
+# MASTER_PORT and a fleet epoch), whole-host failure recovery (gang restart
+# at reduced size into elastic resume), scale-up rejoin of healed hosts at
+# the next checkpoint boundary (cooperative FLEET resize stop), and a
+# priority multi-job queue with bounded-drain preemption over one pool.
+_C.FLEET = CN()
+# Host slots in the pool (each runs one fleet-managed dtpu-agent with
+# NPROCS_PER_HOST worker ranks). The controller launches them as local
+# child processes — on one machine this simulates an N-host gang (the CPU
+# chaos tier); the rendezvous protocol itself is multi-host shaped.
+_C.FLEET.HOSTS = 2
+_C.FLEET.NPROCS_PER_HOST = 1
+# Rendezvous service bind (PORT 0 picks a free ephemeral port) and the
+# address workers use for MASTER_ADDR (the host carrying global rank 0).
+_C.FLEET.HOST = "127.0.0.1"
+_C.FLEET.PORT = 0
+_C.FLEET.MASTER_ADDR = "127.0.0.1"
+# Stable job id; the gang's rendezvous MASTER_PORT is derived
+# deterministically from "<job_id>:epoch<E>" (runtime/dist.py
+# derive_rendezvous_port) so re-formed gangs never race independent port
+# picks across hosts. "" derives the id from OUT_DIR.
+_C.FLEET.JOB_ID = ""
+# Gang restart budget + backoff — same sliding-window semantics as AGENT.*,
+# one scope up: a gang restart is one spend, however many hosts relaunch.
+_C.FLEET.MAX_GANG_RESTARTS = 5
+_C.FLEET.RESTART_WINDOW_S = 3600.0
+_C.FLEET.BACKOFF_BASE_S = 1.0
+_C.FLEET.BACKOFF_MAX_S = 60.0
+# Fleet-scope poison escalation (mirrors AGENT.MAX_ROLLBACKS: each gang-wide
+# poison exit rolls auto-resume one known-good checkpoint further back).
+_C.FLEET.MAX_ROLLBACKS = 2
+# Controller-side journal heartbeat over the WHOLE journal (main + parts):
+# a gang whose journal stops growing is killed and gang-restarted. Same
+# armed-after-first-beat + startup-grace semantics as the agent's.
+_C.FLEET.HEARTBEAT_TIMEOUT_S = 0.0
+_C.FLEET.HEARTBEAT_STARTUP_GRACE_S = 900.0
+# Never re-form a gang below this many hosts; with fewer healthy slots the
+# controller waits (under the restart budget) for hosts to heal.
+_C.FLEET.MIN_HOSTS = 1
+# A slot whose host died is quarantined this long before it may rejoin
+# (a real deployment replaces this clock with a health probe; the clock is
+# the simulation-grade stand-in and the floor under probe flapping).
+_C.FLEET.HOST_COOLDOWN_S = 30.0
+# Elastic scale-up: let healed hosts rejoin a RUNNING reduced gang. The
+# rejoin is cooperative — the controller bumps the fleet epoch, survivors
+# checkpoint-and-exit at an agreed step (resilience.FleetSignalPoller), and
+# the gang relaunches at N+1 hosts into elastic resume.
+_C.FLEET.REJOIN = True
+# Only trigger the rejoin resize after the reduced gang has committed a NEW
+# checkpoint since its launch — proof of forward progress, so resize churn
+# can never starve a struggling gang ("rejoin at the next checkpoint
+# boundary" is literal).
+_C.FLEET.REJOIN_AFTER_CHECKPOINT = True
+# Bounded drain for cooperative stops (resize / job preemption / shutdown):
+# after announcing the stop, hosts get DRAIN_S to checkpoint and exit; then
+# SIGTERM; after another DRAIN_S, SIGKILL. Covers the emergency-checkpoint
+# write at the agreed stop step.
+_C.FLEET.DRAIN_S = 120.0
+# Multi-job queue over the pool: "name=priority@command" entries (higher
+# priority wins; equal priority is FIFO). A job submitted while a lower-
+# priority job runs preempts it via the bounded drain above (SIGTERM ->
+# emergency checkpoint), runs, and the preempted job relaunches into
+# elastic resume. Jobs can also be submitted to a RUNNING controller by
+# dropping {"name","priority","hosts","cmd"} JSON files into
+# OUT_DIR/fleet/queue/. Empty: one built-in training job (the same worker
+# the dtpu-agent launches) using this config's argv.
+_C.FLEET.QUEUE = []
 
 # Resume policy (TPU addition). Epoch checkpoints stay the primary contract;
 # these govern the extra step-granular/robustness behavior on top.

@@ -1,0 +1,1054 @@
+"""dtpu-fleet: cluster-level orchestration (docs/FAULT_TOLERANCE.md "Fleet runs").
+
+`dtpu-agent` (PR 5) made one *host* self-healing: it supervises the ranks on
+its machine and closes the detect→recover loop for rank-scope failures. But
+a multi-host job dies with its weakest host — a dead host takes the whole
+gang down and waits for a human, a healed host can never rejoin (elastic
+resume only works downward), and nothing arbitrates two jobs wanting one
+pool. This module promotes detect→recover one scope up, from host to fleet:
+
+- **Gang scheduling through a lightweight rendezvous service.** The
+  controller forms a gang (which host slots, what world size, which fleet
+  epoch), launches one fleet-managed `dtpu-agent` per host, and answers each
+  worker's startup registration with its assignment — RANK / WORLD_SIZE /
+  MASTER_ADDR / MASTER_PORT (`runtime/dist.maybe_fleet_rendezvous` is the
+  client). The controller owns the topology, so a re-formed gang cannot
+  inherit stale launch-time env; a worker from a superseded gang epoch is
+  *refused* and dies loudly instead of rendezvousing into the wrong gang.
+  The gang's rendezvous port is derived deterministically from the job id +
+  fleet epoch (`runtime/dist.derive_rendezvous_port`), so re-formed gangs
+  never race independent port picks across hosts.
+- **Whole-host failure recovery.** Host agents are one-attempt in fleet mode
+  (a host-local restart would re-rendezvous at a stale world size); their
+  exit codes carry the merged rank outcome upward. A fatal host exit
+  declares a fleet-level failure: the survivors drain (their in-process
+  watchdogs turn the dead peer into bounded 124s; the controller's staged
+  SIGTERM→SIGKILL backstops them), the dead slot is quarantined for
+  ``FLEET.HOST_COOLDOWN_S``, and the gang re-forms from the healthy slots —
+  at reduced size when the host is still down — restarting into PR 4's
+  elastic resume. Gang restarts ride the same sliding-window budget and
+  full-jitter backoff as the agent's, one scope up.
+- **Elastic scale-up rejoin.** When a quarantined slot heals while a reduced
+  gang runs, the controller bumps the fleet epoch and announces it through
+  the cooperative stop protocol (`resilience.FleetSignalPoller`): rank 0
+  publishes an agreed stop step, every rank emergency-checkpoints there and
+  exits ``RESIZE_EXIT_CODE``, and the gang relaunches at N+1 hosts — restore
+  is already topology-driven, so the rejoin is one more elastic resume. With
+  ``FLEET.REJOIN_AFTER_CHECKPOINT`` the resize waits for the reduced gang to
+  commit a checkpoint first: rejoin happens at the next checkpoint boundary,
+  never before the gang has proven forward progress.
+- **Multi-job queue with priority preemption.** One pool, many jobs
+  (``FLEET.QUEUE`` at launch, JSON drops into ``OUT_DIR/fleet/queue/`` at
+  runtime). A higher-priority submission (a serving spike) preempts the
+  running lower-priority gang via the same cooperative stop (bounded drain:
+  announce → checkpoint-and-exit → SIGTERM → SIGKILL), runs, and the
+  preempted job relaunches into elastic resume with nothing lost.
+- **Warm restarts.** Relaunched gangs inherit the persistent XLA compile
+  cache (``TRAIN.COMPILE_CACHE``, on by default), so a gang restart pays
+  restore + cache-hit instead of a cold compile; ``obs summarize``'s goodput
+  timeline renders per-attempt startup time, making warm-vs-cold restart
+  cost a measured number rather than folklore.
+
+Everything the controller does is a typed ``fleet_*`` record in the pool's
+telemetry journal (its own ``.part3000`` continuation — the main file stays
+single-writer for the global rank-0 worker, host agents take
+``.part<2000+host>``), so one ``obs summarize`` shows gangs, failures,
+resizes, preemptions and the per-attempt goodput timeline.
+
+CLI (same config contract as train_net.py)::
+
+    python -m distribuuuu_tpu.fleet --cfg config/resnet50.yaml [KEY VALUE ...]
+    dtpu-fleet --cfg ...   # identical (console script)
+
+Like the agent, the controller process never initializes an accelerator
+backend — the chips belong to the workers.
+
+Scope note: the controller launches host agents as local child processes.
+On one machine that simulates an N-host gang (the CPU chaos tier in
+tests/test_fleet.py kills entire simulated hosts); the rendezvous protocol,
+assignment flow and recovery policy are multi-host shaped — pointing the
+spawn at a remote launcher is deployment plumbing, not a protocol change.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import signal
+import socketserver
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any
+
+from distribuuuu_tpu import resilience
+from distribuuuu_tpu.agent import (
+    _CHAOS_ENV_DISARM,
+    JournalHeartbeat,
+    RestartBudget,
+    Worker,
+    _serve_frontend_ports,
+    backoff_delay,
+    merge_outcomes,
+)
+from distribuuuu_tpu.config import cfg, load_cfg_fom_args
+from distribuuuu_tpu.logging import logger
+from distribuuuu_tpu.obs.journal import ValidatedJournal
+
+
+def _journal_path(out_dir: str) -> str | None:
+    try:
+        from distribuuuu_tpu.obs.telemetry import journal_path
+
+        return journal_path(out_dir)
+    except Exception as exc:  # pragma: no cover - defensive
+        logger.warning(f"fleet journal path unavailable: {exc!r}")
+        return None
+
+
+class FleetJournal(ValidatedJournal):
+    """Validated ``fleet_*`` appends into the pool's telemetry journal.
+
+    The controller owns the ``.part3000`` continuation — never the main
+    file, which the global rank-0 worker opens (and torn-tail-heals) at
+    every gang launch. `read_journal` reassembles all parts.
+    """
+
+    def __init__(self, out_dir: str):
+        path = _journal_path(out_dir)
+        super().__init__(
+            f"{path}.part3000" if path else None, label="fleet journal"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous service (the controller side; runtime/dist.py is the client)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class _Gang:
+    fleet_epoch: int
+    slots: tuple[int, ...]
+    nprocs: int
+    master_addr: str
+    master_port: int
+
+    @property
+    def world_size(self) -> int:
+        return len(self.slots) * self.nprocs
+
+
+class RendezvousServer:
+    """JSON-line-over-TCP assignment service.
+
+    One request per connection: ``{"op": "register", "host": H,
+    "local_rank": L, "fleet_epoch": E}`` → ``{"ok": true, "rank": R,
+    "world_size": W, "master_addr": A, "master_port": P, "fleet_epoch": E}``
+    or ``{"ok": false, "error": ...}``. Assignments are a pure function of
+    the current gang (host slot order × nprocs), set by the controller at
+    each gang formation — there is no negotiation to race. A register from
+    a stale fleet epoch is refused: that worker belongs to a gang the
+    controller already declared dead.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        outer = self
+
+        class _Handler(socketserver.StreamRequestHandler):
+            def handle(self) -> None:  # noqa: N805 - socketserver API
+                try:
+                    line = self.rfile.readline(65536)
+                    try:
+                        req = json.loads(line)
+                        if not isinstance(req, dict):
+                            raise ValueError("not an object")
+                    except ValueError:
+                        resp: dict[str, Any] = {"ok": False, "error": "bad_request"}
+                    else:
+                        resp = outer._handle(req)
+                    self.wfile.write((json.dumps(resp) + "\n").encode("utf-8"))
+                except OSError:  # client went away mid-exchange
+                    pass
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._lock = threading.Lock()
+        self._gang: _Gang | None = None
+        self._server = _Server((host, int(port)), _Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="dtpu-fleet-rdzv"
+        )
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def set_gang(self, gang: _Gang) -> None:
+        with self._lock:
+            self._gang = gang
+
+    def clear_gang(self) -> None:
+        with self._lock:
+            self._gang = None
+
+    def _handle(self, req: dict) -> dict:
+        op = req.get("op")
+        with self._lock:
+            gang = self._gang
+        if op == "ping":
+            return {
+                "ok": True,
+                "fleet_epoch": gang.fleet_epoch if gang else -1,
+                "world_size": gang.world_size if gang else 0,
+            }
+        if op != "register":
+            return {"ok": False, "error": f"unknown op {op!r}"}
+        if gang is None:
+            return {"ok": False, "error": "no_gang", "fleet_epoch": -1}
+        try:
+            epoch = int(req.get("fleet_epoch", -1))
+            host = int(req.get("host", -1))
+            local_rank = int(req.get("local_rank", 0))
+        except (TypeError, ValueError):
+            return {"ok": False, "error": "bad_request"}
+        if epoch != gang.fleet_epoch:
+            return {
+                "ok": False,
+                "error": "stale_epoch",
+                "fleet_epoch": gang.fleet_epoch,
+            }
+        if host not in gang.slots:
+            return {
+                "ok": False,
+                "error": "not_in_gang",
+                "fleet_epoch": gang.fleet_epoch,
+            }
+        if not 0 <= local_rank < gang.nprocs:
+            return {"ok": False, "error": "bad_local_rank"}
+        return {
+            "ok": True,
+            "rank": gang.slots.index(host) * gang.nprocs + local_rank,
+            "world_size": gang.world_size,
+            "master_addr": gang.master_addr,
+            "master_port": gang.master_port,
+            "fleet_epoch": gang.fleet_epoch,
+        }
+
+    def close(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except OSError:  # pragma: no cover - defensive
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Cooperative-stop signals (controller writer; resilience.FleetSignalPoller
+# is the worker-side reader)
+# ---------------------------------------------------------------------------
+
+class FleetSignals:
+    """Owns a job's signals directory (``<out_dir>/fleet``). All I/O rides
+    pathio — the signals dir lives under OUT_DIR, which may be an object
+    store shared with the (possibly remote) hosts reading it."""
+
+    def __init__(self, signals_dir: str):
+        from distribuuuu_tpu.runtime import pathio
+
+        self.dir = str(signals_dir)
+        pathio.makedirs(self.dir)
+
+    def _write_marker(self, marker: dict) -> None:
+        from distribuuuu_tpu.runtime import pathio
+
+        # atomic (tmp + rename, remote-safe): a worker never reads a torn marker
+        pathio.write_text(
+            os.path.join(self.dir, resilience.FLEET_MARKER_NAME), json.dumps(marker)
+        )
+
+    def announce_gang(self, fleet_epoch: int) -> None:
+        """Reset the protocol for a freshly launched gang: marker == the
+        gang's own epoch (no resize pending) and no leftover stop step from
+        the previous gang's cooperative stop."""
+        from distribuuuu_tpu.runtime import pathio
+
+        pathio.remove(os.path.join(self.dir, resilience.FLEET_STOP_STEP_NAME))
+        self._write_marker({"fleet_epoch": int(fleet_epoch), "stop": None})
+
+    def request_resize(self, to_epoch: int) -> None:
+        self._write_marker({"fleet_epoch": int(to_epoch), "stop": None})
+
+    def request_preempt(self, fleet_epoch: int) -> None:
+        self._write_marker({"fleet_epoch": int(fleet_epoch), "stop": "preempt"})
+
+
+# ---------------------------------------------------------------------------
+# Jobs and the host pool
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FleetJob:
+    """One queued unit of work over the pool."""
+
+    name: str
+    priority: float = 0.0
+    hosts: int = 0  # desired gang size; 0 -> FLEET.HOSTS
+    cmd: str = ""  # "" -> the agent's built-in training worker
+    seq: int = 0  # FIFO tiebreak among equal priorities
+    out_dir: str = ""
+    fleet_epoch: int = 0  # last epoch this job's gangs used (monotonic)
+    rollback: int = 0  # fleet-scope poison escalation state
+    source: str = ""  # queue-dir submission file; deleting it withdraws a
+    # still-pending job (a job that already ran/preempted stays queued)
+
+    @property
+    def sort_key(self) -> tuple[float, int]:
+        return (-float(self.priority), int(self.seq))
+
+
+def parse_job_spec(spec: str, seq: int = 0) -> FleetJob:
+    """``name=priority@command`` / ``name=priority:hosts@command`` /
+    ``name=priority`` (built-in training worker)."""
+    name, eq, rest = str(spec).partition("=")
+    name = name.strip()
+    if not eq or not name or not rest.strip():
+        raise ValueError(
+            f"bad FLEET.QUEUE entry {spec!r}: want 'name=priority[:hosts][@command]'"
+        )
+    head, _, cmd = rest.partition("@")
+    prio_s, colon, hosts_s = head.partition(":")
+    try:
+        priority = float(prio_s)
+        hosts = int(hosts_s) if colon else 0
+    except ValueError as exc:
+        raise ValueError(f"bad FLEET.QUEUE entry {spec!r}: {exc}") from exc
+    return FleetJob(name=name, priority=priority, hosts=hosts, cmd=cmd.strip(), seq=seq)
+
+
+class HostPool:
+    """Slot health book-keeping: a slot whose host died is quarantined for
+    ``cooldown_s`` before it may rejoin a gang (the simulation-grade stand-in
+    for a health probe, and the floor under probe flapping)."""
+
+    def __init__(self, n_slots: int, cooldown_s: float):
+        self.slots = list(range(int(n_slots)))
+        self.cooldown_s = float(cooldown_s)
+        self._until: dict[int, float] = {}
+
+    def mark_dead(self, slot: int) -> None:
+        self._until[slot] = time.monotonic() + self.cooldown_s
+
+    def available(self) -> list[int]:
+        now = time.monotonic()
+        return [s for s in self.slots if self._until.get(s, 0.0) <= now]
+
+    def healed(self, in_gang: "list[int] | tuple[int, ...]") -> list[int]:
+        return [s for s in self.available() if s not in in_gang]
+
+    def next_heal_s(self) -> float:
+        """Seconds until the next quarantined slot heals (0 if none)."""
+        now = time.monotonic()
+        pending = [t - now for t in self._until.values() if t > now]
+        return max(0.0, min(pending)) if pending else 0.0
+
+
+def _checkpoint_names(out_dir: str) -> set[str]:
+    """Committed checkpoint directory names (cheap scan — the controller
+    never imports the checkpoint stack, which pulls jax/orbax; pathio so a
+    gs:// OUT_DIR's checkpoints gate the rejoin exactly like a local one)."""
+    from distribuuuu_tpu.runtime import pathio
+
+    try:
+        return {
+            n
+            for n in pathio.listdir(pathio.join(str(out_dir), "checkpoints"))
+            if n.startswith("ckpt_") and ".orbax-checkpoint-tmp" not in n
+        }
+    except Exception:
+        return set()
+
+
+# ---------------------------------------------------------------------------
+# Gang controller (one job's supervision)
+# ---------------------------------------------------------------------------
+
+_FATAL_HOST_OUTCOMES = (resilience.EXIT_KILLED, resilience.EXIT_CRASH)
+
+
+class GangController:
+    """Form, supervise and re-form gangs for one job until a verdict."""
+
+    def __init__(
+        self,
+        job: FleetJob,
+        argv: list[str],
+        rdzv: RendezvousServer,
+        journal: FleetJournal,
+        pool: HostPool,
+        job_id: str,
+        stop_event: threading.Event,
+    ):
+        self.job = job
+        self._argv = list(argv)
+        self.rdzv = rdzv
+        self.journal = journal
+        self.pool = pool
+        self.job_id = job_id
+        self._stop = stop_event  # controller-process stop (signal/shutdown)
+        self._preempt = threading.Event()  # queue-initiated preemption
+        self.preempted_by = ""
+        f = cfg.FLEET
+        self.nprocs = int(f.NPROCS_PER_HOST)
+        self.target_hosts = int(job.hosts) or int(f.HOSTS)
+        self.out_dir = job.out_dir or str(cfg.OUT_DIR)
+        self.signals = FleetSignals(os.path.join(self.out_dir, "fleet"))
+        self.budget = RestartBudget(f.MAX_GANG_RESTARTS, f.RESTART_WINDOW_S)
+        self._agents: dict[int, Worker] = {}
+        self.resizes = 0
+
+    # -- external control ----------------------------------------------------
+
+    def request_preempt(self, by: str) -> None:
+        self.preempted_by = by
+        self._preempt.set()
+
+    def _stopping(self) -> bool:
+        return self._stop.is_set() or self._preempt.is_set()
+
+    # -- launch --------------------------------------------------------------
+
+    def _agent_cmd(self) -> list[str]:
+        cmd = [
+            sys.executable,
+            "-m",
+            "distribuuuu_tpu.agent",
+            *self._argv,
+            "OUT_DIR",
+            self.out_dir,
+            "AGENT.NPROCS",
+            str(self.nprocs),
+        ]
+        if self.job.cmd:
+            cmd += ["AGENT.CMD", self.job.cmd]
+        return cmd
+
+    def _agent_env(self, slot: int, epoch: int, attempt: int) -> dict[str, str]:
+        env = dict(os.environ)
+        env.update(
+            DTPU_FLEET_CONTROLLER=self.rdzv.address,
+            DTPU_FLEET_HOST=str(slot),
+            DTPU_FLEET_EPOCH=str(epoch),
+            DTPU_FLEET_ATTEMPT=str(attempt),
+            DTPU_FLEET_SIGNALS=self.signals.dir,
+            DTPU_FLEET_JOB_ID=self.job_id,
+            DTPU_RESUME_ROLLBACK=str(self.job.rollback),
+        )
+        if attempt > 1 and cfg.AGENT.DISARM_CHAOS_ON_RESTART:
+            # same reasoning as the agent's relaunch path: gstep-keyed chaos
+            # injections model transient machine faults and must not re-fire
+            # on every gang replay (data poison stays armed by design)
+            env.update(_CHAOS_ENV_DISARM)
+        return env
+
+    def _launch_gang(self, slots: list[int], epoch: int, attempt: int) -> bool:
+        cmd = self._agent_cmd()
+        gang_dir = os.path.join(self.out_dir, "fleet", f"epoch_{epoch:03d}")
+        self._agents = {}
+        try:
+            for slot in slots:
+                self._agents[slot] = Worker(
+                    slot,
+                    cmd,
+                    self._agent_env(slot, epoch, attempt),
+                    os.path.join(gang_dir, f"host{slot}.log"),
+                    label=f"host {slot}",
+                    new_session=True,
+                )
+        except OSError as exc:
+            logger.error(f"fleet[{self.job.name}]: could not spawn gang: {exc!r}")
+            for w in self._agents.values():
+                w.signal_group(signal.SIGKILL)
+                w.finish()
+            self._agents = {}
+            return False
+        logger.info(
+            f"fleet[{self.job.name}]: epoch {epoch}: launched gang of "
+            f"{len(slots)} host(s) {slots} (world {len(slots) * self.nprocs}, "
+            f"attempt {attempt}, rollback {self.job.rollback})"
+        )
+        return True
+
+    # -- gang supervision ----------------------------------------------------
+
+    def _signal_gang(self, signum: int, *, group: bool = False) -> None:
+        for w in self._agents.values():
+            if w.returncode is None:
+                (w.signal_group if group else w.signal)(signum)
+
+    def _supervise(
+        self, slots: list[int], epoch: int
+    ) -> tuple[str, dict[int, int | None], list[int], bool]:
+        """Wait the gang out; returns ``(outcome, codes_by_slot, dead_slots,
+        resize_initiated)``. Runs the controller-side timers: journal
+        heartbeat over the whole journal, the staged cooperative drain
+        (announce → DRAIN_S → SIGTERM → DRAIN_S → SIGKILL-the-group), and
+        the rejoin watch (healed slot + optional new-checkpoint gate)."""
+        f = cfg.FLEET
+        drain_s = float(f.DRAIN_S)
+        hb: JournalHeartbeat | None = JournalHeartbeat(
+            _journal_path(self.out_dir),
+            float(f.HEARTBEAT_TIMEOUT_S),
+            float(f.HEARTBEAT_STARTUP_GRACE_S),
+        )
+        ckpts_at_launch = _checkpoint_names(self.out_dir)
+        codes: dict[int, int | None] = {}
+        dead: list[int] = []
+        next_ckpt_scan = 0.0  # checkpoint commits are minute-timescale; a
+        # 0.2s-cadence listdir of a gs:// OUT_DIR would be ~5 LIST req/s
+        launch_t = time.monotonic()
+        drain_deadline: float | None = None
+        drain_stage = 0  # 0: cooperative, 1: SIGTERM sent, 2: SIGKILL sent
+        resize_initiated = False
+        stop_announced = False
+        hb_kill = False
+        while self._agents:
+            now = time.monotonic()
+            # reap exited host agents
+            for slot, w in list(self._agents.items()):
+                if w.returncode is None:
+                    continue
+                w.finish()
+                del self._agents[slot]
+                codes[slot] = w.returncode
+                outcome_h = resilience.classify_exit_code(w.returncode)
+                self.journal.event(
+                    "fleet_host_exit",
+                    job=self.job.name,
+                    fleet_epoch=epoch,
+                    host=slot,
+                    outcome=outcome_h,
+                    code=w.returncode if w.returncode is not None else -1,
+                    wall_s=round(now - launch_t, 3),
+                )
+                logger.info(
+                    f"fleet[{self.job.name}]: host {slot} exited "
+                    f"{w.returncode} -> {outcome_h}"
+                )
+                # attribution: only the FIRST organic fatal exit quarantines
+                # its slot — everything after it is downstream of that death
+                # (peers crash on the broken collective within seconds, or
+                # get reaped by our own drain escalation) and quarantining
+                # them too could empty a healthy pool. A host that is truly
+                # dead anyway fails its next relaunch and gets attributed as
+                # that gang's first fatal exit — self-correcting at one
+                # budget spend. Controller-initiated stops (preempt / resize
+                # / heartbeat kill) never attribute.
+                if (
+                    outcome_h in _FATAL_HOST_OUTCOMES
+                    and not dead
+                    and drain_stage == 0
+                    and not (stop_announced or resize_initiated or hb_kill)
+                ):
+                    self.pool.mark_dead(slot)
+                    dead.append(slot)
+                # any first exit arms the drain: the rest of the gang must
+                # follow (a dead peer leaves survivors wedged; a finished
+                # peer means the rest are seconds behind)
+                if drain_deadline is None:
+                    drain_deadline = now + drain_s
+            if not self._agents:
+                break
+            # queue preemption / controller shutdown: announce the
+            # cooperative stop once, then let the drain stages bound it
+            if self._stopping() and not stop_announced and not resize_initiated:
+                stop_announced = True
+                self.signals.request_preempt(epoch)
+                logger.warning(
+                    f"fleet[{self.job.name}]: preempting gang (epoch {epoch})"
+                    + (f" for {self.preempted_by!r}" if self.preempted_by else "")
+                )
+                if drain_deadline is None:
+                    drain_deadline = now + drain_s
+            # rejoin watch: a healed slot + a gang below target size → bump
+            # the fleet epoch and stop the gang cooperatively at the next
+            # checkpoint boundary
+            if (
+                not resize_initiated
+                and not stop_announced
+                and drain_deadline is None
+                and bool(f.REJOIN)
+                and len(slots) < self.target_hosts
+            ):
+                healed = self.pool.healed(slots)[: self.target_hosts - len(slots)]
+                gate_ok = not bool(f.REJOIN_AFTER_CHECKPOINT)
+                if healed and not gate_ok and now >= next_ckpt_scan:
+                    next_ckpt_scan = now + 2.0
+                    gate_ok = bool(
+                        _checkpoint_names(self.out_dir) - ckpts_at_launch
+                    )
+                if healed and gate_ok:
+                    resize_initiated = True
+                    self.resizes += 1
+                    self.signals.request_resize(epoch + 1)
+                    self.journal.event(
+                        "fleet_resize",
+                        job=self.job.name,
+                        from_epoch=epoch,
+                        to_epoch=epoch + 1,
+                        from_hosts=len(slots),
+                        to_hosts=len(slots) + len(healed),
+                        reason="rejoin",
+                    )
+                    logger.warning(
+                        f"fleet[{self.job.name}]: host(s) {healed} healed — "
+                        f"resizing gang {len(slots)} -> "
+                        f"{len(slots) + len(healed)} at the next checkpoint "
+                        f"boundary (epoch {epoch} -> {epoch + 1})"
+                    )
+                    drain_deadline = now + drain_s
+            # journal heartbeat: a gang-wide stall is killed and re-formed
+            if hb is not None and drain_deadline is None:
+                fired = hb.poll()
+                if fired is not None:
+                    phase, stalled = fired
+                    hb_kill = True
+                    hb = None
+                    logger.error(
+                        f"fleet[{self.job.name}]: journal heartbeat "
+                        f"{'never started' if phase == 'startup' else 'stalled'} "
+                        f"({stalled:.0f}s) — killing the gang"
+                    )
+                    self._signal_gang(signal.SIGTERM)
+                    drain_deadline = now + drain_s
+                    drain_stage = 1
+            # staged drain escalation
+            if drain_deadline is not None and now > drain_deadline:
+                if drain_stage == 0:
+                    self._signal_gang(signal.SIGTERM)
+                    drain_stage, drain_deadline = 1, now + drain_s
+                elif drain_stage == 1:
+                    logger.error(
+                        f"fleet[{self.job.name}]: gang ignored SIGTERM for "
+                        f"{drain_s:.0f}s — SIGKILLing host process groups"
+                    )
+                    self._signal_gang(signal.SIGKILL, group=True)
+                    drain_stage, drain_deadline = 2, now + 10.0
+                else:  # pragma: no cover - SIGKILL cannot be ignored
+                    drain_deadline = now + 10.0
+            time.sleep(0.2)
+        outcome = (
+            resilience.EXIT_HANG
+            if hb_kill
+            else merge_outcomes([codes[s] for s in sorted(codes)])
+        )
+        return outcome, codes, dead, resize_initiated
+
+    # -- the job loop --------------------------------------------------------
+
+    def run(self) -> str:
+        f = cfg.FLEET
+        job = self.job
+        tic = time.time()
+        attempt = 0
+        restarts = 0
+        rollbacks = 0
+        verdict: str | None = None
+        reason = ""
+        while verdict is None:
+            if self._stop.is_set():
+                verdict, reason = "preempted", "controller stopped"
+                break
+            if self._preempt.is_set():
+                verdict, reason = "preempted", f"preempted by {self.preempted_by!r}"
+                break
+            slots = self.pool.available()[: self.target_hosts]
+            if len(slots) < max(1, int(f.MIN_HOSTS)):
+                # every healthy slot is quarantined: wait for the earliest
+                # heal (cooldowns always expire, so this always progresses)
+                wait = min(5.0, max(0.2, self.pool.next_heal_s()))
+                logger.warning(
+                    f"fleet[{job.name}]: {len(slots)} healthy host(s) < "
+                    f"MIN_HOSTS {f.MIN_HOSTS}; waiting {wait:.1f}s for a heal"
+                )
+                self._stop.wait(wait)
+                continue
+            attempt += 1
+            job.fleet_epoch += 1
+            epoch = job.fleet_epoch
+            from distribuuuu_tpu.runtime.dist import derive_rendezvous_port
+
+            port = derive_rendezvous_port(
+                f"{self.job_id}:epoch{epoch}", exclude=_serve_frontend_ports()
+            )
+            gang = _Gang(epoch, tuple(slots), self.nprocs, str(f.MASTER_ADDR), port)
+            self.rdzv.set_gang(gang)
+            self.signals.announce_gang(epoch)
+            self.journal.event(
+                "fleet_launch",
+                job=job.name,
+                fleet_epoch=epoch,
+                attempt=attempt,
+                hosts=list(slots),
+                world_size=gang.world_size,
+                port=port,
+                rollback=job.rollback,
+            )
+            if not self._launch_gang(slots, epoch, attempt):
+                outcome: str = resilience.EXIT_CRASH
+                codes: dict[int, int | None] = {}
+                dead: list[int] = []
+                resized = False
+            else:
+                outcome, codes, dead, resized = self._supervise(slots, epoch)
+            self.rdzv.clear_gang()
+
+            if outcome == resilience.EXIT_CLEAN:
+                verdict, reason = "clean", "job completed"
+                break
+            if self._stopping():
+                verdict, reason = "preempted", (
+                    f"preempted by {self.preempted_by!r}"
+                    if self._preempt.is_set()
+                    else "controller stopped"
+                )
+                break
+            if resized and outcome in (
+                resilience.EXIT_RESIZE,
+                resilience.EXIT_PREEMPTED,
+            ):
+                # cooperative resize completed: relaunch immediately at the
+                # new size (no budget spend — the stop was controller-made
+                # and gated on forward progress)
+                self.journal.event(
+                    "fleet_recovery",
+                    job=job.name,
+                    fleet_epoch=epoch,
+                    outcome=outcome,
+                    action="resize_relaunch",
+                    rollback=job.rollback,
+                )
+                continue
+            # a failure: journal it, then decide
+            self.journal.event(
+                "fleet_failure",
+                job=job.name,
+                fleet_epoch=epoch,
+                outcome=outcome,
+                dead_hosts=list(dead),
+                codes=[
+                    c if c is not None else -1
+                    for _, c in sorted(codes.items())
+                ],
+            )
+            recovery_reason = ""
+            if outcome == resilience.EXIT_POISON:
+                job.rollback += 1
+                rollbacks += 1
+                if job.rollback > int(f.MAX_ROLLBACKS):
+                    verdict, reason = "gave_up", (
+                        f"poison persisted through {f.MAX_ROLLBACKS} fleet "
+                        f"rollback(s) — the divergence is not checkpoint-state"
+                    )
+                    break
+                action, delay = "rollback", 0.0
+            elif outcome in (
+                resilience.EXIT_HANG,
+                resilience.EXIT_PREEMPTED,
+                resilience.EXIT_RESIZE,
+            ):
+                # stopped at (hang) or committed (preempt/stray resize) a
+                # durable point: re-form immediately
+                action, delay = "restart", 0.0
+            else:  # killed / crash: whole-host death or gang crash
+                action = "restart"
+                delay = backoff_delay(
+                    self.budget.in_window(), f.BACKOFF_BASE_S, f.BACKOFF_MAX_S
+                )
+                if dead:
+                    recovery_reason = (
+                        f"host(s) {dead} died; quarantined for "
+                        f"{self.pool.cooldown_s:.0f}s — re-forming from the "
+                        f"healthy slots"
+                    )
+            if not self.budget.try_spend():
+                verdict, reason = "gave_up", (
+                    f"{self.budget.max_restarts} gang restarts inside "
+                    f"{self.budget.window_s:.0f}s — fleet-level crash loop"
+                )
+                break
+            restarts += 1
+            rec_fields: dict[str, Any] = (
+                {"reason": recovery_reason} if recovery_reason else {}
+            )
+            self.journal.event(
+                "fleet_recovery",
+                job=job.name,
+                fleet_epoch=epoch,
+                outcome=outcome,
+                action=action,
+                backoff_s=round(delay, 3),
+                rollback=job.rollback,
+                restarts_in_window=self.budget.in_window(),
+                **rec_fields,
+            )
+            logger.warning(
+                f"fleet[{job.name}]: {outcome} -> {action} "
+                f"(backoff {delay:.1f}s, rollback {job.rollback}, "
+                f"{self.budget.in_window()}/{self.budget.max_restarts} gang "
+                f"restarts in window)"
+                + (f": {recovery_reason}" if recovery_reason else "")
+            )
+            if delay:
+                self._stop.wait(delay)
+        self.journal.event(
+            "fleet_verdict",
+            job=job.name,
+            verdict=verdict,
+            attempts=attempt,
+            gang_restarts=restarts,
+            resizes=self.resizes,
+            rollbacks=rollbacks,
+            reason=reason,
+            wall_s=round(time.time() - tic, 3),
+        )
+        (logger.info if verdict == "clean" else logger.warning)(
+            f"fleet[{job.name}] verdict: {verdict} after {attempt} gang(s), "
+            f"{restarts} restart(s), {self.resizes} resize(s): {reason}"
+        )
+        return verdict or "gave_up"
+
+
+# ---------------------------------------------------------------------------
+# Multi-job queue over one pool
+# ---------------------------------------------------------------------------
+
+class FleetQueue:
+    """Priority queue of `FleetJob`s over one `HostPool`.
+
+    One gang runs at a time (a gang takes the pool). A higher-priority
+    submission — from ``FLEET.QUEUE`` or a JSON file dropped into
+    ``OUT_DIR/fleet/queue/`` while the controller runs — preempts the
+    active gang through the bounded cooperative drain; the preempted job
+    goes back on the queue and relaunches into elastic resume.
+    """
+
+    def __init__(self, argv: list[str]):
+        f = cfg.FLEET
+        self._argv = list(argv)
+        self.journal = FleetJournal(cfg.OUT_DIR)
+        self.rdzv = RendezvousServer(str(f.HOST), int(f.PORT))
+        self.pool = HostPool(int(f.HOSTS), float(f.HOST_COOLDOWN_S))
+        self.job_id = str(f.JOB_ID) or (
+            "dtpu-"
+            + hashlib.sha256(os.path.abspath(cfg.OUT_DIR).encode()).hexdigest()[:8]
+        )
+        self.queue_dir = os.path.join(cfg.OUT_DIR, "fleet", "queue")
+        self._seen_specs: set[str] = set()
+        self._next_scan = 0.0  # queue-dir scans are throttled: submissions
+        # are human-timescale and a 0.2s-cadence remote listdir is not free
+        self._seq = 0
+        self._stop = threading.Event()
+        self._stop_signum: int | None = None
+        self._active: GangController | None = None
+        self.jobs: list[FleetJob] = []
+        specs = list(f.QUEUE)
+        if not specs:
+            self._add_job(FleetJob(name="train"))
+        for spec in specs:
+            self._add_job(parse_job_spec(spec, self._seq))
+
+    def _add_job(self, job: FleetJob) -> None:
+        job.seq = self._seq
+        self._seq += 1
+        if not job.out_dir:
+            # the lone default job owns OUT_DIR (the ordinary single-job
+            # fleet); named queue jobs each get their own out dir so their
+            # checkpoints and journals never interleave
+            job.out_dir = (
+                str(cfg.OUT_DIR)
+                if job.name == "train" and not self.jobs and not job.cmd
+                else os.path.join(cfg.OUT_DIR, "fleet", "jobs", job.name)
+            )
+        self.jobs.append(job)
+
+    def _scan_queue_dir(self) -> None:
+        from distribuuuu_tpu.runtime import pathio
+
+        try:
+            names = sorted(pathio.listdir(self.queue_dir))
+        except Exception:
+            return
+        for name in names:
+            if not name.endswith(".json") or name in self._seen_specs:
+                continue
+            self._seen_specs.add(name)
+            path = pathio.join(self.queue_dir, name)
+            try:
+                spec = json.loads(pathio.read_bytes(path))
+                job = FleetJob(
+                    name=str(spec["name"]),
+                    priority=float(spec.get("priority", 0.0)),
+                    hosts=int(spec.get("hosts", 0)),
+                    cmd=str(spec.get("cmd", "")),
+                    source=path,
+                )
+            except Exception as exc:
+                logger.error(f"fleet queue: bad submission {path}: {exc!r}")
+                continue
+            self._add_job(job)
+            logger.info(
+                f"fleet queue: job {job.name!r} submitted "
+                f"(priority {job.priority}, hosts {job.hosts or cfg.FLEET.HOSTS})"
+            )
+
+    def _poll_queue(self) -> None:
+        """Throttled queue maintenance (scan for submissions + prune
+        withdrawals): 2 s cadence, not the 0.2 s child-reap cadence."""
+        now = time.monotonic()
+        if now < self._next_scan:
+            return
+        self._next_scan = now + 2.0
+        self._scan_queue_dir()
+        self._prune_withdrawn()
+
+    def _prune_withdrawn(self) -> None:
+        """Drop still-pending submissions whose queue file was deleted —
+        deleting the file withdraws the job up until the moment it is picked
+        (or triggers a preemption); after that the submission is spent."""
+        from distribuuuu_tpu.runtime import pathio
+
+        for job in list(self.jobs):
+            if job.source and job.fleet_epoch == 0 and not pathio.exists(job.source):
+                self.jobs.remove(job)
+                logger.info(f"fleet queue: job {job.name!r} withdrawn (file deleted)")
+
+    def _install_signals(self) -> None:
+        def handler(signum, frame):
+            self._stop_signum = signum
+            self._stop.set()
+            active = self._active
+            if active is not None:
+                active.request_preempt("shutdown")
+
+        try:
+            signal.signal(signal.SIGTERM, handler)
+            signal.signal(signal.SIGINT, handler)
+        except ValueError:  # pragma: no cover - embedded (non-main-thread)
+            logger.warning("fleet: signal handling not installed (not main thread)")
+
+    def run(self) -> int:
+        from distribuuuu_tpu.runtime import pathio
+
+        f = cfg.FLEET
+        self._install_signals()
+        pathio.makedirs(self.queue_dir)
+        self.journal.event(
+            "fleet_start",
+            hosts=int(f.HOSTS),
+            nprocs_per_host=int(f.NPROCS_PER_HOST),
+            jobs=len(self.jobs),
+            job_id=self.job_id,
+            out_dir=str(cfg.OUT_DIR),
+            rdzv=self.rdzv.address,
+            max_gang_restarts=int(f.MAX_GANG_RESTARTS),
+        )
+        logger.info(
+            f"fleet: pool of {f.HOSTS} host slot(s) x {f.NPROCS_PER_HOST} "
+            f"rank(s), rendezvous at {self.rdzv.address}, "
+            f"{len(self.jobs)} job(s) queued"
+        )
+        rc = 0
+        try:
+            while self.jobs and not self._stop.is_set():
+                self._poll_queue()
+                if not self.jobs:
+                    break
+                job = min(self.jobs, key=lambda j: j.sort_key)
+                self.jobs.remove(job)
+                controller = GangController(
+                    job,
+                    self._argv,
+                    self.rdzv,
+                    self.journal,
+                    self.pool,
+                    f"{self.job_id}/{job.name}",
+                    self._stop,
+                )
+                self._active = controller
+                holder: dict[str, str] = {}
+                thread = threading.Thread(
+                    target=lambda: holder.update(verdict=controller.run()),
+                    daemon=True,
+                    name=f"dtpu-fleet-{job.name}",
+                )
+                thread.start()
+                while thread.is_alive():
+                    self._poll_queue()
+                    waiting = [j for j in self.jobs if j.priority > job.priority]
+                    if waiting and not controller._preempt.is_set():
+                        by = min(waiting, key=lambda j: j.sort_key)
+                        # the submission is SPENT the moment it triggers a
+                        # preemption: deleting its queue file after this
+                        # point must not withdraw it (the running job is
+                        # already paying the drain)
+                        by.source = ""
+                        self.journal.event(
+                            "fleet_preempt",
+                            job=job.name,
+                            by=by.name,
+                            priority=float(job.priority),
+                            by_priority=float(by.priority),
+                            drain_s=float(f.DRAIN_S),
+                        )
+                        controller.request_preempt(by.name)
+                    thread.join(0.2)
+                self._active = None
+                verdict = holder.get("verdict", "gave_up")
+                if verdict == "preempted" and not self._stop.is_set():
+                    # back on the queue: relaunches into elastic resume once
+                    # the higher-priority job is done
+                    self.jobs.append(job)
+                elif verdict != "clean":
+                    rc = 1
+        finally:
+            self.rdzv.close()
+            self.journal.close()
+        if self._stop.is_set():
+            return 128 + (self._stop_signum or signal.SIGTERM)
+        return rc
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # accepted-and-ignored, symmetric with the agent: a fleet launched by a
+    # launcher wrapper must not choke on its flags
+    parser = argparse.ArgumentParser(
+        prog="python -m distribuuuu_tpu.fleet",
+        description="Cluster-level orchestration: gang scheduling, whole-host "
+        "failure recovery, elastic rejoin, priority preemption "
+        "(docs/FAULT_TOLERANCE.md 'Fleet runs').",
+        add_help=False,
+    )
+    _, rest = parser.parse_known_args(argv)
+    load_cfg_fom_args("dtpu-fleet: cluster-level orchestration.", argv=rest)
+    from distribuuuu_tpu.logging import setup_logger
+
+    # stderr only — rank-0 workers own OUT_DIR's timestamped log file; the
+    # controller's narration rides the multiplexed console stream
+    setup_logger(None, 0)
+    return FleetQueue(rest).run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

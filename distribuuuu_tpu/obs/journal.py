@@ -152,27 +152,35 @@ SCHEMA: dict[str, tuple[dict[str, tuple], dict[str, tuple]]] = {
     ),
     # supervision (dtpu-agent) --------------------------------------------
     # the agent took over this OUT_DIR: one per `python -m distribuuuu_tpu.agent`
+    # (fleet-managed host agents add their ``host`` slot to every record and
+    # journal into their own .part<2000+host> continuation)
     "supervisor_start": (
         {"nprocs": _INT, "max_restarts": _INT},
-        {"cmd": _STR, "out_dir": _STR, "restart_window_s": _NUM},
+        {"cmd": _STR, "out_dir": _STR, "restart_window_s": _NUM, "host": _INT},
     ),
     # one preflight gate evaluation (before every launch/relaunch); a failed
     # gate lists which checks failed and counts against the restart budget
     "supervisor_preflight": (
         {"attempt": _INT, "ok": _BOOL},
-        {"failures": _LIST, "checks": _DICT, "wall_s": _NUM, "replica": _INT},
+        {
+            "failures": _LIST,
+            "checks": _DICT,
+            "wall_s": _NUM,
+            "replica": _INT,
+            "host": _INT,
+        },
     ),
     # a worker fleet was launched (attempt is 1-based across the whole
     # supervision, rollback is the resume depth the fleet was launched at)
     "supervisor_launch": (
         {"attempt": _INT, "nprocs": _INT},
-        {"rollback": _INT, "port": _INT, "cmd": _STR, "replica": _INT},
+        {"rollback": _INT, "port": _INT, "cmd": _STR, "replica": _INT, "host": _INT},
     ),
     # a fleet finished one way or another: per-rank exit codes + the merged
     # classification (resilience.classify_exit_code, worst rank wins)
     "supervisor_exit": (
         {"attempt": _INT, "outcome": _STR, "codes": _LIST},
-        {"wall_s": _NUM, "heartbeat_kill": _BOOL, "replica": _INT},
+        {"wall_s": _NUM, "heartbeat_kill": _BOOL, "replica": _INT, "host": _INT},
     ),
     # the recovery policy's decision for a non-clean exit: action is
     # restart | rollback | give_up | preempt_exit, with the parameters the
@@ -185,13 +193,67 @@ SCHEMA: dict[str, tuple[dict[str, tuple], dict[str, tuple]]] = {
             "restarts_in_window": _INT,
             "reason": _STR,
             "replica": _INT,
+            "host": _INT,
         },
     ),
-    # the agent's final word: verdict is clean | gave_up | preempted, with
-    # the whole supervision's totals — the record tests and operators gate on
+    # the agent's final word: verdict is clean | gave_up | preempted (a
+    # fleet-managed host agent reports its single attempt's merged outcome),
+    # with the whole supervision's totals — the record tests and operators
+    # gate on
     "supervisor_verdict": (
         {"verdict": _STR, "attempts": _INT, "restarts": _INT},
-        {"rollbacks": _INT, "reason": _STR, "wall_s": _NUM},
+        {"rollbacks": _INT, "reason": _STR, "wall_s": _NUM, "host": _INT},
+    ),
+    # fleet orchestration (dtpu-fleet, docs/FAULT_TOLERANCE.md "Fleet runs");
+    # all written by the controller into its .part<3000> continuation -------
+    # the controller took over this pool: one per `dtpu-fleet` invocation
+    "fleet_start": (
+        {"hosts": _INT, "nprocs_per_host": _INT, "jobs": _INT},
+        {"job_id": _STR, "out_dir": _STR, "rdzv": _STR, "max_gang_restarts": _INT},
+    ),
+    # a gang was formed and launched: which host slots, at what world size,
+    # under which fleet epoch and derived rendezvous port
+    "fleet_launch": (
+        {"job": _STR, "fleet_epoch": _INT, "attempt": _INT, "hosts": _LIST,
+         "world_size": _INT},
+        {"port": _INT, "rollback": _INT},
+    ),
+    # one host's fleet-managed agent exited (outcome per the exit taxonomy)
+    "fleet_host_exit": (
+        {"job": _STR, "fleet_epoch": _INT, "host": _INT, "outcome": _STR},
+        {"code": _INT, "wall_s": _NUM},
+    ),
+    # the controller declared a fleet-level failure for the running gang
+    # (whole-host death, gang-wide hang, ...) and will re-form it
+    "fleet_failure": (
+        {"job": _STR, "fleet_epoch": _INT, "outcome": _STR},
+        {"dead_hosts": _LIST, "codes": _LIST},
+    ),
+    # a cooperative gang resize: reason is host_failure (shrink) or rejoin
+    # (a healed host returns; survivors checkpoint-and-exit at the agreed
+    # step and the gang relaunches at the new size)
+    "fleet_resize": (
+        {"job": _STR, "from_epoch": _INT, "to_epoch": _INT, "from_hosts": _INT,
+         "to_hosts": _INT, "reason": _STR},
+        {},
+    ),
+    # the multi-job queue preempted a running job for a higher-priority one
+    # (bounded drain: announce -> checkpoint-and-exit -> SIGTERM -> SIGKILL)
+    "fleet_preempt": (
+        {"job": _STR, "by": _STR},
+        {"priority": _NUM, "by_priority": _NUM, "drain_s": _NUM},
+    ),
+    # the gang recovery policy's decision for a non-clean gang outcome
+    "fleet_recovery": (
+        {"job": _STR, "fleet_epoch": _INT, "outcome": _STR, "action": _STR},
+        {"backoff_s": _NUM, "rollback": _INT, "restarts_in_window": _INT,
+         "reason": _STR},
+    ),
+    # one job's final word: verdict is clean | gave_up | preempted
+    "fleet_verdict": (
+        {"job": _STR, "verdict": _STR, "attempts": _INT},
+        {"gang_restarts": _INT, "resizes": _INT, "rollbacks": _INT,
+         "reason": _STR, "wall_s": _NUM},
     ),
     # serving (dtpu-serve, docs/SERVING.md) -------------------------------
     # a serve replica came up: hosted models, compiled batch ladder, bind
@@ -309,7 +371,16 @@ def validate_record(record: Any) -> list[str]:
 
 
 def _journal_parts(path: str) -> list[str]:
-    """The journal file plus any ``.part<N>`` continuations, in write order."""
+    """The journal file plus any ``.part<N>`` continuations, in write order.
+
+    Suffixes may nest: a *supervisory* journal is itself a part file
+    (``.part2001`` for fleet host 1, ``.part3000`` for the controller,
+    ``.part1000+R`` for serve replicas), and on a remote OUT_DIR its own
+    commit/reopen continuations land at ``.part2001.part1``, ``...part2``
+    (object stores have no append — `Journal` opens the next part). Each
+    dot-separated number chain sorts as a tuple, so nested continuations
+    read back in write order right after their base part.
+    """
     paths = [path]
     parent, name = os.path.split(str(path))
     try:
@@ -319,9 +390,9 @@ def _journal_parts(path: str) -> list[str]:
     parts = []
     for f in siblings:
         if f.startswith(name + ".part"):
-            suffix = f[len(name) + 5 :]
-            if suffix.isdigit():
-                parts.append((int(suffix), pathio.join(parent, f)))
+            nums = f[len(name) + 5 :].split(".part")
+            if all(s.isdigit() for s in nums):
+                parts.append((tuple(int(s) for s in nums), pathio.join(parent, f)))
     return paths + [p for _, p in sorted(parts)]
 
 
@@ -333,8 +404,17 @@ def read_journal(path: str, *, strict: bool = False) -> Iterator[dict]:
     append can tear an earlier part's (the record's remainder is lost, the
     stream continues in the next part). Any other undecodable line raises —
     that is corruption, not tearing.
+
+    A *missing main file* is tolerated when ``.part<N>`` continuations
+    exist: supervisors (dtpu-fleet's controller, fleet-managed host agents)
+    journal into parts before any worker has opened the main file, and a
+    job of pure shell commands never opens it at all. A journal with
+    neither main nor parts still raises FileNotFoundError.
     """
-    for part_path in _journal_parts(path):
+    parts = _journal_parts(path)
+    if len(parts) > 1 and not pathio.exists(parts[0]):
+        parts = parts[1:]
+    for part_path in parts:
         with _open_read(part_path) as f:
             lines = f.read().splitlines()
         for i, line in enumerate(lines):

@@ -169,6 +169,153 @@ def render(records: Iterable[dict]) -> str:
         if n_recover == 0 and not by_kind["supervisor_verdict"]:
             out("  (supervision still in progress)")
 
+    # -- fleet orchestration (dtpu-fleet) -----------------------------------
+    # only present for fleet-managed pools; omitted otherwise so ordinary
+    # reports (and the golden test) are unchanged
+    if by_kind["fleet_start"] or by_kind["fleet_launch"] or by_kind["fleet_verdict"]:
+        out("")
+        if by_kind["fleet_start"]:
+            s = by_kind["fleet_start"][-1]
+            out(
+                f"fleet: pool of {s.get('hosts', '?')} host slot(s) x "
+                f"{s.get('nprocs_per_host', '?')} rank(s), "
+                f"{s.get('jobs', '?')} job(s) (rendezvous {s.get('rdzv', '?')})"
+            )
+        else:
+            out("fleet:")
+        for r in by_kind["fleet_launch"]:
+            out(
+                f"  gang epoch {r.get('fleet_epoch', '?')}: hosts "
+                f"{r.get('hosts', [])} world {r.get('world_size', '?')} "
+                f"port {r.get('port', '?')} [{r.get('job', '?')}]"
+                + (f" rollback {r['rollback']}" if r.get("rollback") else "")
+            )
+        for r in by_kind["fleet_failure"]:
+            out(
+                f"  FAILURE at epoch {r.get('fleet_epoch', '?')}: "
+                f"{r.get('outcome', '?')}"
+                + (f", host(s) {r['dead_hosts']} dead" if r.get("dead_hosts") else "")
+            )
+        for r in by_kind["fleet_resize"]:
+            out(
+                f"  resize {r.get('from_hosts', '?')} -> {r.get('to_hosts', '?')} "
+                f"host(s) (epoch {r.get('from_epoch', '?')} -> "
+                f"{r.get('to_epoch', '?')}, {r.get('reason', '?')})"
+            )
+        for r in by_kind["fleet_preempt"]:
+            out(
+                f"  preempt: {r.get('job', '?')} (priority {r.get('priority', '?')}) "
+                f"by {r.get('by', '?')} (priority {r.get('by_priority', '?')})"
+            )
+        for r in by_kind["fleet_verdict"]:
+            out(
+                f"  verdict[{r.get('job', '?')}]: {r.get('verdict', '?').upper()} "
+                f"after {r.get('attempts', '?')} gang(s), "
+                f"{r.get('gang_restarts', 0)} restart(s), "
+                f"{r.get('resizes', 0)} resize(s)"
+                + (f" — {r['reason']}" if r.get("reason") else "")
+            )
+
+    # -- goodput timeline (per-attempt startup / productive / downtime) ------
+    # attributes every second of a supervised or fleet-managed run: for each
+    # launch, how long until the first step landed (startup: restore + the
+    # compile the persistent cache makes warm), how long the attempt trained,
+    # and how much wall time the restarts cost. Warm-vs-cold startup is the
+    # compile-cache acceptance evidence. Serve-replica launches (replica
+    # field) are excluded — their goodput story is the SLO section.
+    # fleet-managed runs: the controller's fleet_launch records ARE the
+    # attempts — the per-host supervisor_launch records (one per host per
+    # gang) would double-count them. Launches/exits are grouped per JOB: the
+    # pool journal holds every job's fleet records but only one job's window
+    # stream (named queue jobs journal into their own out dirs), so a mixed
+    # timeline would attribute one job's windows to another's gangs.
+    _launch_kind, _exit_kind = (
+        ("fleet_launch", "fleet_host_exit")
+        if by_kind["fleet_launch"]
+        else ("supervisor_launch", "supervisor_exit")
+    )
+    launches_by_job: dict[str, list[dict]] = defaultdict(list)
+    for r in by_kind[_launch_kind]:
+        if r.get("replica") is None and isinstance(r.get("ts"), (int, float)):
+            launches_by_job[r.get("job", "")].append(r)
+    exits_by_job: dict[str, list[dict]] = defaultdict(list)
+    for r in by_kind[_exit_kind]:
+        if r.get("replica") is None and isinstance(r.get("ts"), (int, float)):
+            exits_by_job[r.get("job", "")].append(r)
+    windows_ts = sorted(
+        (w for w in by_kind["window"] if isinstance(w.get("ts"), (int, float))),
+        key=lambda w: w["ts"],
+    )
+    timeline_header = False
+    for job_name in sorted(launches_by_job):
+        timeline_launches = sorted(launches_by_job[job_name], key=lambda r: r["ts"])
+        spans = [
+            (
+                launch["ts"],
+                timeline_launches[i + 1]["ts"]
+                if i + 1 < len(timeline_launches)
+                else float("inf"),
+            )
+            for i, launch in enumerate(timeline_launches)
+        ]
+        job_windows = [
+            w for w in windows_ts if any(a <= w["ts"] < b for a, b in spans)
+        ]
+        if not job_windows:
+            continue  # this journal carries another job's window stream
+        if not timeline_header:
+            timeline_header = True
+            out("")
+            out("goodput timeline:")
+        tag = f" [{job_name}]" if len(launches_by_job) > 1 and job_name else ""
+        t0 = timeline_launches[0]["ts"]
+        exits = sorted(exits_by_job[job_name], key=lambda r: r["ts"])
+        startups: list[float] = []
+        downtime = 0.0
+        prev_end: float | None = None
+        for i, launch in enumerate(timeline_launches):
+            t_start, t_next = spans[i]
+            ws = [w for w in job_windows if t_start <= w["ts"] < t_next]
+            exit_recs = [r for r in exits if t_start <= r["ts"] < t_next]
+            t_end = max(
+                [r["ts"] for r in exit_recs] + [w["ts"] for w in ws] + [t_start]
+            )
+            label = (
+                f"  attempt {launch.get('attempt', i + 1)}{tag} "
+                f"@ +{t_start - t0:.0f}s: "
+            )
+            if ws:
+                startup = ws[0]["ts"] - t_start
+                startups.append(startup)
+                productive = max(0.0, t_end - ws[0]["ts"])
+                warm = ""
+                if len(startups) > 1 and startups[0] > 0:
+                    warm = f" ({startup / startups[0]:.2f}x of cold)"
+                label += (
+                    f"first step +{startup:.1f}s{warm}, "
+                    f"productive {_fmt_s(productive)}"
+                )
+            else:
+                label += "no steps landed"
+            if exit_recs:
+                label += f", exit {exit_recs[-1].get('outcome', '?')}"
+            out(label)
+            if prev_end is not None:
+                gap = (t_start - prev_end) + (ws[0]["ts"] - t_start if ws else 0.0)
+                downtime += max(0.0, gap)
+            prev_end = t_end
+        if len(timeline_launches) > 1:
+            line = (
+                f"  restart downtime{tag} {_fmt_s(downtime)} across "
+                f"{len(timeline_launches) - 1} restart(s)"
+            )
+            if len(startups) > 1:
+                line += (
+                    f"; startup cold {startups[0]:.1f}s vs warm "
+                    f"{_median(startups[1:]):.1f}s"
+                )
+            out(line)
+
     # -- serving (dtpu-serve) -----------------------------------------------
     # only present for serving runs; omitted otherwise so training reports
     # (and the golden test) are unchanged

@@ -44,6 +44,7 @@ Pieces, all config-driven via the ``FAULT`` section:
 from __future__ import annotations
 
 import faulthandler
+import json
 import os
 import random
 import signal
@@ -66,7 +67,14 @@ class Preempted(SystemExit):
 
     def __init__(self, message: str = "preempted", code: int | None = None):
         if code is None:
-            code = 128 + _preempt_signum if _preempt_signum else 143
+            if fleet_resize_requested():
+                # the dtpu-fleet controller announced a new gang epoch and
+                # this rank stopped cooperatively: the supervisor must see
+                # "resize" (re-form the gang NOW at the new size), not an
+                # ordinary preemption
+                code = RESIZE_EXIT_CODE
+            else:
+                code = 128 + _preempt_signum if _preempt_signum else 143
         super().__init__(code)
         self.message = message
 
@@ -359,6 +367,19 @@ HANG_EXIT_CODE = 124
 # 128+signum family.
 POISON_EXIT_CODE = 117
 
+# A worker that stopped cooperatively for a fleet resize (the dtpu-fleet
+# controller announced a new gang epoch; the rank emergency-checkpointed at
+# the agreed step boundary and exited so the gang can re-form at the new
+# size). Same durability contract as a preemption exit — restart resumes
+# exactly where it stopped — but the controller must tell the two apart:
+# a resize relaunch is immediate and re-forms the gang at a NEW size.
+RESIZE_EXIT_CODE = 118
+
+# 128+SIGKILL: how a fleet-managed dtpu-agent reports "a rank on this host
+# hard-died" upward to the fleet controller (merge_outcomes -> killed needs
+# a positive exit code to ride a process boundary).
+KILLED_EXIT_CODE = 137
+
 # Graceful-preemption exits (Preempted): 128+SIGTERM from the scheduler,
 # 128+SIGINT from an operator. Both mean "the run checkpointed and stopped
 # on purpose" — a supervisor restart resumes exactly where it left off.
@@ -367,10 +388,29 @@ PREEMPT_EXIT_CODES = (143, 130)
 # classify_exit_code verdicts, in escalation order for the agent's policy.
 EXIT_CLEAN = "clean"
 EXIT_PREEMPTED = "preempted"
+EXIT_RESIZE = "resize"
 EXIT_HANG = "hang"
 EXIT_POISON = "poison"
 EXIT_KILLED = "killed"
 EXIT_CRASH = "crash"
+
+# The round trip fleet-managed agents use to forward a merged fleet outcome
+# across their own process boundary: classify_exit_code(outcome_exit_code(o))
+# == o for every outcome (pinned by tests/test_fleet.py).
+_OUTCOME_EXIT_CODES = {
+    EXIT_CLEAN: 0,
+    EXIT_PREEMPTED: 143,
+    EXIT_RESIZE: RESIZE_EXIT_CODE,
+    EXIT_HANG: HANG_EXIT_CODE,
+    EXIT_POISON: POISON_EXIT_CODE,
+    EXIT_KILLED: KILLED_EXIT_CODE,
+    EXIT_CRASH: 1,
+}
+
+
+def outcome_exit_code(outcome: str) -> int:
+    """The exit code that re-classifies to ``outcome`` (crash for unknowns)."""
+    return _OUTCOME_EXIT_CODES.get(outcome, 1)
 
 
 def classify_exit_code(code: int | None) -> str:
@@ -378,16 +418,21 @@ def classify_exit_code(code: int | None) -> str:
 
     ``None`` (still running / launcher timeout) and negative codes (died to
     signal ``-code``, e.g. an OOM-kill's SIGKILL) are both hard deaths with
-    no cleanup — `EXIT_KILLED`. Everything unrecognized is `EXIT_CRASH`.
+    no cleanup — `EXIT_KILLED`, as is the positive 128+SIGKILL form a
+    fleet-managed agent forwards. Everything unrecognized is `EXIT_CRASH`.
     """
     if code == 0:
         return EXIT_CLEAN
     if code is None or (isinstance(code, int) and code < 0):
         return EXIT_KILLED
+    if code == KILLED_EXIT_CODE:
+        return EXIT_KILLED
     if code == HANG_EXIT_CODE:
         return EXIT_HANG
     if code == POISON_EXIT_CODE:
         return EXIT_POISON
+    if code == RESIZE_EXIT_CODE:
+        return EXIT_RESIZE
     if code in PREEMPT_EXIT_CODES:
         return EXIT_PREEMPTED
     return EXIT_CRASH
@@ -410,6 +455,169 @@ def call_with_poison_exit(fn: Callable[[], Any]) -> tuple[int, Any]:
         print(f"POISON: {exc}", file=sys.stderr, flush=True)
         return POISON_EXIT_CODE, None
     return 0, result
+
+
+# ---------------------------------------------------------------------------
+# Fleet cooperative-stop protocol (the client side of dtpu-fleet's gang
+# resize/preemption; docs/FAULT_TOLERANCE.md "Fleet runs")
+# ---------------------------------------------------------------------------
+#
+# A fleet-managed worker finds two small files under the controller-owned
+# signals directory (env ``DTPU_FLEET_SIGNALS``):
+#
+# - ``signals.json``: ``{"fleet_epoch": E, "stop": null|"preempt"}`` — the
+#   controller's announcement. ``fleet_epoch`` greater than the epoch this
+#   worker was launched at (env ``DTPU_FLEET_EPOCH``) means "a resize is
+#   pending: checkpoint and exit so the gang can re-form at the new size";
+#   ``stop == "preempt"`` means "this job is being preempted (multi-job
+#   queue / controller shutdown): checkpoint and exit".
+# - ``stop_step``: the *agreed* global step to stop at, published by global
+#   rank 0 once it sees the announcement. Stopping is collective (the
+#   emergency checkpoint is a multi-process save, and a lone rank leaving
+#   the step loop strands the rest in their next collective), so every rank
+#   stops at exactly this step. Rank 0 picks ``its own gstep + margin``
+#   where the margin exceeds the maximum host-loop drift between ranks
+#   (bounded by PRINT_FREQ's device_get sync + the prefetch depth); every
+#   rank polls both files at every step boundary, so by the time the agreed
+#   step arrives each rank has read it. SIGTERM-based agreement (the JAX
+#   preemption sync point) is NOT used here: the controller initiates these
+#   stops and a file on the shared OUT_DIR filesystem is observable by
+#   every host without relying on signal delivery order.
+
+FLEET_MARKER_NAME = "signals.json"
+FLEET_STOP_STEP_NAME = "stop_step"
+
+
+def _read_fleet_marker(signals_dir: str) -> dict:
+    """Decode the controller's announcement ({} when absent/torn — a torn
+    read is retried at the next step boundary, never fatal). Through pathio:
+    a fleet's signals dir lives under OUT_DIR, which may be an object store
+    — the same store `FleetSignals` writes it to."""
+    from distribuuuu_tpu.runtime import pathio
+
+    try:
+        marker = json.loads(
+            pathio.read_bytes(os.path.join(signals_dir, FLEET_MARKER_NAME))
+        )
+        return marker if isinstance(marker, dict) else {}
+    except Exception:
+        return {}
+
+
+def fleet_resize_requested() -> bool:
+    """Is a fleet resize pending for THIS worker (controller announced a
+    gang epoch newer than the one this worker launched at)? Consulted by
+    `Preempted` so a cooperative resize stop exits `RESIZE_EXIT_CODE`
+    instead of the generic preemption 143."""
+    signals_dir = os.environ.get("DTPU_FLEET_SIGNALS", "")
+    if not signals_dir:
+        return False
+    marker = _read_fleet_marker(signals_dir)
+    try:
+        return int(marker.get("fleet_epoch", -1)) > int(
+            os.environ.get("DTPU_FLEET_EPOCH", "-1")
+        )
+    except (TypeError, ValueError):
+        return False
+
+
+class FleetSignalPoller:
+    """Step-boundary poller for the fleet cooperative-stop protocol.
+
+    ``check(gstep)`` returns ``None`` (keep training) or the stop kind
+    (``"resize"`` / ``"preempt"``) once THIS rank should stop — i.e. once
+    the agreed stop step has been published and reached. The trainer then
+    takes the exact emergency-checkpoint path a preemption takes.
+
+    Two stat+reads of small local files per step boundary; microseconds
+    against millisecond-scale steps, and only in fleet-managed runs.
+    """
+
+    def __init__(
+        self,
+        signals_dir: str,
+        fleet_epoch: int,
+        *,
+        is_primary: bool,
+        margin_steps: int,
+    ):
+        self.signals_dir = str(signals_dir)
+        self.fleet_epoch = int(fleet_epoch)
+        self.is_primary = bool(is_primary)
+        self.margin_steps = max(1, int(margin_steps))
+        self._stop_kind: str | None = None
+        self._stop_step: int | None = None
+
+    @classmethod
+    def from_env(
+        cls, *, is_primary: bool, margin_steps: int
+    ) -> "FleetSignalPoller | None":
+        signals_dir = os.environ.get("DTPU_FLEET_SIGNALS", "")
+        if not signals_dir:
+            return None
+        return cls(
+            signals_dir,
+            int(os.environ.get("DTPU_FLEET_EPOCH", "-1")),
+            is_primary=is_primary,
+            margin_steps=margin_steps,
+        )
+
+    def _stop_requested(self) -> str | None:
+        marker = _read_fleet_marker(self.signals_dir)
+        if not marker:
+            return None
+        try:
+            if int(marker.get("fleet_epoch", -1)) > self.fleet_epoch:
+                return "resize"
+        except (TypeError, ValueError):
+            pass
+        return "preempt" if marker.get("stop") == "preempt" else None
+
+    def _read_stop_step(self) -> int | None:
+        from distribuuuu_tpu.runtime import pathio
+
+        try:
+            return int(
+                pathio.read_bytes(
+                    os.path.join(self.signals_dir, FLEET_STOP_STEP_NAME)
+                )
+                .decode("utf-8")
+                .strip()
+            )
+        except Exception:
+            return None
+
+    def _publish_stop_step(self, gstep: int) -> int:
+        """Rank 0 only: publish the agreed stop step (atomic via rename, so
+        a peer never reads a torn value)."""
+        from distribuuuu_tpu.runtime import pathio
+
+        stop = int(gstep) + self.margin_steps
+        pathio.write_text(
+            os.path.join(self.signals_dir, FLEET_STOP_STEP_NAME), str(stop)
+        )
+        logger.warning(
+            f"fleet: cooperative stop requested; this gang stops at the "
+            f"agreed global step {stop} (margin {self.margin_steps})"
+        )
+        return stop
+
+    def check(self, gstep: int) -> str | None:
+        if self._stop_kind is None:
+            kind = self._stop_requested()
+            if kind is None:
+                return None
+            step = self._read_stop_step()
+            if step is None:
+                if not self.is_primary:
+                    return None  # wait for rank 0 to publish the agreed step
+                step = self._publish_stop_step(gstep)
+            self._stop_kind, self._stop_step = kind, step
+        # >= not ==, defensively: a rank that somehow learned the stop step
+        # late stops at its next boundary (the collective save will then
+        # fail loudly and the watchdog/controller recovers the gang — a
+        # bounded failure beats an unbounded straggler)
+        return self._stop_kind if gstep >= (self._stop_step or 0) else None
 
 
 def dump_all_stacks(reason: str = "") -> None:

@@ -25,11 +25,14 @@ Environment autodetection mirrors the reference's dual Slurm/launcher logic:
 from __future__ import annotations
 
 import faulthandler
+import hashlib
+import json
 import os
 import re
 import signal
 import socket
 import subprocess
+import time
 from dataclasses import dataclass
 
 from typing import Iterable
@@ -106,6 +109,39 @@ def pick_rendezvous_port(exclude: "Iterable[int]" = ()) -> int:
     )
 
 
+def derive_rendezvous_port(
+    job_id: str, *, exclude: "Iterable[int]" = (), attempts: int = 32
+) -> int:
+    """A rendezvous port derived deterministically from a job id.
+
+    The fleet controller (distribuuuu_tpu/fleet.py) assigns every gang a job
+    id (stable name + fleet epoch); hashing it to a port means every re-formed
+    gang lands on the same port *without coordination* — two hosts (or a host
+    and a controller restart) deriving the port independently cannot race
+    each other the way independent `pick_rendezvous_port` calls can, because
+    there is no longer a choice to disagree on.
+
+    The derived sequence is walked in order and the first candidate that is
+    (a) outside ``exclude`` (the serve-frontend exclusion, same as
+    `pick_rendezvous_port`) and (b) currently bindable is returned — so a
+    port squatted by an unrelated process degrades deterministically to the
+    next derived candidate, not to a random pick. Only after ``attempts``
+    derived candidates fail does this fall back to the OS's ephemeral pick.
+    """
+    excluded = {int(p) for p in exclude}
+    excluded.add(_DEFAULT_PORT)  # never collide with the env-default port
+    for i in range(attempts):
+        digest = hashlib.sha256(f"{job_id}:{i}".encode()).digest()
+        # 20000-29499: above the common registered-services range, below the
+        # default rendezvous port and typical ephemeral ranges
+        port = 20000 + int.from_bytes(digest[:4], "big") % 9500
+        if port in excluded:
+            continue
+        if port_is_free(port):
+            return port
+    return pick_rendezvous_port(exclude=excluded)
+
+
 def rendezvous_ports_in_play() -> set[int]:
     """Ports the rendezvous machinery may bind on this host — the exclusion
     set a port-0 serve frontend pick must avoid (the other half of the
@@ -115,6 +151,91 @@ def rendezvous_ports_in_play() -> set[int]:
     if mp.isdigit():
         ports.add(int(mp))
     return ports
+
+
+# ---------------------------------------------------------------------------
+# Fleet rendezvous client (the worker side of dtpu-fleet's gang scheduling,
+# distribuuuu_tpu/fleet.py; docs/FAULT_TOLERANCE.md "Fleet runs")
+# ---------------------------------------------------------------------------
+
+def fleet_request(address: str, payload: dict, *, timeout_s: float = 10.0) -> dict:
+    """One JSON-line request/response round trip with the fleet controller's
+    rendezvous service (``host:port``). Raises OSError/ValueError on
+    transport or decode failures — retry policy is the caller's."""
+    host, _, port = address.rpartition(":")
+    with socket.create_connection((host or "127.0.0.1", int(port)), timeout=timeout_s) as s:
+        f = s.makefile("rw", encoding="utf-8", newline="\n")
+        f.write(json.dumps(payload) + "\n")
+        f.flush()
+        line = f.readline()
+    if not line:
+        raise OSError(f"rendezvous service at {address} closed without replying")
+    resp = json.loads(line)
+    if not isinstance(resp, dict):
+        raise ValueError(f"malformed rendezvous response: {line!r}")
+    return resp
+
+
+def maybe_fleet_rendezvous(*, deadline_s: float = 60.0) -> bool:
+    """Fleet-managed workers: register with the controller's rendezvous
+    service and export the assignment as the standard launcher env vars.
+
+    A gang-scheduled worker is launched with ``DTPU_FLEET_CONTROLLER``
+    (the rendezvous address), ``DTPU_FLEET_HOST`` (this host's slot),
+    ``DTPU_FLEET_LOCAL_RANK`` and ``DTPU_FLEET_EPOCH`` — but NOT with
+    RANK/WORLD_SIZE/MASTER_ADDR/MASTER_PORT: the *controller* owns the gang
+    topology (it shrinks on whole-host failure and grows back on rejoin),
+    so the worker asks at startup instead of trusting launch-time env. The
+    assignment is exported as exactly the env vars `setup_distributed`'s
+    manual-launcher branch already understands, so everything downstream
+    (including per-process batch sizing done before `setup_distributed`)
+    reads one vocabulary.
+
+    Returns True when an assignment was obtained (or already exported),
+    False when this is not a fleet-managed process. A registration the
+    controller *refuses* (stale fleet epoch — this worker belongs to a gang
+    that was already re-formed) raises RuntimeError: a stale worker must
+    die loudly, never rendezvous into the wrong gang.
+    """
+    address = os.environ.get("DTPU_FLEET_CONTROLLER", "")
+    if not address:
+        return False
+    if "RANK" in os.environ and "WORLD_SIZE" in os.environ:
+        return True  # already resolved (idempotent across re-entry)
+    payload = {
+        "op": "register",
+        "host": int(os.environ.get("DTPU_FLEET_HOST", "0")),
+        "local_rank": int(os.environ.get("DTPU_FLEET_LOCAL_RANK", "0")),
+        "fleet_epoch": int(os.environ.get("DTPU_FLEET_EPOCH", "-1")),
+        "pid": os.getpid(),
+    }
+    deadline = time.monotonic() + deadline_s
+    delay = 0.1
+    while True:
+        try:
+            resp = fleet_request(address, payload)
+            break
+        except (OSError, ValueError) as exc:
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"fleet rendezvous at {address} unreachable for "
+                    f"{deadline_s:.0f}s: {exc!r}"
+                ) from exc
+            time.sleep(delay)
+            delay = min(2.0, delay * 2)
+    if not resp.get("ok"):
+        raise RuntimeError(
+            f"fleet rendezvous refused this worker: {resp.get('error', '?')} "
+            f"(controller fleet_epoch {resp.get('fleet_epoch', '?')}, "
+            f"ours {payload['fleet_epoch']})"
+        )
+    os.environ.update(
+        RANK=str(int(resp["rank"])),
+        WORLD_SIZE=str(int(resp["world_size"])),
+        MASTER_ADDR=str(resp["master_addr"]),
+        MASTER_PORT=str(int(resp["master_port"])),
+    )
+    return True
 
 
 def _first_slurm_hostname(nodelist: str) -> str:
@@ -182,12 +303,21 @@ def setup_distributed(port: int | None = None) -> DistInfo:
     process is externally diagnosable whatever the watchdog config.
     """
     _install_stack_dump_signal()
+    # fleet-managed workers resolve their gang assignment first: the
+    # controller's answer lands in RANK/WORLD_SIZE/MASTER_* so the manual-
+    # launcher branch below handles fleet and non-fleet runs identically.
+    # When it resolved, the Slurm branch is SKIPPED: a fleet launched inside
+    # an sbatch allocation inherits SLURM_JOB_ID/SLURM_PROCID into every
+    # worker, and letting that branch win would make each rank take the
+    # same inherited SLURM_PROCID (every rank "rank 0" of a world of
+    # SLURM_NTASKS) instead of the controller's assignment.
+    fleet_managed = maybe_fleet_rendezvous()
     env = os.environ
     coordinator = None
     num_processes = 1
     process_id = 0
 
-    if "SLURM_JOB_ID" in env and "SLURM_PROCID" in env:
+    if not fleet_managed and "SLURM_JOB_ID" in env and "SLURM_PROCID" in env:
         process_id = int(env["SLURM_PROCID"])
         num_processes = int(env.get("SLURM_NTASKS", "1"))
         addr = _first_slurm_hostname(env["SLURM_NODELIST"])

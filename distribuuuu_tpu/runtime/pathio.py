@@ -98,6 +98,14 @@ def write_text(path: str, text: str) -> None:
     _os.replace(tmp, path)
 
 
+def remove(path: str) -> None:
+    """Delete a single file; a missing file is fine (signal-file cleanup)."""
+    try:
+        epath.Path(path).unlink()
+    except FileNotFoundError:
+        pass
+
+
 def rename(src: str, dst: str) -> None:
     """Rename/move a file or directory tree (quarantine path). Local: one
     ``os.replace``-style rename. Object stores: epath's copy+delete."""

@@ -495,6 +495,7 @@ def train_epoch(
     loader, mesh, train_step, state, epoch: int, rng, is_primary: bool,
     start_epoch: int = 0, run_tic: float | None = None,
     start_step: int = 0, best_acc1: float = 0.0, injector=None,
+    fleet_poller=None,
 ):
     lr = optim.get_epoch_lr(epoch)
     if is_primary:
@@ -556,6 +557,12 @@ def train_epoch(
             # injection keys off gstep, identical on every host — safe to
             # stop without the multi-host agreement below
             resilience.request_preemption(f"injected at global step {gstep}")
+            stop_here = True
+        elif fleet_poller is not None and (fleet_kind := fleet_poller.check(gstep)):
+            # fleet cooperative stop (resize / queue preemption): the agreed
+            # stop step IS the multi-host agreement — every rank reads the
+            # same published step and stops at the same boundary
+            resilience.request_preemption(f"fleet {fleet_kind} at global step {gstep}")
             stop_here = True
         else:
             # multi-host: stop only when every host agrees on this step
@@ -862,6 +869,20 @@ def train_model():
             f"(failures={injector.io_failures}), nan_steps="
             f"{sorted(injector.nan_steps)}, preempt_step={injector.preempt_step}"
         )
+    # fleet-managed runs (dtpu-fleet, env DTPU_FLEET_SIGNALS): poll the
+    # controller's cooperative-stop files at step boundaries. The stop-step
+    # margin must exceed the worst host-loop drift between ranks: hosts sync
+    # at every PRINT_FREQ device_get and dispatch at most PREFETCH batches
+    # ahead, so PRINT_FREQ + 2*PREFETCH + a safety pad covers it.
+    fleet_poller = resilience.FleetSignalPoller.from_env(
+        is_primary=info.is_primary,
+        margin_steps=cfg.TRAIN.PRINT_FREQ + 2 * cfg.TRAIN.PREFETCH + 4,
+    )
+    if fleet_poller is not None:
+        logger.info(
+            f"Fleet-managed run: gang epoch {fleet_poller.fleet_epoch}, "
+            f"cooperative-stop signals at {fleet_poller.signals_dir}"
+        )
     mesh = data_mesh(cfg.MESH.DATA, cfg.MESH.FSDP)
     # fleet-wide samples one optimizer step consumes — the unit elastic
     # resume remaps checkpointed sample offsets with
@@ -968,6 +989,7 @@ def train_model():
                 info.is_primary, start_epoch=start_epoch, run_tic=run_tic,
                 start_step=start_step if epoch == start_epoch else 0,
                 best_acc1=best_acc1, injector=injector,
+                fleet_poller=fleet_poller,
             )
             acc1, _ = validate(
                 val_loader, mesh, eval_step, state, info.is_primary, epoch=epoch

@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""Fleet orchestration launcher — thin wrapper over `distribuuuu_tpu.fleet`.
+
+    python scripts/dtpu_fleet.py --cfg config/resnet50.yaml [KEY VALUE ...]
+
+Identical to ``python -m distribuuuu_tpu.fleet`` (and the ``dtpu-fleet``
+console script); exists so repo checkouts without an installed package get
+the same one-liner as train_net.py. See docs/FAULT_TOLERANCE.md
+"Fleet runs" for the gang lifecycle, resize protocol and queue semantics.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distribuuuu_tpu.fleet import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

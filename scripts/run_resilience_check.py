@@ -4,7 +4,7 @@ fault-tolerance layer, docs/FAULT_TOLERANCE.md), outside pytest with the
 phases spelled out and timed so a failing resume can be bisected
 interactively.
 
-    python scripts/run_resilience_check.py [--scenario basic|elastic|corrupt|supervised|all]
+    python scripts/run_resilience_check.py [--scenario basic|elastic|corrupt|supervised|fleet|all]
 
 Scenarios:
 
@@ -26,6 +26,13 @@ Scenarios:
   input), finish bitwise-identical to an uninterrupted run, and journal the
   whole story as ``supervisor_*`` records. (This scenario re-execs this
   script with ``--worker`` as the supervised rank command.)
+- **fleet** (tests/test_fleet.py chaos tier): a 2-simulated-host gang under
+  `python -m distribuuuu_tpu.fleet` with every rank of host 1 SIGKILLed
+  mid-epoch-1 and the slot quarantined: the controller must gang-restart at
+  reduced size (world 1) into elastic resume, then let the healed host
+  rejoin at the next checkpoint boundary (cooperative resize; world size
+  returns to 2, the fleet epoch advances), finish with a complete step
+  stream, and journal it all as schema-valid ``fleet_*`` records.
 
 Exit code 0 iff every requested scenario passes. Self-pins to a virtual
 8-device CPU mesh (cpu_mesh_run-style bootstrap), so it runs anywhere.
@@ -308,10 +315,80 @@ def check_supervised(scratch: str, epochs: int) -> bool:
     return False
 
 
+def check_fleet(scratch: str) -> bool:
+    """Fleet chaos (tests/test_fleet.py, interactively): kill an entire
+    simulated host of a 2-host gang; the controller must re-form the gang
+    at reduced size, rejoin the healed host at the next checkpoint boundary
+    (cooperative resize, fleet epoch advances, world size returns to 2),
+    and finish with a complete, schema-valid journaled step stream."""
+    import subprocess
+
+    from distribuuuu_tpu import obs
+    from distribuuuu_tpu.obs.journal import validate_journal
+
+    out = os.path.join(scratch, "fleet")
+    worker = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "_fleet_worker.py",
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # each rank is its own 1-device "host"
+    env.update(
+        DTPU_FAULT_KILL_STEP="20",   # epoch 1, step 4: ep-0 ckpt durable
+        DTPU_TEST_KILL_HOST="1",     # ...every rank of host 1 only
+        DTPU_TEST_HANG_TIMEOUT_S="20",
+    )
+    t0 = time.time()
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "distribuuuu_tpu.fleet",
+            "OUT_DIR", out,
+            "FLEET.HOSTS", "2",
+            "FLEET.HOST_COOLDOWN_S", "25",  # the dead host stays down a while
+            "FLEET.DRAIN_S", "12",
+            "FLEET.BACKOFF_BASE_S", "0.05", "FLEET.BACKOFF_MAX_S", "0.2",
+            "AGENT.CMD", f"{sys.executable} {worker} {out} 6",
+            "AGENT.CPU_DEVICES_PER_WORKER", "1",
+            "AGENT.PREFLIGHT_DEVICE_PROBE", "False",
+            "AGENT.EXIT_BARRIER_S", "45",
+        ],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    journal = os.path.join(out, "telemetry.jsonl")
+    schema_errors = validate_journal(journal)
+    recs = list(obs.read_journal(journal))
+    launches = [r for r in recs if r.get("kind") == "fleet_launch"]
+    worlds = [r["world_size"] for r in launches]
+    resizes = [r for r in recs if r.get("kind") == "fleet_resize"]
+    verdicts = [r for r in recs if r.get("kind") == "fleet_verdict"]
+    losses = {r["gstep"] for r in recs
+              if r.get("kind") == "window" and r.get("loss") is not None}
+    complete = losses == set(range(96))  # 6 epochs x 16 steps, each ran
+    clean = bool(verdicts) and verdicts[-1].get("verdict") == "clean"
+    print(f"[1/1] fleet rc={proc.returncode} in {time.time() - t0:.1f}s; "
+          f"gang worlds={worlds}, {len(resizes)} resize(s), "
+          f"schema_errors={len(schema_errors)}, "
+          f"stream_complete={complete}, "
+          f"verdict={verdicts[-1].get('verdict') if verdicts else 'MISSING'}")
+    # essential shape, tolerant of one incidental bounded recovery on a
+    # contended box: full gang -> reduced gang -> back to full by the end
+    shape_ok = (
+        len(worlds) >= 3 and worlds[0] == 2 and worlds[1] == 1 and worlds[-1] == 2
+    )
+    if (proc.returncode == 0 and clean and complete and not schema_errors
+            and shape_ok and resizes):
+        print("PASS fleet: host kill -> reduced gang -> checkpoint-boundary "
+              "rejoin -> clean, journaled")
+        return True
+    print(f"FAIL fleet; controller tail:\n{proc.stdout[-2500:]}{proc.stderr[-1500:]}")
+    return False
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--scenario",
-                    choices=("basic", "elastic", "corrupt", "supervised", "all"),
+                    choices=("basic", "elastic", "corrupt", "supervised",
+                             "fleet", "all"),
                     default="basic")
     ap.add_argument("--preempt-step", type=int, default=5,
                     help="global step to inject the simulated SIGTERM before (basic)")
@@ -328,6 +405,7 @@ def main() -> int:
         "elastic": lambda: check_elastic(scratch, args.epochs),
         "corrupt": lambda: check_corrupt(scratch, args.epochs),
         "supervised": lambda: check_supervised(scratch, args.epochs),
+        "fleet": lambda: check_fleet(scratch),
     }
     selected = list(checks) if args.scenario == "all" else [args.scenario]
     rc = 0

@@ -1,0 +1,118 @@
+"""Gang-scheduled rank worker for the dtpu-fleet chaos tests
+(tests/test_fleet.py) — NOT a pytest module.
+
+The fleet-managed sibling of tests/_agent_worker.py: same tiny DUMMY_INPUT
+recipe (global batch 4, 16 steps/epoch), but the gang topology comes from
+the controller's rendezvous service — this worker resolves its assignment
+FIRST (`runtime.dist.maybe_fleet_rendezvous` exports RANK/WORLD_SIZE/
+MASTER_*) and then sizes its per-process batch as ``4 // WORLD_SIZE`` so the
+global batch (and therefore the step/sample stream elastic resume replays)
+is identical at any gang size.
+
+Chaos gating: ``DTPU_TEST_KILL_HOST`` scopes ``DTPU_FAULT_KILL_STEP`` to one
+simulated host — every rank of that host SIGKILLs at the step while the
+other hosts' ranks keep the injection disarmed (the "kill an entire host"
+scenario; the controller disarms the env on gang relaunches like the agent
+does).
+
+argv: out_dir max_epoch
+env:  DTPU_TEST_HANG_TIMEOUT_S   -> cfg.FAULT.HANG_TIMEOUT_S (default 0)
+      DTPU_TEST_KILL_HOST        -> host slot the kill injection applies to
+      DTPU_FLEET_*               -> fleet assignment (controller-provided)
+
+Prints ``FLEET DIGEST <sha256>`` of the final params on a clean finish.
+"""
+
+import hashlib
+import os
+import sys
+
+out_dir, max_epoch = sys.argv[1:3]
+
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=1"
+    ).strip()
+
+# host-scoped chaos: the injection env reaches every rank of every host, but
+# only DTPU_TEST_KILL_HOST's ranks may act on it — scrub it everywhere else
+# BEFORE the FaultInjector (env has precedence over cfg) ever reads it
+_kill_host = os.environ.get("DTPU_TEST_KILL_HOST")
+if _kill_host is not None and os.environ.get("DTPU_FLEET_HOST") != _kill_host:
+    os.environ["DTPU_FAULT_KILL_STEP"] = "-1"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distribuuuu_tpu.runtime.compile_cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+
+import flax.linen as nn  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from distribuuuu_tpu import config, resilience, trainer  # noqa: E402
+from distribuuuu_tpu.models import list_models, register_model  # noqa: E402
+from distribuuuu_tpu.runtime.dist import maybe_fleet_rendezvous  # noqa: E402
+
+if "fleet_tiny" not in list_models():
+
+    class _FleetTiny(nn.Module):
+        num_classes: int = 4
+
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = nn.Conv(4, (3, 3), use_bias=False, dtype=jnp.float32)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            return nn.Dense(self.num_classes)(nn.relu(x).mean(axis=(1, 2)))
+
+    @register_model("fleet_tiny")
+    def fleet_tiny(num_classes, dtype, bn_axis_name=None, remat=False):
+        return _FleetTiny(num_classes=num_classes)
+
+
+def main() -> int:
+    # gang assignment BEFORE any sizing: the controller owns the topology
+    maybe_fleet_rendezvous()
+    world = int(os.environ.get("WORLD_SIZE", "1"))
+    c = config.cfg
+    c.MODEL.ARCH = "fleet_tiny"
+    c.MODEL.NUM_CLASSES = 4
+    c.MODEL.DTYPE = "float32"
+    c.MODEL.DUMMY_INPUT = True
+    c.TRAIN.BATCH_SIZE = 4 // world  # global batch 4 at any gang size
+    c.TRAIN.IM_SIZE = 8
+    c.TEST.IM_SIZE = 8
+    c.TEST.CROP_SIZE = 8
+    c.TEST.BATCH_SIZE = 4 // world
+    c.TRAIN.DUMMY_EPOCH_SAMPLES = 64  # 16 steps/epoch at global batch 4
+    c.TRAIN.PRINT_FREQ = 1
+    c.OPTIM.MAX_EPOCH = int(max_epoch)
+    c.OPTIM.WARMUP_EPOCHS = 0
+    c.RNG_SEED = 5
+    c.FAULT.HANG_TIMEOUT_S = float(os.environ.get("DTPU_TEST_HANG_TIMEOUT_S", "0"))
+    c.FAULT.HANDLE_SIGNALS = True  # drain escalation forwards SIGTERM
+    c.OUT_DIR = out_dir
+
+    code, result = resilience.call_with_poison_exit(trainer.train_model)
+    if code:
+        return code
+    state, best = result
+    digest = hashlib.sha256()
+    for leaf in jax.tree.leaves(jax.device_get(state.params)):
+        digest.update(np.ascontiguousarray(leaf).tobytes())
+    print(f"FLEET DIGEST {digest.hexdigest()}", flush=True)
+    print(
+        f"FLEET OK rank={os.environ.get('RANK', '0')} "
+        f"host={os.environ.get('DTPU_FLEET_HOST', '?')} best={best:.4f}",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -154,6 +154,9 @@ def test_preflight_device_probe_subprocess(tmp_path):
 def test_verify_resume_target_rollback_and_exhaustion(monkeypatch, tmp_path):
     import distribuuuu_tpu.checkpoint as ckpt
 
+    # the checkpoints dir must exist: a missing dir short-circuits to
+    # ("fresh") without ever scanning (the fleet fast path)
+    (tmp_path / "checkpoints").mkdir()
     cands = [
         ((2, 0, 1), "epoch", "/c2"),
         ((1, 0, 1), "epoch", "/c1"),
@@ -377,11 +380,14 @@ def test_agent_cli_preflight_failure_spends_budget(tmp_path):
 def test_agent_cli_heartbeat_kills_wedged_fleet(tmp_path):
     """A fleet whose journal stops growing is killed (SIGUSR2 diagnose →
     grace → SIGKILL), classified as a hang, and restarted — the supervisor-
-    side backstop for a worker wedged beyond its own watchdog's reach."""
+    side backstop for a worker wedged beyond its own watchdog's reach.
+    (STARTUP_GRACE_S is pinned low: this worker never writes a first record,
+    so the pre-beat startup budget is what fires here.)"""
     tic = time.time()
     p = _run_agent_cli(tmp_path, [
         "AGENT.CMD", "sleep 600",
         "AGENT.HEARTBEAT_TIMEOUT_S", "1.0",
+        "AGENT.HEARTBEAT_STARTUP_GRACE_S", "1.0",
         "AGENT.MAX_RESTARTS", "1",
     ], timeout=120)
     wall = time.time() - tic
@@ -393,6 +399,27 @@ def test_agent_cli_heartbeat_kills_wedged_fleet(tmp_path):
     assert any(r.get("heartbeat_kill") for r in exits)
     (verdict,) = _by_kind(recs, "supervisor_verdict")
     assert verdict["verdict"] == "gave_up"
+
+
+def test_agent_cli_heartbeat_not_armed_during_cold_start(tmp_path):
+    """Regression (PR 9): a heartbeat timeout shorter than the worker's
+    bring-up must NOT kill the fleet before the first journal record — the
+    stall clock arms at the first beat; until then only the (much larger)
+    AGENT.HEARTBEAT_STARTUP_GRACE_S budget applies. Pre-fix, this worker
+    was heartbeat-killed ~1s in and the supervision ended gave_up."""
+    p = _run_agent_cli(tmp_path, [
+        "AGENT.CMD", "sh -c 'sleep 3; exit 0'",  # 3s "cold compile", no journal
+        "AGENT.HEARTBEAT_TIMEOUT_S", "1.0",
+        "AGENT.MAX_RESTARTS", "1",
+    ])
+    assert p.returncode == 0, p.stdout + p.stderr
+    recs = _journal(tmp_path)
+    assert [r["outcome"] for r in _by_kind(recs, "supervisor_exit")] == [
+        resilience.EXIT_CLEAN,
+    ]
+    assert not [r for r in recs if r.get("kind") == "hang"]
+    (verdict,) = _by_kind(recs, "supervisor_verdict")
+    assert verdict["verdict"] == "clean" and verdict["attempts"] == 1
 
 
 # ---------------------------------------------------------------------------

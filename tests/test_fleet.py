@@ -1,0 +1,729 @@
+"""dtpu-fleet orchestration tests (docs/FAULT_TOLERANCE.md "Fleet runs").
+
+Three tiers:
+
+- **unit**: the fleet-scope policy pieces — exit-code round trip, resize
+  merge precedence, job-spec parsing, rendezvous assignment/refusal,
+  deterministic port derivation, the cooperative-stop poller, the
+  armed-after-first-beat journal heartbeat, host-pool cooldowns, the
+  ``fleet_*`` journal schema and the summarize goodput timeline.
+- **CLI**: fleet-managed agent mode (single attempt, outcome exit codes,
+  part-file journal) and the multi-job queue with priority preemption over
+  trivial shell gangs.
+- **chaos** (slow, ``chaos`` marker; CI's fleet-smoke job): gang-scheduled
+  real training fleets (tests/_fleet_worker.py) — the acceptance scenarios:
+  SIGKILL every rank of one simulated host in a 2-host gang → the controller
+  gang-restarts and the resumed step stream is **bitwise identical** to an
+  uninterrupted run; with the healed host quarantined, the gang re-forms at
+  reduced size and the host **rejoins at the next checkpoint boundary**
+  (fleet epoch advances, world size returns to N).
+"""
+
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from distribuuuu_tpu import agent, fleet, resilience
+from distribuuuu_tpu.obs.journal import (
+    read_journal,
+    validate_journal,
+    validate_record,
+)
+from distribuuuu_tpu.obs.summarize import render
+from distribuuuu_tpu.runtime.dist import (
+    derive_rendezvous_port,
+    fleet_request,
+    maybe_fleet_rendezvous,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_fleet_worker.py")
+
+
+# ---------------------------------------------------------------------------
+# Unit tier: taxonomy, parsing, rendezvous, ports, signals, heartbeat
+# ---------------------------------------------------------------------------
+
+def test_outcome_exit_code_roundtrip():
+    """Fleet-managed agents forward merged outcomes across their process
+    boundary as exit codes — the translation must be lossless."""
+    for outcome in (
+        resilience.EXIT_CLEAN,
+        resilience.EXIT_PREEMPTED,
+        resilience.EXIT_RESIZE,
+        resilience.EXIT_HANG,
+        resilience.EXIT_POISON,
+        resilience.EXIT_KILLED,
+        resilience.EXIT_CRASH,
+    ):
+        code = resilience.outcome_exit_code(outcome)
+        assert resilience.classify_exit_code(code) == outcome, (outcome, code)
+    assert resilience.classify_exit_code(resilience.RESIZE_EXIT_CODE) == (
+        resilience.EXIT_RESIZE
+    )
+    assert resilience.classify_exit_code(resilience.KILLED_EXIT_CODE) == (
+        resilience.EXIT_KILLED
+    )
+
+
+def test_merge_outcomes_resize_precedence():
+    m = agent.merge_outcomes
+    # a crash outranks a cooperative resize exit (something went wrong)
+    assert m([1, resilience.RESIZE_EXIT_CODE]) == resilience.EXIT_CRASH
+    # resize outranks plain preemption and clean: the gang must re-form NOW
+    assert m([resilience.RESIZE_EXIT_CODE, 143]) == resilience.EXIT_RESIZE
+    assert m([resilience.RESIZE_EXIT_CODE, 0]) == resilience.EXIT_RESIZE
+
+
+def test_parse_job_spec():
+    j = fleet.parse_job_spec("serve=10:1@dtpu-serve --cfg x.yaml", seq=3)
+    assert (j.name, j.priority, j.hosts) == ("serve", 10.0, 1)
+    assert j.cmd == "dtpu-serve --cfg x.yaml" and j.seq == 3
+    j = fleet.parse_job_spec("train=1")
+    assert (j.name, j.priority, j.hosts, j.cmd) == ("train", 1.0, 0, "")
+    for bad in ("noequals", "x=", "x=notanumber", "=1@cmd"):
+        with pytest.raises(ValueError):
+            fleet.parse_job_spec(bad)
+
+
+def test_rendezvous_assignments_and_refusals():
+    srv = fleet.RendezvousServer()
+    try:
+        # no gang formed yet: register is refused, never guessed
+        r = fleet_request(srv.address, {"op": "register", "host": 0,
+                                        "local_rank": 0, "fleet_epoch": 1})
+        assert not r["ok"] and r["error"] == "no_gang"
+        srv.set_gang(fleet._Gang(2, (0, 2), 2, "127.0.0.1", 29000))
+        # rank = slot-position * nprocs + local_rank (slot order, not slot id)
+        r = fleet_request(srv.address, {"op": "register", "host": 2,
+                                        "local_rank": 1, "fleet_epoch": 2})
+        assert r == {"ok": True, "rank": 3, "world_size": 4,
+                     "master_addr": "127.0.0.1", "master_port": 29000,
+                     "fleet_epoch": 2}
+        # stale fleet epoch: a worker of an already-re-formed gang must die
+        r = fleet_request(srv.address, {"op": "register", "host": 0,
+                                        "local_rank": 0, "fleet_epoch": 1})
+        assert not r["ok"] and r["error"] == "stale_epoch" and r["fleet_epoch"] == 2
+        # a quarantined slot is not in the gang
+        r = fleet_request(srv.address, {"op": "register", "host": 1,
+                                        "local_rank": 0, "fleet_epoch": 2})
+        assert not r["ok"] and r["error"] == "not_in_gang"
+        r = fleet_request(srv.address, {"op": "ping"})
+        assert r["ok"] and r["fleet_epoch"] == 2 and r["world_size"] == 4
+        # garbage on the wire is answered, not crashed on
+        r = fleet_request(srv.address, {"op": "register", "host": "x",
+                                        "local_rank": 0, "fleet_epoch": 2})
+        assert not r["ok"]
+    finally:
+        srv.close()
+
+
+def test_maybe_fleet_rendezvous_exports_env(monkeypatch):
+    srv = fleet.RendezvousServer()
+    srv.set_gang(fleet._Gang(5, (1, 3), 1, "127.0.0.1", 28123))
+    rdzv_keys = ("RANK", "WORLD_SIZE", "MASTER_ADDR", "MASTER_PORT")
+    try:
+        for k in rdzv_keys:
+            monkeypatch.delenv(k, raising=False)
+        monkeypatch.setenv("DTPU_FLEET_CONTROLLER", srv.address)
+        monkeypatch.setenv("DTPU_FLEET_HOST", "3")
+        monkeypatch.setenv("DTPU_FLEET_LOCAL_RANK", "0")
+        monkeypatch.setenv("DTPU_FLEET_EPOCH", "5")
+        assert maybe_fleet_rendezvous() is True
+        assert os.environ["RANK"] == "1" and os.environ["WORLD_SIZE"] == "2"
+        assert os.environ["MASTER_PORT"] == "28123"
+        # idempotent: a second call keeps the resolved assignment
+        assert maybe_fleet_rendezvous() is True
+        # a stale worker raises instead of rendezvousing into the wrong gang
+        os.environ.pop("RANK")
+        os.environ.pop("WORLD_SIZE")
+        monkeypatch.setenv("DTPU_FLEET_EPOCH", "4")
+        with pytest.raises(RuntimeError, match="stale_epoch"):
+            maybe_fleet_rendezvous()
+    finally:
+        srv.close()
+        # the export is done by the CODE UNDER TEST, not monkeypatch — pop it
+        # ourselves or a leaked RANK/WORLD_SIZE makes every later in-process
+        # setup_distributed() attempt a multi-proc jax.distributed.initialize
+        for k in rdzv_keys:
+            os.environ.pop(k, None)
+
+
+def test_maybe_fleet_rendezvous_noop_outside_fleet(monkeypatch):
+    monkeypatch.delenv("DTPU_FLEET_CONTROLLER", raising=False)
+    assert maybe_fleet_rendezvous() is False
+
+
+def test_derive_rendezvous_port_deterministic():
+    p1 = derive_rendezvous_port("jobx:epoch1")
+    assert p1 == derive_rendezvous_port("jobx:epoch1")  # no choice to race on
+    assert 20000 <= p1 < 29500
+    # a different gang epoch lands elsewhere (new gang, fresh port)
+    assert derive_rendezvous_port("jobx:epoch2") != p1  # sha collision ~0
+    # exclusion (serve frontends) pushes to the next derived candidate,
+    # still deterministically
+    p_ex = derive_rendezvous_port("jobx:epoch1", exclude=[p1])
+    assert p_ex != p1
+    assert p_ex == derive_rendezvous_port("jobx:epoch1", exclude=[p1])
+
+
+def test_derive_rendezvous_port_liveness_fallback():
+    p1 = derive_rendezvous_port("joby:epoch1")
+    with socket.socket() as s:  # squat the derived port
+        s.bind(("127.0.0.1", p1))
+        s.listen(1)
+        p2 = derive_rendezvous_port("joby:epoch1")
+        assert p2 != p1
+        assert p2 == derive_rendezvous_port("joby:epoch1")  # still deterministic
+
+
+def _write_marker(signals_dir, marker):
+    with open(os.path.join(signals_dir, resilience.FLEET_MARKER_NAME), "w") as f:
+        json.dump(marker, f)
+
+
+def test_fleet_signal_poller_resize_agreement(tmp_path):
+    d = str(tmp_path)
+    primary = resilience.FleetSignalPoller(d, 1, is_primary=True, margin_steps=3)
+    follower = resilience.FleetSignalPoller(d, 1, is_primary=False, margin_steps=3)
+    assert primary.check(5) is None and follower.check(5) is None
+    # controller announces epoch 2 (> launch epoch 1): resize pending
+    _write_marker(d, {"fleet_epoch": 2, "stop": None})
+    # the follower waits for rank 0's agreed step; rank 0 publishes gstep+margin
+    assert follower.check(6) is None
+    assert primary.check(6) is None  # published stop=9, not reached yet
+    stop_path = os.path.join(d, resilience.FLEET_STOP_STEP_NAME)
+    assert open(stop_path).read().strip() == "9"
+    assert follower.check(8) is None and primary.check(8) is None
+    assert primary.check(9) == "resize" and follower.check(9) == "resize"
+
+
+def test_fleet_signal_poller_preempt_and_marker_reset(tmp_path):
+    d = str(tmp_path)
+    p = resilience.FleetSignalPoller(d, 3, is_primary=True, margin_steps=2)
+    # marker at the gang's own epoch: business as usual
+    _write_marker(d, {"fleet_epoch": 3, "stop": None})
+    assert p.check(10) is None
+    _write_marker(d, {"fleet_epoch": 3, "stop": "preempt"})
+    assert p.check(11) is None  # publishes 13
+    assert p.check(13) == "preempt"
+
+
+def test_fleet_resize_requested_env(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    monkeypatch.setenv("DTPU_FLEET_SIGNALS", d)
+    monkeypatch.setenv("DTPU_FLEET_EPOCH", "2")
+    assert resilience.fleet_resize_requested() is False  # no marker yet
+    _write_marker(d, {"fleet_epoch": 2, "stop": None})
+    assert resilience.fleet_resize_requested() is False  # own epoch
+    _write_marker(d, {"fleet_epoch": 3, "stop": None})
+    assert resilience.fleet_resize_requested() is True
+    # and Preempted picks the resize exit code off it
+    assert resilience.Preempted("x").code == resilience.RESIZE_EXIT_CODE
+    monkeypatch.delenv("DTPU_FLEET_SIGNALS")
+    assert resilience.Preempted("x").code == 143
+
+
+def test_journal_heartbeat_arms_only_after_first_beat():
+    """The satellite-1 regression: a cold compile longer than the stall
+    timeout must NOT be killed before the journal's first record."""
+    now = [0.0]
+    size = [10]
+    hb = agent.JournalHeartbeat(
+        "x", 2.0, 60.0, clock=lambda: now[0], size_fn=lambda p: size[0]
+    )
+    # no beat yet: the 2s stall timeout must NOT fire, only startup grace
+    for t in (1.0, 5.0, 30.0, 59.0):
+        now[0] = t
+        assert hb.poll() is None, t
+    now[0] = 61.0
+    assert hb.poll() == ("startup", 61.0)  # grace exceeded, never a beat
+    # first beat: stall clock arms, but the first interval still spans the
+    # cold compile -> budgeted max(timeout, grace)
+    hb = agent.JournalHeartbeat(
+        "x", 2.0, 60.0, clock=lambda: now[0], size_fn=lambda p: size[0]
+    )
+    now[0] = 1.0
+    size[0] = 20  # run_start landed
+    assert hb.poll() is None
+    now[0] = 50.0  # 49s of compile after the first record: within grace
+    assert hb.poll() is None
+    now[0] = 62.0
+    size[0] = 30  # first window landed: steady state from here
+    assert hb.poll() is None
+    now[0] = 63.5
+    assert hb.poll() is None  # 1.5s < 2s
+    now[0] = 64.5
+    fired = hb.poll()
+    assert fired is not None and fired[0] == "stalled"
+    # grace 0 disables the pre-beat kill entirely
+    hb = agent.JournalHeartbeat(
+        "x", 2.0, 0.0, clock=lambda: now[0], size_fn=lambda p: size[0]
+    )
+    now[0] = 10_000.0
+    assert hb.poll() is None
+
+
+def test_host_pool_cooldown():
+    pool = fleet.HostPool(3, cooldown_s=30.0)
+    assert pool.available() == [0, 1, 2]
+    pool.mark_dead(1)
+    assert pool.available() == [0, 2]
+    assert pool.healed([0]) == [2]
+    assert pool.next_heal_s() > 0
+    pool._until[1] = 0.0  # heal by hand (monotonic clocks don't rewind)
+    assert pool.available() == [0, 1, 2]
+    assert pool.next_heal_s() == 0.0
+
+
+def test_fleet_journal_schema_and_partfile(tmp_path, fresh_cfg):
+    """Every fleet_* kind validates; the controller's part-only journal
+    reads back even though no worker ever created the main file."""
+    fresh_cfg.OUT_DIR = str(tmp_path)
+    j = fleet.FleetJournal(str(tmp_path))
+    assert j.path and j.path.endswith(".part3000")
+    j.event("fleet_start", hosts=2, nprocs_per_host=1, jobs=1, rdzv="h:1")
+    j.event("fleet_launch", job="train", fleet_epoch=1, attempt=1,
+            hosts=[0, 1], world_size=2, port=20123, rollback=0)
+    j.event("fleet_host_exit", job="train", fleet_epoch=1, host=1,
+            outcome="killed", code=137, wall_s=1.0)
+    j.event("fleet_failure", job="train", fleet_epoch=1, outcome="killed",
+            dead_hosts=[1], codes=[137, -9])
+    j.event("fleet_recovery", job="train", fleet_epoch=1, outcome="killed",
+            action="restart", backoff_s=0.5, restarts_in_window=1)
+    j.event("fleet_resize", job="train", from_epoch=2, to_epoch=3,
+            from_hosts=1, to_hosts=2, reason="rejoin")
+    j.event("fleet_preempt", job="train", by="serve", priority=1.0,
+            by_priority=10.0, drain_s=5.0)
+    j.event("fleet_verdict", job="train", verdict="clean", attempts=3,
+            gang_restarts=1, resizes=1, rollbacks=0, reason="done", wall_s=9.0)
+    # a record missing required fields is dropped, not written
+    j.event("fleet_launch", job="train", fleet_epoch=1)
+    j.close()
+    main = os.path.join(str(tmp_path), "telemetry.jsonl")
+    assert not os.path.exists(main)  # controller never touches the main file
+    assert validate_journal(main) == []
+    kinds = [r["kind"] for r in read_journal(main)]
+    assert len(kinds) == 8 and kinds[0] == "fleet_start" and "fleet_resize" in kinds
+
+
+def test_supervisor_records_accept_host_field():
+    rec = {"ts": 1.0, "kind": "supervisor_exit", "attempt": 1,
+           "outcome": "killed", "codes": [137], "host": 1}
+    assert validate_record(rec) == []
+
+
+def test_summarize_fleet_section_and_goodput_timeline():
+    t0 = 1000.0
+    records = [
+        {"ts": t0, "kind": "fleet_start", "hosts": 2, "nprocs_per_host": 1,
+         "jobs": 1, "rdzv": "127.0.0.1:1"},
+        {"ts": t0, "kind": "fleet_launch", "job": "train", "fleet_epoch": 1,
+         "attempt": 1, "hosts": [0, 1], "world_size": 2, "port": 21000},
+        {"ts": t0 + 40, "kind": "window", "epoch": 0, "step": 0, "gstep": 0,
+         "steps": 1, "skipped": 0, "lr": 0.1, "step_time": 0.1,
+         "data_time": 0.0, "imgs_per_sec": 10.0, "goodput": 0.9,
+         "warmup": True},
+        {"ts": t0 + 60, "kind": "fleet_host_exit", "job": "train",
+         "fleet_epoch": 1, "host": 1, "outcome": "killed", "code": 137},
+        {"ts": t0 + 70, "kind": "fleet_failure", "job": "train",
+         "fleet_epoch": 1, "outcome": "killed", "dead_hosts": [1]},
+        {"ts": t0 + 80, "kind": "fleet_launch", "job": "train",
+         "fleet_epoch": 2, "attempt": 2, "hosts": [0], "world_size": 1,
+         "port": 21001},
+        {"ts": t0 + 90, "kind": "window", "epoch": 1, "step": 0, "gstep": 16,
+         "steps": 1, "skipped": 0, "lr": 0.1, "step_time": 0.1,
+         "data_time": 0.0, "imgs_per_sec": 10.0, "goodput": 0.9,
+         "warmup": False},
+        {"ts": t0 + 95, "kind": "fleet_resize", "job": "train",
+         "from_epoch": 2, "to_epoch": 3, "from_hosts": 1, "to_hosts": 2,
+         "reason": "rejoin"},
+        {"ts": t0 + 100, "kind": "fleet_host_exit", "job": "train",
+         "fleet_epoch": 2, "host": 0, "outcome": "resize", "code": 118},
+        {"ts": t0 + 120, "kind": "fleet_verdict", "job": "train",
+         "verdict": "clean", "attempts": 2, "gang_restarts": 1, "resizes": 1},
+    ]
+    for r in records:
+        assert validate_record(r) == [], r
+    report = render(records)
+    assert "fleet: pool of 2 host slot(s)" in report
+    assert "gang epoch 2: hosts [0] world 1" in report
+    assert "resize 1 -> 2 host(s) (epoch 2 -> 3, rejoin)" in report
+    assert "FAILURE at epoch 1: killed, host(s) [1] dead" in report
+    assert "verdict[train]: CLEAN" in report
+    assert "goodput timeline:" in report
+    # attempt 1: first window landed 40s after launch (the cold startup)
+    assert "first step +40.0s" in report
+    # attempt 2: 10s warm startup, quantified against cold
+    assert "(0.25x of cold)" in report
+    assert "restart downtime" in report
+
+
+def test_read_journal_requires_some_part(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        list(read_journal(str(tmp_path / "telemetry.jsonl")))
+
+
+def test_read_journal_nested_part_suffixes(tmp_path):
+    """A supervisory part's own remote-commit continuations
+    (``.part2001.part1``) must read back, in write order, right after their
+    base part — an unparseable nested suffix would silently drop every
+    record after a fleet host agent's first remote commit."""
+    base = str(tmp_path / "telemetry.jsonl")
+
+    def rec(n):
+        return (
+            f'{{"ts": {n}.0, "kind": "hang", "timeout_s": 1, '
+            f'"stalled_s": 1, "phase": "p{n}"}}\n'
+        )
+
+    with open(base, "w") as f:
+        f.write(rec(0))
+    with open(f"{base}.part2001", "w") as f:
+        f.write(rec(1))
+    with open(f"{base}.part2001.part1", "w") as f:
+        f.write(rec(2))
+    with open(f"{base}.part3000", "w") as f:
+        f.write(rec(3))
+    phases = [r["phase"] for r in read_journal(base)]
+    assert phases == ["p0", "p1", "p2", "p3"], phases
+    assert validate_journal(base) == []
+    # nested continuations of supervisory parts are NOT worker heartbeats
+    assert agent._journal_bytes(base, workers_only=True) == os.path.getsize(base)
+
+
+def test_fleet_queue_withdrawal_of_pending_submission(tmp_path, fresh_cfg):
+    """Deleting a still-pending queue file withdraws the job; a job that
+    already ran (fleet_epoch > 0) stays queued — the submission is spent."""
+    fresh_cfg.OUT_DIR = str(tmp_path)
+    fresh_cfg.FLEET.QUEUE = ["base=1"]
+    q = fleet.FleetQueue([])
+    try:
+        os.makedirs(q.queue_dir, exist_ok=True)
+        sub = os.path.join(q.queue_dir, "spike.json")
+        with open(sub, "w") as f:
+            json.dump({"name": "spike", "priority": 9, "cmd": "sh -c 'exit 0'"}, f)
+        q._scan_queue_dir()
+        assert [j.name for j in q.jobs] == ["base", "spike"]
+        os.remove(sub)
+        q._prune_withdrawn()
+        assert [j.name for j in q.jobs] == ["base"]
+        # a preempted/ran job survives its file's deletion
+        with open(sub.replace("spike", "spike2"), "w") as f:
+            json.dump({"name": "spike2", "priority": 9, "cmd": "x"}, f)
+        q._scan_queue_dir()
+        q.jobs[-1].fleet_epoch = 2  # "has run"
+        os.remove(sub.replace("spike", "spike2"))
+        q._prune_withdrawn()
+        assert [j.name for j in q.jobs] == ["base", "spike2"]
+        # a submission that TRIGGERED a preemption is spent (source cleared
+        # by the queue loop) even though it never launched: deleting its
+        # file after the drain started must not withdraw it
+        with open(sub.replace("spike", "spike3"), "w") as f:
+            json.dump({"name": "spike3", "priority": 9, "cmd": "x"}, f)
+        q._scan_queue_dir()
+        q.jobs[-1].source = ""  # what the preemption trigger does
+        os.remove(sub.replace("spike", "spike3"))
+        q._prune_withdrawn()
+        assert "spike3" in [j.name for j in q.jobs]
+    finally:
+        q.rdzv.close()
+        q.journal.close()
+
+
+def test_fleet_rendezvous_outranks_slurm_env(tmp_path):
+    """A fleet launched inside a Slurm allocation inherits SLURM_JOB_ID /
+    SLURM_PROCID into every worker; the controller's rendezvous answer must
+    still win in setup_distributed, or every rank would take the same
+    inherited SLURM_PROCID. Subprocess with a timeout: the regression mode
+    is a world-of-SLURM_NTASKS initialize that blocks."""
+    srv = fleet.RendezvousServer()
+    srv.set_gang(fleet._Gang(1, (0,), 1, "127.0.0.1", 28999))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update(
+        DTPU_FLEET_CONTROLLER=srv.address,
+        DTPU_FLEET_HOST="0",
+        DTPU_FLEET_LOCAL_RANK="0",
+        DTPU_FLEET_EPOCH="1",
+        SLURM_JOB_ID="1234",
+        SLURM_PROCID="0",
+        SLURM_NTASKS="2",
+        SLURM_NODELIST="localhost",
+    )
+    for k in ("RANK", "WORLD_SIZE", "MASTER_ADDR", "MASTER_PORT"):
+        env.pop(k, None)
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+             "from distribuuuu_tpu.runtime.dist import setup_distributed\n"
+             "info = setup_distributed()\n"
+             "print('DIST', info.process_index, info.process_count)"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+        )
+    finally:
+        srv.close()
+    assert p.returncode == 0, p.stdout + p.stderr
+    # the 1-host gang's assignment (world 1), NOT Slurm's NTASKS=2
+    assert "DIST 0 1" in p.stdout, p.stdout + p.stderr
+
+
+# ---------------------------------------------------------------------------
+# CLI tier: fleet-managed agent mode + the priority queue over shell gangs
+# ---------------------------------------------------------------------------
+
+def _fleet_env(extra=None):
+    env = dict(os.environ)
+    for k in ("DTPU_FLEET_CONTROLLER", "DTPU_FLEET_HOST", "DTPU_FLEET_EPOCH",
+              "DTPU_FLEET_SIGNALS", "DTPU_FAULT_KILL_STEP",
+              "DTPU_TEST_KILL_HOST", "DTPU_TEST_HANG_TIMEOUT_S",
+              "XLA_FLAGS"):
+        env.pop(k, None)
+    env.update(extra or {})
+    return env
+
+
+def _run_fleet_host_agent(out_dir, cmd, host=1, timeout=120):
+    """Run the agent in fleet-managed mode over a trivial shell worker (the
+    rendezvous service is never contacted — shell workers don't register)."""
+    p = subprocess.run(
+        [sys.executable, "-m", "distribuuuu_tpu.agent",
+         "OUT_DIR", str(out_dir),
+         "AGENT.PREFLIGHT_DEVICE_PROBE", "False",
+         "AGENT.MIN_FREE_DISK_GB", "0",
+         "AGENT.CMD", cmd],
+        cwd=REPO,
+        env=_fleet_env({"DTPU_FLEET_CONTROLLER": "127.0.0.1:1",
+                        "DTPU_FLEET_HOST": str(host)}),
+        capture_output=True, text=True, timeout=timeout,
+    )
+    return p
+
+
+def test_agent_fleet_host_mode_single_attempt_outcome_codes(tmp_path):
+    # clean worker -> 0; the journal rides the host's own part file with a
+    # host field on every record
+    p = _run_fleet_host_agent(tmp_path / "a", "sh -c 'exit 0'")
+    assert p.returncode == 0, p.stdout + p.stderr
+    part = os.path.join(str(tmp_path / "a"), "telemetry.jsonl.part2001")
+    assert os.path.exists(part)
+    recs = list(read_journal(os.path.join(str(tmp_path / "a"), "telemetry.jsonl")))
+    assert validate_journal(os.path.join(str(tmp_path / "a"), "telemetry.jsonl")) == []
+    kinds = [r["kind"] for r in recs]
+    assert kinds.count("supervisor_launch") == 1  # ONE attempt, no retries
+    assert all(r.get("host") == 1 for r in recs
+               if r["kind"].startswith("supervisor"))
+    (v,) = [r for r in recs if r["kind"] == "supervisor_verdict"]
+    assert v["verdict"] == "clean" and v["attempts"] == 1
+
+    # crash -> exit 1, still exactly one attempt (recovery is fleet-scope)
+    p = _run_fleet_host_agent(tmp_path / "b", "sh -c 'exit 7'")
+    assert p.returncode == 1, p.stdout + p.stderr
+    recs = list(read_journal(os.path.join(str(tmp_path / "b"), "telemetry.jsonl")))
+    assert [r["kind"] for r in recs].count("supervisor_launch") == 1
+
+    # cooperative resize exit is forwarded verbatim
+    p = _run_fleet_host_agent(
+        tmp_path / "c", f"sh -c 'exit {resilience.RESIZE_EXIT_CODE}'"
+    )
+    assert p.returncode == resilience.RESIZE_EXIT_CODE, p.stdout + p.stderr
+
+
+def test_fleet_queue_priority_preemption_and_resume(tmp_path):
+    """A high-priority job dropped into the queue dir preempts the running
+    low-priority gang (bounded drain), runs to completion, and the preempted
+    job relaunches — all journaled as typed fleet_* records."""
+    out = str(tmp_path / "pool")
+    flag = tmp_path / "resumed_flag"
+    queue_dir = os.path.join(out, "fleet", "queue")
+    os.makedirs(queue_dir)
+    bg_cmd = f"sh -c 'test -f {flag} && exit 0; touch {flag}; sleep 300'"
+    cmd = [
+        sys.executable, "-m", "distribuuuu_tpu.fleet",
+        "OUT_DIR", out,
+        "FLEET.HOSTS", "1",
+        "FLEET.QUEUE", f'["bg=1@{bg_cmd}"]',
+        "FLEET.DRAIN_S", "0.5",
+        "FLEET.HOST_COOLDOWN_S", "0",
+        "FLEET.BACKOFF_BASE_S", "0.05", "FLEET.BACKOFF_MAX_S", "0.2",
+        "AGENT.PREFLIGHT_DEVICE_PROBE", "False",
+        "AGENT.MIN_FREE_DISK_GB", "0",
+        "AGENT.EXIT_BARRIER_S", "2",
+    ]
+    proc = subprocess.Popen(cmd, cwd=REPO, env=_fleet_env(),
+                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                            text=True)
+    try:
+        deadline = time.time() + 90
+        while time.time() < deadline and not flag.exists():
+            time.sleep(0.2)  # wait for the bg job's worker to be running
+        assert flag.exists(), "bg job never started"
+        with open(os.path.join(queue_dir, "urgent.json"), "w") as f:
+            json.dump({"name": "urgent", "priority": 10, "hosts": 1,
+                       "cmd": "sh -c 'exit 0'"}, f)
+        out_text, _ = proc.communicate(timeout=240)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 0, out_text[-4000:]
+    recs = list(read_journal(os.path.join(out, "telemetry.jsonl")))
+    assert validate_journal(os.path.join(out, "telemetry.jsonl")) == []
+    (pre,) = [r for r in recs if r["kind"] == "fleet_preempt"]
+    assert pre["job"] == "bg" and pre["by"] == "urgent"
+    assert pre["priority"] == 1.0 and pre["by_priority"] == 10.0
+    verdicts = [(r["job"], r["verdict"]) for r in recs
+                if r["kind"] == "fleet_verdict"]
+    # bg preempted, urgent clean, bg relaunched (flag file) and clean
+    assert verdicts == [("bg", "preempted"), ("urgent", "clean"),
+                        ("bg", "clean")], verdicts
+    launches = [(r["job"], r["fleet_epoch"]) for r in recs
+                if r["kind"] == "fleet_launch"]
+    assert launches[0][0] == "bg" and launches[-1][0] == "bg"
+    assert launches[-1][1] > launches[0][1]  # epoch advanced across resume
+
+
+# ---------------------------------------------------------------------------
+# Chaos tier: gang-scheduled real training fleets (acceptance scenarios)
+# ---------------------------------------------------------------------------
+
+def _run_fleet(out_dir, max_epoch, env_extra=None, overrides=(), timeout=560):
+    cmd = [
+        sys.executable, "-m", "distribuuuu_tpu.fleet",
+        "OUT_DIR", str(out_dir),
+        "FLEET.HOSTS", "2",
+        "FLEET.NPROCS_PER_HOST", "1",
+        "FLEET.DRAIN_S", "12",
+        "FLEET.BACKOFF_BASE_S", "0.05", "FLEET.BACKOFF_MAX_S", "0.2",
+        "AGENT.CMD", f"{sys.executable} {WORKER} {out_dir} {max_epoch}",
+        "AGENT.CPU_DEVICES_PER_WORKER", "1",
+        "AGENT.PREFLIGHT_DEVICE_PROBE", "False",
+        "AGENT.MIN_FREE_DISK_GB", "0",
+        "AGENT.EXIT_BARRIER_S", "45",
+        *[str(x) for x in overrides],
+    ]
+    return subprocess.run(cmd, cwd=REPO, env=_fleet_env(env_extra),
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def _digests(stdout):
+    return set(re.findall(r"FLEET DIGEST (\w+)", stdout))
+
+
+def _journal(out_dir):
+    return list(read_journal(os.path.join(str(out_dir), "telemetry.jsonl")))
+
+
+def _by_kind(records, kind):
+    return [r for r in records if r.get("kind") == kind]
+
+
+def _final_window_losses(out_dir):
+    out = {}
+    for r in _journal(out_dir):
+        if r.get("kind") == "window" and r.get("loss") is not None:
+            out[r["gstep"]] = r["loss"]
+    return out
+
+
+@pytest.fixture(scope="module")
+def fleet_reference(tmp_path_factory):
+    """Uninterrupted 2-host gang: the bitwise oracle for kill recovery."""
+    out = tmp_path_factory.mktemp("fleet_ref") / "out"
+    p = _run_fleet(out, max_epoch=2, overrides=["FLEET.HOST_COOLDOWN_S", "0"])
+    assert p.returncode == 0, p.stdout[-4000:] + p.stderr[-2000:]
+    digests = _digests(p.stdout)
+    assert len(digests) == 1, f"hosts disagree on final params: {digests}"
+    losses = _final_window_losses(out)
+    assert sorted(losses) == list(range(32)), sorted(losses)
+    return {"digest": digests, "losses": losses}
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fleet_kill_host_gang_restart_is_bitwise(fleet_reference, tmp_path):
+    """SIGKILL every rank of host 1 at gstep 20: the controller declares a
+    fleet-level failure, drains the wedged survivor, and (slot healed —
+    cooldown 0) gang-restarts at FULL size into elastic resume. The resumed
+    step stream and final params are bitwise identical to the uninterrupted
+    reference."""
+    out = tmp_path / "out"
+    p = _run_fleet(out, max_epoch=2, env_extra={
+        "DTPU_FAULT_KILL_STEP": "20",   # epoch 1, step 4: ep-0 ckpt durable
+        "DTPU_TEST_KILL_HOST": "1",     # every rank of host 1 only
+        "DTPU_TEST_HANG_TIMEOUT_S": "10",
+    }, overrides=["FLEET.HOST_COOLDOWN_S", "0"])
+    assert p.returncode == 0, p.stdout[-4000:] + p.stderr[-2000:]
+    recs = _journal(out)
+    assert validate_journal(os.path.join(str(out), "telemetry.jsonl")) == []
+    # host 1's death is attributed: a fleet_failure with host 1 dead
+    fails = _by_kind(recs, "fleet_failure")
+    assert fails and fails[0]["dead_hosts"] == [1], fails
+    assert fails[0]["outcome"] in (resilience.EXIT_KILLED, resilience.EXIT_HANG)
+    # the gang re-formed at FULL size (the host healed immediately) under a
+    # bumped fleet epoch
+    launches = _by_kind(recs, "fleet_launch")
+    assert [r["world_size"] for r in launches] == [2, 2]
+    assert launches[1]["fleet_epoch"] > launches[0]["fleet_epoch"]
+    (verdict,) = _by_kind(recs, "fleet_verdict")
+    assert verdict["verdict"] == "clean" and verdict["gang_restarts"] == 1
+    # bitwise: same final params, same per-step loss stream as the reference
+    assert _digests(p.stdout) == fleet_reference["digest"]
+    assert _final_window_losses(out) == fleet_reference["losses"]
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fleet_reduced_gang_then_checkpoint_boundary_rejoin(tmp_path):
+    """Kill host 1 with a long cooldown: the gang re-forms at REDUCED size
+    (world 1) and trains on; once the slot heals AND the reduced gang has
+    committed a new checkpoint, the controller announces the resize, the
+    survivor checkpoint-and-exits cooperatively (118), and the gang
+    relaunches at full size — world size returns to N, the fleet epoch
+    advances, and the union step stream is complete (every step ran)."""
+    out = tmp_path / "out"
+    p = _run_fleet(out, max_epoch=6, env_extra={
+        "DTPU_FAULT_KILL_STEP": "20",
+        "DTPU_TEST_KILL_HOST": "1",
+        # generous: a slow orbax multi-proc save barrier must not read as a
+        # hang (the chaos box is 1 contended core)
+        "DTPU_TEST_HANG_TIMEOUT_S": "20",
+    }, overrides=["FLEET.HOST_COOLDOWN_S", "25"])
+    assert p.returncode == 0, p.stdout[-4000:] + p.stderr[-2000:]
+    recs = _journal(out)
+    assert validate_journal(os.path.join(str(out), "telemetry.jsonl")) == []
+    launches = _by_kind(recs, "fleet_launch")
+    worlds = [r["world_size"] for r in launches]
+    # essential shape (an incidental extra bounded recovery on a contended
+    # box is tolerated — the guarantee is bounded recovery, not zero
+    # hiccups): full gang first, a REDUCED gang ran, and the world size
+    # returned to N by the end
+    assert worlds[0] == 2 and 1 in worlds and worlds[-1] == 2, worlds
+    assert worlds.index(1) == 1, worlds  # the post-kill gang was the reduced one
+    epochs = [r["fleet_epoch"] for r in launches]
+    assert epochs == sorted(set(epochs)), epochs  # strictly advancing
+    resize = _by_kind(recs, "fleet_resize")[0]
+    assert resize["reason"] == "rejoin"
+    assert (resize["from_hosts"], resize["to_hosts"]) == (1, 2)
+    # the survivor stopped COOPERATIVELY at the announced boundary
+    resize_exits = [r for r in _by_kind(recs, "fleet_host_exit")
+                    if r["outcome"] == resilience.EXIT_RESIZE]
+    assert resize_exits and resize_exits[0]["code"] == resilience.RESIZE_EXIT_CODE
+    # an emergency checkpoint backs the resize (checkpoint-boundary rejoin)
+    assert any(r.get("ckpt_kind") == "emergency"
+               for r in _by_kind(recs, "checkpoint"))
+    (verdict,) = _by_kind(recs, "fleet_verdict")
+    assert verdict["verdict"] == "clean" and verdict["resizes"] == 1
+    # completeness: every one of the 6x16 steps ran exactly once in the
+    # final stream (elastic resume replays across 2 -> 1 -> 2 hosts)
+    assert sorted(_final_window_losses(out)) == list(range(96))
+    # and the report renders the whole story
+    report = render(recs)
+    assert "resize 1 -> 2 host(s)" in report and "goodput timeline:" in report
