@@ -24,6 +24,15 @@ precision half is only visible statically). Three shapes:
   guarantees f32 end to end (`metrics.cross_entropy_loss` computes in f32;
   grads ride f32 params). A literal downcast there silently halves the
   optimizer's signal.
+* **``lax.dot_general`` without ``preferred_element_type``**: the raw MXU
+  entry point — including inside Pallas kernel bodies, where ref loads make
+  operand dtypes statically unknowable and the downstream upcast pattern
+  above can't see the problem. These call sites must always state their
+  accumulator (f32 for float inputs, int32 for int8); the exact
+  accumulation-dtype bug class fixed in `ops/attention.py`. Operands that
+  are all explicit f32 casts are exempt (f32 in = f32 accumulate), and so
+  is ``pl.dot`` — it rejects the kwarg and already hardcodes f32
+  accumulation internally.
 """
 
 from __future__ import annotations
@@ -56,6 +65,18 @@ _F32_DOTTED = {
 }
 _REDUCTIONS = {"sum", "mean", "prod", "cumsum", "psum", "pmean", "psum_scatter"}
 _CONTRACTIONS = {"einsum", "dot", "matmul", "tensordot", "dot_general"}
+# the raw MXU entry points that must ALWAYS state their accumulator (the
+# upcast-after check above only fires when an astype(f32) follows; these are
+# flagged on sight — kernel bodies included, where ref-loaded operand dtypes
+# are unknowable). jnp.dot is deliberately absent (jnp.matmul-family, covered
+# by the upcast-flow check), and so is pl.dot: it REJECTS the
+# preferred_element_type kwarg and already hardcodes f32 accumulation in the
+# dot_general it emits — flagging it would demand an impossible fix
+# (exemption pinned in tests/test_analysis_ipa.py).
+_DOT_CALLS = {
+    "lax.dot_general",
+    "jax.lax.dot_general",
+}
 _LOSS_GRAD_RE = re.compile(r"(^|_)(loss|grad|grads|gradients?)($|_|\d)", re.IGNORECASE)
 
 
@@ -249,6 +270,41 @@ def _check_bf16_reductions(scope: _Scope) -> list[RawFinding]:
     return findings
 
 
+def _check_dot_general_preferred(scope: _Scope) -> list[RawFinding]:
+    findings: list[RawFinding] = []
+    for node in scope.nodes:
+        # cheap pre-filter before the dotted() walk: every flagged form is
+        # an attribute call named dot_general (wall-time budget test)
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "dot_general"
+        ):
+            continue
+        d = dotted(node.func) or ""
+        if d not in _DOT_CALLS or _has_preferred(node):
+            continue
+        ops = _operands(node)[:2]  # lhs, rhs (dimension_numbers follows)
+        if ops and all(
+            scope.cast_kind_at(a, pos_key(node)) == "f32" for a in ops
+        ):
+            continue  # explicit f32 operands: accumulation is f32 already
+        findings.append(
+            RawFinding(
+                node.lineno,
+                node.col_offset,
+                CODE,
+                f"`{d}` without preferred_element_type accumulates in its "
+                "input dtype — on the MXU that silently rounds bf16 "
+                "contractions (and truncates int8) before anything "
+                "downstream sees them. State the accumulator explicitly: "
+                "preferred_element_type=jnp.float32 for float inputs, "
+                "jnp.int32 for int8",
+            )
+        )
+    return findings
+
+
 def _check_loss_grad_downcast(scope: _Scope) -> list[RawFinding]:
     findings: list[RawFinding] = []
     for node in scope.nodes:
@@ -292,10 +348,12 @@ def check(tree: ast.AST, model: ModuleModel, ctx) -> list[RawFinding]:
         findings.extend(_check_contractions_upcast(scope))
         findings.extend(_check_bf16_reductions(scope))
         findings.extend(_check_loss_grad_downcast(scope))
+        findings.extend(_check_dot_general_preferred(scope))
     # module top level, excluding function bodies (their names must not
     # leak into module-level dataflow)
     scope = _Scope(list(_walk_scope(tree)))
     findings.extend(_check_contractions_upcast(scope))
     findings.extend(_check_bf16_reductions(scope))
     findings.extend(_check_loss_grad_downcast(scope))
+    findings.extend(_check_dot_general_preferred(scope))
     return findings

@@ -341,6 +341,33 @@ _C.SERVE.VERIFY_INTEGRITY = True
 _C.SERVE.SLO_WINDOW_S = 10.0
 _C.SERVE.JOURNAL_REQUESTS = True
 
+# Post-training int8 quantization (dtpu-quant; docs/PERFORMANCE.md,
+# docs/SERVING.md "Serving int8"). A hosted model opts in per entry:
+# SERVE.MODELS "name=arch@weights:int8" quantizes that model's conv/dense
+# weights per-channel symmetric int8 (BatchNorm folded where possible),
+# calibrates per-tensor activation scales over CALIB_BATCHES synthetic
+# batches, and AOT-compiles the int8×int8→int32 forward at the same
+# SERVE.BATCH_SIZES ladder — the MXU's int8 rate is 2x bf16.
+_C.QUANT = CN()
+# Calibration pass: batches run through the fp model to record activation
+# amax per layer. Synthetic inputs in the serve wire dtype (seeded, so the
+# quantized model is reproducible); point a real-traffic replay at the
+# engine's calibrate hook for production-distribution scales.
+_C.QUANT.CALIB_BATCHES = 4
+_C.QUANT.CALIB_BATCH_SIZE = 8
+_C.QUANT.CALIB_SEED = 1234
+# Quality gate (quant/gate.py): compare the int8 path against the fp32
+# engine on GATE_N deterministic fixture inputs (convert.golden_inputs —
+# the same input family the checked-in tests/fixtures goldens pin). Either
+# threshold failing REFUSES to serve the model and the measurement is
+# journaled as a typed `quant_quality` record either way. GATE False skips
+# the refusal (the record is still written) — escape hatch, not a default.
+_C.QUANT.GATE = True
+_C.QUANT.GATE_N = 16
+_C.QUANT.GATE_SEED = 0
+_C.QUANT.MIN_TOP1_AGREE = 0.99
+_C.QUANT.MAX_LOGIT_RMSE = 0.25
+
 # Fleet orchestration (TPU addition; docs/FAULT_TOLERANCE.md "Fleet runs").
 # `dtpu-fleet --cfg ...` promotes supervision from host scope (dtpu-agent)
 # to cluster scope: gang-scheduled multi-host launches through a lightweight

@@ -299,6 +299,33 @@ SCHEMA: dict[str, tuple[dict[str, tuple], dict[str, tuple]]] = {
         {"model": _STR, "depth": _INT, "max_depth": _INT},
         {"n": _INT},
     ),
+    # one (model, batch-size) AOT ladder compile at engine load: wall_s is
+    # the lower+compile time (a persistent-cache hit shows up as a near-zero
+    # wall — the warm-vs-cold serving startup number)
+    "serve_compile": (
+        {"model": _STR, "batch_size": _INT, "wall_s": _NUM},
+        {"quant": _STR},
+    ),
+    # the int8 quality gate's measurement vs the fp32 engine on fixture
+    # inputs (quant/gate.py): passed False means the model REFUSED to serve
+    "quant_quality": (
+        {
+            "model": _STR,
+            "mode": _STR,
+            "top1_agree": _NUM,
+            "logit_rmse": _NUM,
+            "passed": _BOOL,
+        },
+        {
+            "n": _INT,
+            "min_top1_agree": _NUM,
+            "max_logit_rmse": _NUM,
+            "calib_batches": _INT,
+            "layers": _INT,
+            "folded_bn": _INT,
+            "wall_s": _NUM,
+        },
+    ),
     # counters / memory / profiler ---------------------------------------
     "counters": (
         {"scope": _STR, "counters": _DICT, "durations": _DICT, "waits": _DICT},

@@ -319,7 +319,13 @@ def render(records: Iterable[dict]) -> str:
     # -- serving (dtpu-serve) -----------------------------------------------
     # only present for serving runs; omitted otherwise so training reports
     # (and the golden test) are unchanged
-    if by_kind["serve_start"] or by_kind["serve_slo"] or by_kind["serve_shed"]:
+    if (
+        by_kind["serve_start"]
+        or by_kind["serve_slo"]
+        or by_kind["serve_shed"]
+        or by_kind["serve_compile"]
+        or by_kind["quant_quality"]
+    ):
         out("")
         if by_kind["serve_start"]:
             s = by_kind["serve_start"][-1]
@@ -332,6 +338,31 @@ def render(records: Iterable[dict]) -> str:
             )
         else:
             out("serving:")
+        # per-(model, batch-size) AOT compile wall — the warm-vs-cold serving
+        # startup number (a persistent-cache hit is a near-zero entry)
+        compile_by_model: dict[str, list[dict]] = defaultdict(list)
+        for r in by_kind["serve_compile"]:
+            compile_by_model[r["model"]].append(r)
+        for model in sorted(compile_by_model):
+            recs = sorted(compile_by_model[model], key=lambda r: r["batch_size"])
+            total = sum(r["wall_s"] for r in recs)
+            per = ", ".join(f"b{r['batch_size']} {r['wall_s']:.2f}s" for r in recs)
+            quant = next((r["quant"] for r in recs if r.get("quant")), "")
+            out(
+                f"  compile[{model}]{f' ({quant})' if quant else ''}: "
+                f"{per} = {total:.2f}s"
+            )
+        # int8 quality gate verdicts (quant_quality; passed False = the
+        # model refused to serve)
+        for r in by_kind["quant_quality"]:
+            out(
+                f"  quant[{r.get('model', '?')}]: {r.get('mode', '?')} "
+                f"top-1 agree {100.0 * r.get('top1_agree', 0.0):.2f}%, "
+                f"logit rmse {r.get('logit_rmse', 0.0):.4f} "
+                f"({r.get('layers', '?')} layer(s), "
+                f"{r.get('folded_bn', 0)} BN folded) -> "
+                f"{'PASSED' if r.get('passed') else 'FAILED (refused to serve)'}"
+            )
         # per-model SLO: aggregate every window so the report covers the
         # whole run, not just the last rollup
         slo_by_model: dict[str, list[dict]] = defaultdict(list)

@@ -5,5 +5,15 @@ from distribuuuu_tpu.ops.attention import (
     fused_attention_abs,
     xla_attention,
 )
+from distribuuuu_tpu.ops.moe_kernel import (
+    fused_moe_combine,
+    fused_moe_dispatch,
+)
 
-__all__ = ["fused_attention", "fused_attention_abs", "xla_attention"]
+__all__ = [
+    "fused_attention",
+    "fused_attention_abs",
+    "xla_attention",
+    "fused_moe_combine",
+    "fused_moe_dispatch",
+]

@@ -29,7 +29,37 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
+
+from distribuuuu_tpu.ops.vmem_guard import VmemBudgetGuard
+
+# VMEM-budget guard: the kernels keep a whole (batch·head) tile resident, so
+# per-tile footprint grows O(L²) — past ~16 MB/core the Mosaic compile fails
+# with an opaque allocation error deep in the serve/train stack. Estimate the
+# footprint up front and fall back to the XLA path with ONE warning per shape
+# instead (the fallback is exactly the code XLA already wins with at small L).
+_VMEM_GUARD = VmemBudgetGuard("DTPU_ATTN_VMEM_BUDGET_MB")
+
+
+def _tile_vmem_bytes(l: int, d: int, dv: int, itemsize: int, bias_input: bool) -> int:
+    """Per-tile VMEM estimate: in/out blocks double-buffered by the grid
+    pipeline, plus the f32 [L, L] logits/exp intermediates the softmax holds."""
+    inputs = 2 * l * d * itemsize + l * dv * itemsize  # q, k, v tiles
+    inputs += l * l * 4 if bias_input else l * d * itemsize  # bias | emb table
+    output = l * dv * itemsize
+    intermediates = 2 * l * l * 4  # logits + exp, f32
+    return 2 * (inputs + output) + intermediates
+
+
+def _within_vmem_budget(kind: str, l: int, d: int, dv: int, itemsize: int,
+                        bias_input: bool) -> bool:
+    return _VMEM_GUARD.within(
+        kind,
+        (kind, l, d, dv, itemsize),
+        _tile_vmem_bytes(l, d, dv, itemsize, bias_input),
+        f"falling back to xla_attention at L={l}",
+    )
 
 
 def xla_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, bias: jnp.ndarray):
@@ -131,7 +161,15 @@ def fused_attention(q, k, v, bias, *, interpret: bool = False):
 
     q is expected pre-scaled (matching the reference, `botnet.py:205`).
     ``interpret=True`` runs the kernel in the Pallas interpreter (CPU tests).
+    A tile too large for VMEM falls back to `xla_attention` with a one-time
+    warning instead of failing opaquely inside Mosaic at large L.
     """
+    l, d = q.shape[-2], q.shape[-1]
+    if not _within_vmem_budget(
+        "fused_attention", l, d, v.shape[-1],
+        np.dtype(q.dtype).itemsize, bias_input=True,
+    ):
+        return xla_attention(q, k, v, bias)
     return _fused_attention(q, k, v, bias, interpret)
 
 
@@ -229,5 +267,20 @@ _fused_attention_abs.defvjp(_abs_fwd, _abs_bwd)
 
 def fused_attention_abs(q, k, v, emb, *, interpret: bool = False):
     """softmax(q·kᵀ + q·embᵀ)·v with the [L, D] position table applied
-    in-kernel; differentiable (incl. d/d emb). q pre-scaled, as above."""
+    in-kernel; differentiable (incl. d/d emb). q pre-scaled, as above.
+    Over the VMEM budget the fallback is the XLA composition — which
+    *materializes* the [B, N, L, L] bias product the kernel exists to avoid,
+    but runs (the one-time warning says what it costs)."""
+    l, d = q.shape[-2], q.shape[-1]
+    if not _within_vmem_budget(
+        "fused_attention_abs", l, d, v.shape[-1],
+        np.dtype(q.dtype).itemsize, bias_input=False,
+    ):
+        return xla_attention(
+            q, k, v,
+            jnp.einsum(
+                "bnid,jd->bnij", q, emb.astype(q.dtype),
+                preferred_element_type=jnp.float32,
+            ),
+        )
     return _fused_attention_abs(q, k, v, emb, interpret)

@@ -1,0 +1,47 @@
+"""Shared VMEM-budget guard for the Pallas kernels in this package.
+
+Every kernel here keeps its whole working set resident in VMEM (~16 MB per
+TPU core); past the budget the Mosaic compile fails with an opaque
+allocation error deep inside whatever stack invoked the kernel. Each kernel
+module owns one `VmemBudgetGuard` (its own env-var override and fallback
+counter — tests assert the counter) and an estimator for its tile shape;
+the guard centralizes the budget parse, the one-warning-per-shape policy,
+and the fallback accounting so the two stay policy-identical.
+"""
+
+from __future__ import annotations
+
+import os
+
+from distribuuuu_tpu.logging import logger
+
+DEFAULT_VMEM_BUDGET_MB = 12.0  # of ~16 MB/core, headroom left for Mosaic
+
+
+class VmemBudgetGuard:
+    """Warn-once, count-always fallback gate against a per-core budget."""
+
+    def __init__(self, env_var: str, default_mb: float = DEFAULT_VMEM_BUDGET_MB):
+        self.env_var = env_var
+        self.default_mb = float(default_mb)
+        self.fallbacks = 0  # total fallback decisions (tests assert this)
+        self._warned: set[tuple] = set()
+
+    def budget_bytes(self) -> int:
+        return int(float(os.environ.get(self.env_var, self.default_mb)) * 2**20)
+
+    def within(self, kind: str, key: tuple, estimate: int, fallback: str) -> bool:
+        """True when ``estimate`` fits the budget; otherwise count a
+        fallback and warn once per ``key`` naming what happens instead."""
+        budget = self.budget_bytes()
+        if estimate <= budget:
+            return True
+        self.fallbacks += 1
+        if key not in self._warned:
+            self._warned.add(key)
+            logger.warning(
+                f"{kind}: estimated per-tile VMEM {estimate / 2**20:.1f} MB "
+                f"exceeds the {budget / 2**20:.1f} MB budget — {fallback} "
+                f"(raise {self.env_var} to force the kernel)"
+            )
+        return False

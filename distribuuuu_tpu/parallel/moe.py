@@ -29,6 +29,12 @@ gate and expert gradients need no custom rules. Exactness (fwd + grad)
 against a dense single-program oracle with the identical drop rule is
 pinned in tests/test_moe.py.
 
+The one-hot dispatch/combine contractions have a fused alternative: the
+Pallas kernels in `ops/moe_kernel.py` keep the ``[n, E, C]`` mask VMEM-
+resident per token tile instead of materializing it in HBM twice per step
+(``fused=True`` / ``DTPU_FUSED_MOE=1``; oracle-equal fwd + grad, pinned in
+tests/test_moe_kernel.py, soak with ``scripts/soak_fused_attn.py --moe``).
+
 Returns the combined output plus the switch load-balancing auxiliary loss
 ``E · Σ_e f_e · P_e`` computed on the LOCAL token shard (the standard
 per-core practice — average it with the task loss through the ordinary
@@ -38,6 +44,7 @@ the training loss to keep routing balanced.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Tuple
 
 import jax
@@ -68,6 +75,8 @@ def switch_moe(
     *,
     capacity: int,
     axis_name: str = "expert",
+    fused: bool | None = None,
+    interpret: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Top-1 mixture-of-experts over ``axis_name``.
 
@@ -78,6 +87,14 @@ def switch_moe(
       expert_fn: ``(params, tokens [m, D]) -> [m, D]``, shape-preserving.
       capacity: C, max tokens each source device may send to each expert.
         Size it ``ceil(n / E) · capacity_factor`` with factor 1.25–2.
+      fused: route dispatch/combine through the Pallas kernels in
+        `ops/moe_kernel.py` (the ``[n, E, C]`` one-hot mask stays VMEM-
+        resident instead of round-tripping HBM twice). ``None`` (default)
+        reads ``DTPU_FUSED_MOE=1`` — the `DTPU_FUSED_ATTN` opt-in
+        convention; oracle equality (fwd + grad, incl. the capacity-drop
+        boundary) is pinned in tests/test_moe_kernel.py.
+      interpret: run the fused kernels in the Pallas interpreter (CPU
+        tests); ignored on the einsum path.
 
     Returns ``(combined [n, D], aux_loss scalar)``; dropped tokens come
     back as zeros (wrap with a residual: ``x + switch_moe(...)[0]``).
@@ -99,6 +116,31 @@ def switch_moe(
             f"'{axis_name}' axis has {e} devices (one expert per device); "
             "tokens routed past the axis would be silently dropped"
         )
+    if fused is None:
+        fused = os.environ.get("DTPU_FUSED_MOE", "0") == "1"
+    if fused:
+        from distribuuuu_tpu.ops.moe_kernel import (
+            fused_moe_dispatch,
+            fused_moe_combine,
+        )
+
+        # off-TPU a fused path runs the Pallas interpreter (the botnet
+        # DTPU_FUSED_ATTN convention: slow-but-correct instead of a crash)
+        interpret = interpret or jax.default_backend() != "tpu"
+
+        send, top, pos, w, fp_sum = fused_moe_dispatch(
+            x, gate_kernel, capacity=capacity, interpret=interpret
+        )
+        recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0, tiled=True)
+        y = expert_fn(expert_params, recv.reshape(e * capacity, d).astype(x.dtype))
+        y = y.reshape(e, capacity, d).astype(jnp.float32)
+        back = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0, tiled=True)
+        out = fused_moe_combine(back, top, pos, w, interpret=interpret).astype(x.dtype)
+        f_e = fp_sum[0] / n
+        p_e = fp_sum[1] / n
+        aux = e * jnp.sum(f_e * p_e)
+        return out, aux
+
     probs = jax.nn.softmax((x.astype(jnp.float32) @ gate_kernel.astype(jnp.float32)), axis=-1)
     top = jnp.argmax(probs, axis=-1)  # [n]
     top_p = jnp.take_along_axis(probs, top[:, None], axis=-1)[:, 0]  # [n]

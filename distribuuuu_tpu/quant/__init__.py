@@ -1,0 +1,31 @@
+"""dtpu-quant: post-training int8 quantization for the serving path.
+
+Per-channel symmetric int8 weights (BatchNorm folded where possible),
+per-tensor activation scales from a calibration pass, and an
+int8×int8→int32 interception forward that jit-traces through the serving
+engine's AOT ``lower().compile()`` ladder unchanged. Quality is gated, not
+assumed: `quant.gate` measures top-1 agreement and logit RMSE against the
+fp32 engine and a failing model refuses to serve (docs/SERVING.md,
+docs/PERFORMANCE.md).
+"""
+
+from distribuuuu_tpu.quant.gate import GateResult, compare_logits
+from distribuuuu_tpu.quant.ptq import (
+    CalibrationSite,
+    Int8Model,
+    calibrate,
+    prune_variables,
+    quantize,
+    quantize_weight,
+)
+
+__all__ = [
+    "CalibrationSite",
+    "GateResult",
+    "Int8Model",
+    "calibrate",
+    "compare_logits",
+    "prune_variables",
+    "quantize",
+    "quantize_weight",
+]

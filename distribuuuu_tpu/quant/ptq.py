@@ -1,0 +1,459 @@
+"""Post-training int8 quantization over the flax model zoo.
+
+The standard integer-arithmetic-only inference recipe (Jacob et al. 2018,
+arXiv 1712.05877), shaped for TPU serving: the MXU's int8 rate is 2× bf16,
+so an inference-only host that can afford a small, *measured* accuracy cost
+(see the quality gate in `quant/gate.py`) gets the headroom for free.
+
+Three stages, none of which touch the model code:
+
+1. **Calibration** (`calibrate`): run N real or synthetic batches through
+   the unmodified fp model under a `flax.linen.intercept_methods` hook,
+   recording per conv/dense call site the input activation amax (→ the
+   per-tensor activation scale) and — on the first batch — the layer graph
+   facts quantization needs: each site's static config and which BatchNorm
+   consumes a conv's output *directly* (object identity on the eager
+   activations), marking it foldable.
+2. **Quantization** (`quantize`): per-channel symmetric int8 over each
+   site's kernel (scale = amax/127 per output channel — symmetric, so the
+   conv's zero padding is exact in the int8 domain). A foldable BatchNorm
+   collapses into the site's dequant: its γ/√(var+ε) multiplies the
+   per-channel scale, its shift lands in the bias, and the BN call itself
+   becomes identity at serve time — no separate BN op remains. Adjacency
+   alone is not proof of foldability — a branch tapping the *pre-BN* conv
+   output (interception cannot see raw-op consumers) would receive folded
+   values — so `calibrate` finishes with a numeric fold check: one fp
+   forward with the fold transformation applied *in fp* must match the
+   plain fp forward; any divergence rejects the folds (the BNs simply stay
+   fp ops — "where possible" is literal).
+3. **Int8 forward** (`Int8Model.apply`): the same interception hook, now
+   substituting each quantized site with quantize-activation →
+   int8×int8→int32 conv/matmul (``preferred_element_type=jnp.int32`` — the
+   accumulator the MXU provides) → per-channel dequant + bias at the layer
+   boundary. Everything else (activations, LayerNorm, unfolded BN, pooling)
+   runs in fp exactly as before. The whole apply is jit-traceable — the
+   serving engine AOT-compiles it through the same ``lower().compile()``
+   ladder as the fp path.
+
+A site quantizes only when its config is representable (no input/kernel
+dilation, recognizable padding); anything else silently stays fp — "BN
+folded where possible" is literal, and correctness never depends on
+coverage (the quality gate measures what coverage costs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _key(path: tuple) -> str:
+    return "/".join(path)
+
+
+@dataclass
+class _BNFold:
+    """A BatchNorm directly consuming a quantized site's output."""
+
+    path: tuple
+    epsilon: float
+
+
+@dataclass
+class CalibrationSite:
+    """One quantizable conv/dense call site discovered during calibration."""
+
+    kind: str  # 'conv' | 'dense'
+    path: tuple
+    amax: float
+    out_dtype: Any = jnp.float32
+    # conv statics (normalized for lax.conv_general_dilated)
+    strides: tuple | None = None
+    padding: Any = None
+    groups: int = 1
+    bn: _BNFold | None = None
+
+
+def _norm_strides(module: nn.Conv) -> tuple:
+    s = module.strides
+    k = len(module.kernel_size)
+    if s is None:
+        return (1,) * k
+    if isinstance(s, int):
+        return (s,) * k
+    return tuple(int(v) for v in s)
+
+
+def _norm_padding(module: nn.Conv):
+    """lax-compatible padding, or None when the form isn't representable."""
+    p = module.padding
+    if isinstance(p, str):
+        return p if p in ("SAME", "VALID") else None
+    if isinstance(p, int):
+        return [(p, p)] * len(module.kernel_size)
+    try:
+        out = []
+        for el in p:
+            if isinstance(el, int):
+                out.append((el, el))
+            else:
+                lo, hi = el
+                out.append((int(lo), int(hi)))
+        return out
+    except (TypeError, ValueError):
+        return None
+
+
+def _conv_site(module: nn.Conv, amax: float) -> CalibrationSite | None:
+    padding = _norm_padding(module)
+    if padding is None:
+        return None
+
+    def dilated(d):
+        return d is not None and any(
+            int(v) != 1 for v in ((d,) if isinstance(d, int) else d)
+        )
+
+    if dilated(module.kernel_dilation) or dilated(module.input_dilation):
+        return None
+    return CalibrationSite(
+        kind="conv",
+        path=module.path,
+        amax=amax,
+        strides=_norm_strides(module),
+        padding=padding,
+        groups=int(module.feature_group_count),
+    )
+
+
+def calibrate(
+    model: nn.Module,
+    variables: dict,
+    batches: Iterable[jnp.ndarray],
+    *,
+    apply_fn: Callable[[dict, jnp.ndarray], jnp.ndarray] | None = None,
+) -> dict[str, CalibrationSite]:
+    """Run calibration batches through the fp model; return the site table.
+
+    ``batches`` must be *eager* arrays (the structure pass compares object
+    identity between a conv's output and a BatchNorm's input — only concrete
+    values have stable identity). ``apply_fn`` overrides the default
+    ``model.apply(variables, x, train=False)`` when the serve path wraps the
+    apply (e.g. on-device normalization before the model).
+    """
+    sites: dict[str, CalibrationSite] = {}
+
+    if apply_fn is None:
+        def apply_fn(v, x):
+            return model.apply(v, x, train=False)
+
+    first_batch = None
+    for batch_index, batch in enumerate(batches):
+        first = batch_index == 0
+        if first:
+            first_batch = batch
+        produced: dict[int, str] = {}  # id(conv output) -> site key
+        hold: list = []  # keep outputs alive so ids can't be recycled mid-pass
+
+        def interceptor(next_fun, args, kwargs, context):
+            mdl = context.module
+            if context.method_name != "__call__" or not mdl.path or not args:
+                return next_fun(*args, **kwargs)
+            if isinstance(mdl, (nn.Conv, nn.Dense)):
+                key = _key(mdl.path)
+                amax = float(jnp.max(jnp.abs(args[0].astype(jnp.float32))))
+                site = sites.get(key)
+                if site is None and first:
+                    site = (
+                        _conv_site(mdl, amax)
+                        if isinstance(mdl, nn.Conv)
+                        else CalibrationSite(kind="dense", path=mdl.path, amax=amax)
+                    )
+                    if site is not None:
+                        sites[key] = site
+                elif site is not None:
+                    site.amax = max(site.amax, amax)
+                out = next_fun(*args, **kwargs)
+                if site is not None and first:
+                    site.out_dtype = out.dtype
+                    produced[id(out)] = key
+                    hold.append(out)
+                return out
+            if (
+                first
+                and isinstance(mdl, nn.BatchNorm)
+                and mdl.use_running_average
+            ):
+                src = produced.get(id(args[0]))
+                out = next_fun(*args, **kwargs)
+                if src is not None and sites[src].bn is None:
+                    # this BN consumes the conv's output directly: foldable.
+                    # The site's boundary dtype becomes the BN's (the folded
+                    # path must emit what downstream saw before).
+                    sites[src].bn = _BNFold(
+                        path=mdl.path, epsilon=float(mdl.epsilon)
+                    )
+                    sites[src].out_dtype = out.dtype
+                return out
+            return next_fun(*args, **kwargs)
+
+        with nn.intercept_methods(interceptor):
+            apply_fn(variables, batch)
+    if first_batch is not None:
+        _verify_folds(variables, first_batch, sites, apply_fn)
+    return sites
+
+
+def _verify_folds(variables, batch, sites, apply_fn) -> None:
+    """Reject folds whose conv output has a consumer interception can't see.
+
+    Identity-adjacency proves the BN consumes the conv's output; it cannot
+    prove the BN is the *only* consumer — a raw-op tap between conv and BN
+    (``skip = h`` before ``h = bn(h)``) is invisible to the module hook and
+    would silently receive BN-transformed values once folded. So verify the
+    transformation itself: run the fp model once with the fold applied *in
+    fp* (affine at the conv site, identity at the BN) — structurally sound
+    folds reproduce the plain fp output to float-reassociation noise, an
+    unsound fold diverges at activation scale. Divergence unfolds
+    everything (conservative: the BNs just stay fp ops at serve time).
+    """
+    folded = {key: s for key, s in sites.items() if s.bn is not None}
+    if not folded:
+        return
+    params = variables["params"]
+    stats = variables.get("batch_stats", {}) or {}
+    bn_keys = {_key(s.bn.path) for s in folded.values()}
+
+    def interceptor(next_fun, args, kwargs, context):
+        mdl = context.module
+        if context.method_name != "__call__" or not mdl.path or not args:
+            return next_fun(*args, **kwargs)
+        key = _key(mdl.path)
+        if key in bn_keys:
+            return args[0]
+        site = folded.get(key)
+        if site is None:
+            return next_fun(*args, **kwargs)
+        out = next_fun(*args, **kwargs)
+        bn_p = _tree_get(params, site.bn.path)
+        bn_s = _tree_get(stats, site.bn.path)
+        gfac = np.asarray(bn_p["scale"], np.float32) / np.sqrt(
+            np.asarray(bn_s["var"], np.float32) + site.bn.epsilon
+        )
+        shift = np.asarray(bn_p["bias"], np.float32) - (
+            np.asarray(bn_s["mean"], np.float32) * gfac
+        )
+        return (out.astype(jnp.float32) * gfac + shift).astype(site.out_dtype)
+
+    with nn.intercept_methods(interceptor):
+        fold_out = apply_fn(variables, batch)
+    plain_out = apply_fn(variables, batch)
+    diff = float(
+        jnp.max(
+            jnp.abs(
+                fold_out.astype(jnp.float32) - plain_out.astype(jnp.float32)
+            )
+        )
+    )
+    scale = float(jnp.max(jnp.abs(plain_out.astype(jnp.float32))))
+    if diff > 1e-2 * max(scale, 1.0):
+        from distribuuuu_tpu.logging import logger
+
+        logger.warning(
+            f"quant: BN folding rejected — the fold transformation changes "
+            f"the fp output (max|Δ| {diff:.3e} vs activation scale "
+            f"{scale:.3e}), so some branch consumes a pre-BN conv output "
+            f"the module hook cannot see. The {len(folded)} adjacent BN(s) "
+            f"stay fp ops; quantization proceeds without folding"
+        )
+        for site in folded.values():
+            site.bn = None
+
+
+def quantize_weight(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Per-output-channel symmetric int8: ``(w_q int8, scale f32 [out])``.
+
+    The output channel is the trailing axis for both flax conv kernels
+    (HWIO) and dense kernels (IO). All-zero channels get scale 1 (their
+    quantized weights are zero anyway — scale must just stay finite).
+    Roundtrip error is bounded by scale/2 per channel (pinned in
+    tests/test_quant.py).
+    """
+    w = np.asarray(w, np.float32)
+    axes = tuple(range(w.ndim - 1))
+    scale = np.max(np.abs(w), axis=axes) / 127.0
+    scale = np.where(scale > 0.0, scale, 1.0).astype(np.float32)
+    w_q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+    return w_q, scale
+
+
+def _tree_get(tree: dict, path: tuple) -> dict:
+    node = tree
+    for name in path:
+        node = node[name]
+    return node
+
+
+@dataclass
+class Int8Model:
+    """The static half of a quantized model: site table + folded BN set.
+
+    Arrays live in the separate ``qparams`` pytree (returned by `quantize`)
+    so the AOT executables take them as ordinary device arguments; this
+    object closes over only hashable/static facts and is reused across every
+    compiled batch size.
+    """
+
+    sites: dict[str, CalibrationSite]
+    folded: frozenset = field(default_factory=frozenset)
+
+    @property
+    def n_quantized(self) -> int:
+        return len(self.sites)
+
+    def apply(
+        self,
+        model: nn.Module,
+        variables: dict,
+        qparams: dict,
+        x: jnp.ndarray,
+        *,
+        apply_fn: Callable[[dict, jnp.ndarray], jnp.ndarray] | None = None,
+    ) -> jnp.ndarray:
+        """The int8 forward: jit-traceable interception apply."""
+        if apply_fn is None:
+            def apply_fn(v, x_):
+                return model.apply(v, x_, train=False)
+
+        def interceptor(next_fun, args, kwargs, context):
+            mdl = context.module
+            if context.method_name != "__call__" or not mdl.path or not args:
+                return next_fun(*args, **kwargs)
+            key = _key(mdl.path)
+            if key in self.folded:
+                return args[0]  # BN folded into the upstream conv's dequant
+            site = self.sites.get(key)
+            if site is None:
+                return next_fun(*args, **kwargs)
+            return _int8_layer(site, qparams[key], args[0])
+
+        with nn.intercept_methods(interceptor):
+            return apply_fn(variables, x)
+
+
+def quantize(
+    variables: dict, sites: dict[str, CalibrationSite]
+) -> tuple[Int8Model, dict]:
+    """Quantize the calibrated sites: ``(Int8Model, qparams pytree)``.
+
+    Per site: per-channel symmetric int8 weights; the per-tensor activation
+    scale folded into the per-channel dequant scale; a foldable BatchNorm's
+    γ/√(var+ε) multiplied in and its shift landed in the bias. ``qparams``
+    maps site key → ``{w_q, scale, bias, act_scale}`` device-committable
+    arrays.
+    """
+    params = variables["params"]
+    stats = variables.get("batch_stats", {}) or {}
+    qparams: dict[str, dict[str, jnp.ndarray]] = {}
+    folded = set()
+    for key, site in sites.items():
+        leaf = _tree_get(params, site.path)
+        w_q, w_scale = quantize_weight(np.asarray(leaf["kernel"], np.float32))
+        out = w_scale.shape[0]
+        bias = (
+            np.asarray(leaf["bias"], np.float32)
+            if "bias" in leaf
+            else np.zeros(out, np.float32)
+        )
+        scale = w_scale
+        if site.bn is not None:
+            bn_p = _tree_get(params, site.bn.path)
+            bn_s = _tree_get(stats, site.bn.path)
+            gfac = np.asarray(bn_p["scale"], np.float32) / np.sqrt(
+                np.asarray(bn_s["var"], np.float32) + site.bn.epsilon
+            )
+            bias = bias * gfac + (
+                np.asarray(bn_p["bias"], np.float32)
+                - np.asarray(bn_s["mean"], np.float32) * gfac
+            )
+            scale = scale * gfac
+            folded.add(_key(site.bn.path))
+        act_scale = np.float32(max(site.amax, 1e-8) / 127.0)
+        qparams[key] = {
+            "w_q": jnp.asarray(w_q),
+            "scale": jnp.asarray(scale * act_scale, jnp.float32),
+            "bias": jnp.asarray(bias, jnp.float32),
+            "act_scale": jnp.asarray(act_scale, jnp.float32),
+        }
+    return Int8Model(sites=dict(sites), folded=frozenset(folded)), qparams
+
+
+def _copy_tree(tree: dict) -> dict:
+    return {
+        k: _copy_tree(v) if isinstance(v, dict) else v for k, v in tree.items()
+    }
+
+
+def _remove_node(tree: dict, path: tuple) -> None:
+    node = tree
+    for name in path[:-1]:
+        node = node.get(name)
+        if not isinstance(node, dict):
+            return
+    node.pop(path[-1], None)
+
+
+def prune_variables(variables: dict, model: Int8Model) -> dict:
+    """Variables with every array the int8 forward never reads removed.
+
+    Quantized sites' kernels/biases live in ``qparams`` (int8 + scales) and
+    folded BNs are identity at serve time — keeping their fp leaves in the
+    executable's arguments would hold the full fp model in HBM next to the
+    quantized one for the replica's lifetime. The interception forward
+    never calls ``next_fun`` for those modules, so flax never looks their
+    params up; everything unquantized (LayerNorm, unfolded BN, embeddings)
+    stays. Leaves are shared, the dict spine is copied.
+    """
+    params = _copy_tree(variables["params"])
+    stats = _copy_tree(variables.get("batch_stats", {}) or {})
+    for site in model.sites.values():
+        node = _tree_get(params, site.path[:-1]) if len(site.path) > 1 else params
+        leaf = node.get(site.path[-1])
+        if isinstance(leaf, dict):
+            leaf.pop("kernel", None)
+            leaf.pop("bias", None)
+        if site.bn is not None:
+            _remove_node(params, site.bn.path)
+            _remove_node(stats, site.bn.path)
+    return {"params": params, "batch_stats": stats}
+
+
+def _int8_layer(site: CalibrationSite, q: dict, x: jnp.ndarray) -> jnp.ndarray:
+    """quantize-activation → int8 contraction (int32 accumulate) → dequant."""
+    xq = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / q["act_scale"]), -127.0, 127.0
+    ).astype(jnp.int8)
+    if site.kind == "conv":
+        acc = lax.conv_general_dilated(
+            xq,
+            q["w_q"],
+            window_strides=site.strides,
+            padding=site.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=site.groups,
+            preferred_element_type=jnp.int32,
+        )
+    else:
+        acc = lax.dot_general(
+            xq,
+            q["w_q"],
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+    y = acc.astype(jnp.float32) * q["scale"] + q["bias"]
+    return y.astype(site.out_dtype)

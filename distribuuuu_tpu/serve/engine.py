@@ -16,6 +16,20 @@ committed replicated over the serve mesh; the batch dimension shards over
 the ``data`` axis whenever the compiled size divides the mesh (``MESH.DATA``
 says how many chips serve), falling back to replicated execution for ladder
 sizes smaller than the mesh (batch 1 on an 8-chip host).
+
+A model spec ending in ``:int8`` (``SERVE.MODELS "name=arch@weights:int8"``)
+hosts the post-training-quantized path instead (dtpu-quant,
+docs/PERFORMANCE.md): per-channel symmetric int8 weights with BatchNorm
+folded where possible, per-tensor activation scales from a calibration pass,
+and an int8×int8→int32 forward (``preferred_element_type=jnp.int32`` — the
+MXU's 2x-rate integer pipeline) AOT-compiled through the very same
+``lower().compile()`` ladder, so the zero-steady-state-compiles contract is
+identical. Quality is gated at load: the int8 path must agree with the fp32
+engine on deterministic fixture inputs (top-1 agreement + logit RMSE vs
+``cfg.QUANT`` thresholds) or the model refuses to serve; the measurement is
+journaled as a typed ``quant_quality`` record either way, and every ladder
+entry's compile wall time lands as a ``serve_compile`` record (the
+warm-vs-cold startup number `obs summarize` renders).
 """
 
 from __future__ import annotations
@@ -35,36 +49,52 @@ from distribuuuu_tpu.logging import logger
 from distribuuuu_tpu.models import build_model
 
 
+QUANT_MODES = ("int8",)
+
+
 @dataclass(frozen=True)
 class ModelSpec:
-    """One hosted model: routing name, zoo arch, weights directory."""
+    """One hosted model: routing name, zoo arch, weights directory.
+
+    ``quant`` is ``""`` (fp, the default) or one of `QUANT_MODES` — parsed
+    from a ``:int8`` spec suffix, it selects the quantized serving path for
+    this model only (other hosted models are untouched).
+    """
 
     name: str
     arch: str
     weights: str
+    quant: str = ""
 
 
 def parse_model_specs(entries: list[str]) -> list[ModelSpec]:
-    """Parse ``SERVE.MODELS`` entries (``"name=arch@weights_path"``).
+    """Parse ``SERVE.MODELS`` entries (``"name=arch@weights_path[:int8]"``).
 
     The separators are fixed and the failure is loud with the full entry —
     a typo'd spec must not silently host the wrong model under a load
     balancer. Duplicate names are rejected (routing would be ambiguous).
+    Only an exact known quant mode is stripped from the tail, so weight
+    paths containing ``:`` (gs://...) parse unchanged.
     """
     specs: list[ModelSpec] = []
     seen: set[str] = set()
     for entry in entries:
         head, sep, weights = str(entry).partition("@")
         name, sep2, arch = head.partition("=")
+        quant = ""
+        base, colon, tail = weights.rpartition(":")
+        if colon and tail in QUANT_MODES:
+            weights, quant = base, tail
         if not (sep and sep2 and name and arch and weights):
             raise ValueError(
                 f"SERVE.MODELS entry {entry!r} is not 'name=arch@weights_path' "
-                f"(e.g. 'rn50=resnet50@/ckpts/converted_resnet50')"
+                f"(e.g. 'rn50=resnet50@/ckpts/converted_resnet50', append "
+                f"':int8' for the quantized path)"
             )
         if name in seen:
             raise ValueError(f"SERVE.MODELS: duplicate model name {name!r}")
         seen.add(name)
-        specs.append(ModelSpec(name=name, arch=arch, weights=weights))
+        specs.append(ModelSpec(name=name, arch=arch, weights=weights, quant=quant))
     return specs
 
 
@@ -73,13 +103,22 @@ class HostedModel:
     """One model's loaded weights + its compiled batch ladder."""
 
     spec: ModelSpec
+    # the loaded weights; for an int8 model these are PRUNED after the
+    # quality gate to the leaves the int8 forward actually reads (the
+    # quantized kernels and folded BNs live in the qparams exec arg)
     params: Any
     batch_stats: Any
     # ladder size -> (AOT executable, the sharding its image arg was
     # compiled for — device_put targets it explicitly before each call)
     compiled: dict[int, tuple[Any, NamedSharding]] = field(default_factory=dict)
+    # the executable's leading (non-image) arguments: (params, batch_stats)
+    # for fp models, (qparams, params, batch_stats) for int8
+    exec_args: tuple = ()
     load_s: float = 0.0
     compile_s: float = 0.0
+    # int8 extras: the gate measurement and the calibrate+quantize wall
+    gate: Any = None
+    quant_s: float = 0.0
 
     @property
     def batch_sizes(self) -> list[int]:
@@ -106,6 +145,8 @@ class InferenceEngine:
         input_dtype: str = "uint8",
         compute_dtype: str = "float32",
         verify_integrity: bool = True,
+        journal_event: Callable[..., None] | None = None,
+        quant_cfg: dict | None = None,
     ):
         if not batch_sizes or sorted(set(int(b) for b in batch_sizes)) != sorted(
             int(b) for b in batch_sizes
@@ -127,6 +168,21 @@ class InferenceEngine:
         self.models: dict[str, HostedModel] = {}
         self._replicated = NamedSharding(mesh, P())
         self.aot_compiles = 0  # ladder entries compiled (cache hits included)
+        # typed-record sink (ValidatedJournal.event); None degrades to no-op
+        self._event = journal_event or (lambda kind, **fields: None)
+        # cfg.QUANT knobs, engine-shaped (ServeReplica builds this dict; a
+        # bare engine in tests gets the same defaults)
+        q = dict(quant_cfg or {})
+        self.quant_cfg = {
+            "calib_batches": int(q.get("calib_batches", 4)),
+            "calib_batch_size": int(q.get("calib_batch_size", 8)),
+            "calib_seed": int(q.get("calib_seed", 1234)),
+            "gate": bool(q.get("gate", True)),
+            "gate_n": int(q.get("gate_n", 16)),
+            "gate_seed": int(q.get("gate_seed", 0)),
+            "min_top1_agree": float(q.get("min_top1_agree", 0.99)),
+            "max_logit_rmse": float(q.get("max_logit_rmse", 0.25)),
+        }
 
     # -- loading -------------------------------------------------------------
 
@@ -172,11 +228,16 @@ class InferenceEngine:
             logits = model.apply({"params": p, "batch_stats": stats}, x, train=False)
             return logits.astype(jnp.float32)
 
-        # one traced callable reused across the whole ladder: each .lower()
-        # below traces with a different batch shape, each .compile() consults
-        # the persistent cache, and the resulting executables are immutable —
-        # a request can never trigger a retrace, whatever sizes arrive
-        jfwd = jax.jit(fwd, out_shardings=rep)
+        if spec.quant:
+            jfwd, hosted.exec_args = self._quantize(spec, model, hosted, fwd, rep)
+        else:
+            # one traced callable reused across the whole ladder: each
+            # .lower() below traces with a different batch shape, each
+            # .compile() consults the persistent cache, and the resulting
+            # executables are immutable — a request can never trigger a
+            # retrace, whatever sizes arrive
+            jfwd = jax.jit(fwd, out_shardings=rep)
+            hosted.exec_args = (params, batch_stats)
         tic = time.time()
         for b in self.batch_sizes:
             img_sharding = (
@@ -189,17 +250,163 @@ class InferenceEngine:
                 self.input_dtype,
                 sharding=img_sharding,
             )
-            compiled = jfwd.lower(params, batch_stats, images_sds).compile()
+            t0 = time.time()
+            compiled = jfwd.lower(*hosted.exec_args, images_sds).compile()
             hosted.compiled[b] = (compiled, img_sharding)
             self.aot_compiles += 1
+            # per-(model, size) compile wall: a persistent-cache hit shows as
+            # a near-zero entry — the measured warm-vs-cold serving startup
+            self._event(
+                "serve_compile",
+                model=spec.name,
+                batch_size=b,
+                wall_s=round(time.time() - t0, 4),
+                quant=spec.quant,
+            )
         hosted.compile_s = time.time() - tic
         self.models[spec.name] = hosted
+        quant_note = f" [{spec.quant}]" if spec.quant else ""
         logger.info(
-            f"serve: hosted {spec.name} ({spec.arch}) from {spec.weights}: "
-            f"weights {load_s:.2f}s, ladder {self.batch_sizes} AOT-compiled in "
-            f"{hosted.compile_s:.2f}s"
+            f"serve: hosted {spec.name} ({spec.arch}{quant_note}) from "
+            f"{spec.weights}: weights {load_s:.2f}s, ladder {self.batch_sizes} "
+            f"AOT-compiled in {hosted.compile_s:.2f}s"
         )
         return hosted
+
+    # -- int8 (dtpu-quant) ---------------------------------------------------
+
+    def _synthetic_batches(self, n_batches: int, batch_size: int, seed: int):
+        """Seeded wire-dtype calibration batches (uint8 pixels or
+        post-normalization floats, matching what requests will carry)."""
+        rng = np.random.default_rng(seed)
+        shape = (batch_size, self.im_size, self.im_size, 3)
+        batches = []
+        for _ in range(n_batches):
+            if self.input_dtype == np.uint8:
+                batches.append(
+                    jnp.asarray(rng.integers(0, 256, size=shape, dtype=np.uint8))
+                )
+            else:
+                batches.append(jnp.asarray(rng.standard_normal(shape), jnp.float32))
+        return batches
+
+    def _gate_inputs(self, n: int, seed: int) -> np.ndarray:
+        """Deterministic gate inputs: `convert.golden_inputs` for float wire
+        (the exact family the checked-in golden fixtures pin), seeded uint8
+        pixels otherwise."""
+        if self.input_dtype == np.uint8:
+            rng = np.random.default_rng(seed)
+            return np.asarray(
+                rng.integers(
+                    0, 256, size=(n, self.im_size, self.im_size, 3), dtype=np.uint8
+                )
+            )
+        from distribuuuu_tpu.convert import golden_inputs
+
+        return golden_inputs(n, self.im_size, seed)
+
+    def _quantize(self, spec: ModelSpec, model, hosted: HostedModel, fwd, rep):
+        """Calibrate → quantize → quality-gate one hosted model.
+
+        Returns the jitted int8 forward plus its executable leading args
+        ``(qparams, params, batch_stats)`` — where params/batch_stats are
+        PRUNED to what the int8 forward actually reads (quantized kernels
+        and folded BNs live in qparams; keeping their fp leaves would hold
+        the whole fp model in HBM next to the quantized one). A failed gate
+        raises (refuse to serve) unless ``QUANT.GATE`` is off; the
+        measurement is journaled as a ``quant_quality`` record in every
+        case.
+        """
+        from distribuuuu_tpu.quant import (
+            calibrate,
+            compare_logits,
+            prune_variables,
+            quantize,
+        )
+
+        qc = self.quant_cfg
+        tic = time.time()
+        variables = {"params": hosted.params, "batch_stats": hosted.batch_stats}
+
+        def calib_apply(v, images):
+            # the REAL serve pipeline (device_normalize included): activation
+            # ranges must be recorded where requests will actually land
+            return fwd(v["params"], v["batch_stats"], images)
+
+        sites = calibrate(
+            model,
+            variables,
+            self._synthetic_batches(
+                qc["calib_batches"], qc["calib_batch_size"], qc["calib_seed"]
+            ),
+            apply_fn=calib_apply,
+        )
+        qmodel, qparams = quantize(variables, sites)
+        qparams = jax.device_put(qparams, rep)
+
+        def q_fwd(qp, p, stats, images):
+            x = device_normalize(images)
+            logits = qmodel.apply(model, {"params": p, "batch_stats": stats}, qp, x)
+            return logits.astype(jnp.float32)
+
+        # gate: int8 vs the fp32 engine forward on deterministic inputs.
+        # One-shot jits bound to names, executed once at load (before any
+        # CompileGuard window) — steady-state serving still never compiles.
+        gate_x = self._gate_inputs(qc["gate_n"], qc["gate_seed"])
+        fp_fn = jax.jit(fwd)
+        q_fn = jax.jit(q_fwd)
+        fp_logits = jax.device_get(fp_fn(hosted.params, hosted.batch_stats, gate_x))
+        q_logits = jax.device_get(
+            q_fn(qparams, hosted.params, hosted.batch_stats, gate_x)
+        )
+        result = compare_logits(
+            fp_logits,
+            q_logits,
+            min_top1_agree=qc["min_top1_agree"],
+            max_logit_rmse=qc["max_logit_rmse"],
+        )
+        hosted.gate = result
+        hosted.quant_s = time.time() - tic
+        self._event(
+            "quant_quality",
+            model=spec.name,
+            mode=spec.quant,
+            **result.fields(),
+            calib_batches=qc["calib_batches"],
+            layers=qmodel.n_quantized,
+            folded_bn=len(qmodel.folded),
+            wall_s=round(hosted.quant_s, 3),
+        )
+        logger.info(
+            f"serve: {spec.name} int8 quality gate: top-1 agree "
+            f"{100.0 * result.top1_agree:.2f}%, logit RMSE "
+            f"{result.logit_rmse:.4f} over {result.n} fixture inputs "
+            f"({qmodel.n_quantized} layer(s) quantized, "
+            f"{len(qmodel.folded)} BN(s) folded) -> "
+            f"{'PASSED' if result.passed else 'FAILED'}"
+        )
+        if not result.passed:
+            msg = (
+                f"refusing to serve {spec.name!r} int8: quality gate failed "
+                f"(top-1 agree {result.top1_agree:.4f} < "
+                f"{qc['min_top1_agree']} or logit RMSE "
+                f"{result.logit_rmse:.4f} > {qc['max_logit_rmse']} vs the "
+                f"fp32 engine on {result.n} fixture inputs)"
+            )
+            if qc["gate"]:
+                raise RuntimeError(msg)
+            logger.warning(msg + " — serving anyway (QUANT.GATE False)")
+        # the gate above needed the full fp tree; the executables do not —
+        # drop the quantized/folded leaves so their HBM is freed once the
+        # gate's locals go out of scope
+        pruned = prune_variables(variables, qmodel)
+        hosted.params = pruned["params"]
+        hosted.batch_stats = pruned["batch_stats"]
+        return jax.jit(q_fwd, out_shardings=rep), (
+            qparams,
+            hosted.params,
+            hosted.batch_stats,
+        )
 
     def load_all(self, specs: list[ModelSpec]) -> None:
         for spec in specs:
@@ -215,7 +422,7 @@ class InferenceEngine:
                     (b, self.im_size, self.im_size, 3), self.input_dtype
                 )
                 np.asarray(
-                    compiled(hosted.params, hosted.batch_stats, jax.device_put(zeros, sharding))
+                    compiled(*hosted.exec_args, jax.device_put(zeros, sharding))
                 )
         wall = time.time() - tic
         logger.info(f"serve: warmup ran every (model, batch) pair in {wall:.2f}s")
@@ -252,7 +459,7 @@ class InferenceEngine:
                 f"{self.input_dtype} (SERVE.INPUT_DTYPE)"
             )
         compiled, sharding = hosted.compiled[b]
-        out = compiled(hosted.params, hosted.batch_stats, jax.device_put(batch, sharding))
+        out = compiled(*hosted.exec_args, jax.device_put(batch, sharding))
         return np.asarray(out)
 
     def runner(self) -> Callable[[str, np.ndarray], np.ndarray]:

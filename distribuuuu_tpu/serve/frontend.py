@@ -154,6 +154,17 @@ class ServeReplica:
             input_dtype=str(s.INPUT_DTYPE),
             compute_dtype=str(s.DTYPE) or str(cfg.MODEL.DTYPE),
             verify_integrity=bool(s.VERIFY_INTEGRITY),
+            journal_event=self.journal.event,
+            quant_cfg={
+                "calib_batches": int(cfg.QUANT.CALIB_BATCHES),
+                "calib_batch_size": int(cfg.QUANT.CALIB_BATCH_SIZE),
+                "calib_seed": int(cfg.QUANT.CALIB_SEED),
+                "gate": bool(cfg.QUANT.GATE),
+                "gate_n": int(cfg.QUANT.GATE_N),
+                "gate_seed": int(cfg.QUANT.GATE_SEED),
+                "min_top1_agree": float(cfg.QUANT.MIN_TOP1_AGREE),
+                "max_logit_rmse": float(cfg.QUANT.MAX_LOGIT_RMSE),
+            },
         )
         self.engine.load_all(specs)
         warmup_s = self.engine.warmup() if s.WARMUP else 0.0

@@ -1,4 +1,4 @@
-"""On-chip soak for the fused attention kernel (run when a TPU is healthy).
+"""On-chip soak for the fused kernels (run when a TPU is healthy).
 
 Validates ops/attention.py against the XLA path on real hardware at BoTNet
 shapes (fwd values, gradients, and speed), then prints the verdict. PASS
@@ -7,8 +7,16 @@ DTPU_FUSED_ATTN. 2026-07-31 measured verdict: 0.771x — XLA wins at these
 shapes, default stays off (docs/BENCH_NOTES.md round-5 session #2).
 
     python scripts/soak_fused_attn.py
+
+``--moe`` soaks the fused MoE dispatch/combine kernels
+(ops/moe_kernel.py) against the einsum formulation instead — fwd + grad
+numerics plus the dispatch/combine microbench that is the flip/keep
+signal for DTPU_FUSED_MOE. Off-TPU the kernels run in the Pallas
+interpreter: numerics still hold (the CI kernels-smoke job asserts this
+runs), timings are meaningless there.
 """
 
+import argparse
 import os
 import sys
 import time
@@ -120,5 +128,110 @@ def main():
     sys.exit(0 if ok else 1)
 
 
+def main_moe():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distribuuuu_tpu.ops.moe_kernel import (
+        fused_moe_combine,
+        fused_moe_dispatch,
+        oracle_combine,
+        oracle_dispatch,
+    )
+
+    print(f"devices: {jax.devices()}", flush=True)
+    interpret = jax.devices()[0].platform != "tpu"
+    if interpret:
+        print("(no TPU: Pallas interpreter — numerics only, ignore timings)", flush=True)
+    rng = np.random.default_rng(0)
+    # a realistic per-device shard: 8k tokens, E=8 experts, C=1.25n/E. D=128
+    # keeps the [E, C, D] buffer + [T, E·C] mask inside the kernels' VMEM
+    # budget — larger shards trip the guard and fall back to the einsum
+    # formulation (the soak would then time einsum vs einsum and say nothing)
+    N, D, E = 8192, 128, 8
+    C = int(1.25 * N / E)
+    x = jnp.asarray(rng.standard_normal((N, D)) * 0.5, jnp.float32)
+    gate = jnp.asarray(rng.standard_normal((D, E)) * 0.1, jnp.float32)
+
+    # 1) dispatch parity (send buffer + routing metadata + aux sums).
+    # jitted callables bound ONCE up front (not jit-then-call per use): the
+    # compile cache stays keyed on stable function objects — dtpu-lint DT003
+    jit_dispatch = jax.jit(
+        lambda x_, g_: fused_moe_dispatch(x_, g_, capacity=C, interpret=interpret)
+    )
+    jit_oracle_dispatch = jax.jit(lambda x_, g_: oracle_dispatch(x_, g_, C))
+    send_f, top_f, pos_f, w_f, fp_f = jax.device_get(jit_dispatch(x, gate))
+    send_o, top_o, pos_o, w_o, fp_o = jax.device_get(
+        jit_oracle_dispatch(x, gate)
+    )
+    send_diff = float(np.max(np.abs(send_f - send_o)))
+    meta_ok = bool(np.array_equal(top_f, top_o) and np.array_equal(pos_f, pos_o))
+    w_diff = float(np.max(np.abs(w_f - w_o)))
+    print(f"dispatch max|Δsend| = {send_diff:.2e}, metadata equal = {meta_ok}, "
+          f"max|Δw| = {w_diff:.2e}", flush=True)
+
+    # 2) combine parity
+    back = jnp.asarray(rng.standard_normal((E, C, D)), jnp.float32)
+    jit_combine = jax.jit(
+        lambda b_, t_, p_, w_: fused_moe_combine(b_, t_, p_, w_, interpret=interpret)
+    )
+    jit_oracle_combine = jax.jit(oracle_combine)
+    out_f = jax.device_get(jit_combine(back, top_f, pos_f, w_f))
+    out_o = jax.device_get(jit_oracle_combine(back, top_o, pos_o, w_o))
+    out_diff = float(np.max(np.abs(out_f - out_o)))
+    print(f"combine max|Δout| = {out_diff:.2e}", flush=True)
+
+    # 3) grad parity through dispatch -> (stand-in expert) -> combine
+    def loss(dispatch, combine):
+        def f(x_, g_, b0):
+            send, top, pos, w, fp = dispatch(x_, g_)
+            out = combine(jnp.tanh(send) + b0, top, pos, w)
+            return jnp.sum(out.astype(jnp.float32) ** 2) + 0.01 * jnp.sum(fp[0] * fp[1])
+        return f
+
+    fused_loss = loss(
+        lambda x_, g_: fused_moe_dispatch(x_, g_, capacity=C, interpret=interpret),
+        lambda b_, t_, p_, w_: fused_moe_combine(b_, t_, p_, w_, interpret=interpret),
+    )
+    oracle_loss = loss(lambda x_, g_: oracle_dispatch(x_, g_, C), oracle_combine)
+    grad_fused = jax.jit(jax.grad(fused_loss, argnums=(0, 1, 2)))
+    grad_oracle = jax.jit(jax.grad(oracle_loss, argnums=(0, 1, 2)))
+    gf = jax.device_get(grad_fused(x, gate, back))
+    go = jax.device_get(grad_oracle(x, gate, back))
+    grad_diff = max(
+        float(np.max(np.abs(a - b))) for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(go))
+    )
+    print(f"grad max|diff| = {grad_diff:.2e}", flush=True)
+
+    # 4) microbench: the dispatch+combine round trip both ways (the einsum
+    # arm materializes the [n, E, C] mask in HBM twice; the fused arm keeps
+    # it VMEM-resident — the whole point)
+    ms = {}
+    for name, f in [("fused", jax.jit(fused_loss)), ("einsum", jax.jit(oracle_loss))]:
+        jax.device_get(f(x, gate, back))
+        t0 = time.perf_counter()
+        for _ in range(10):
+            jax.device_get(f(x, gate, back))
+        ms[name] = (time.perf_counter() - t0) / 10 * 1000
+        print(f"{name} dispatch+combine (fwd+bwd): {ms[name]:.2f} ms", flush=True)
+    print(
+        f"moe speedup: {ms['einsum'] / ms['fused']:.3f}x (>1 = fused wins"
+        f"{'; interpreter — not meaningful' if interpret else ''})",
+        flush=True,
+    )
+
+    ok = send_diff < 1e-4 and meta_ok and w_diff < 1e-6 and out_diff < 1e-4 and grad_diff < 1e-3
+    print("SOAK", "PASS (numerics hold; see the speedup line for the "
+          "flip/keep verdict)" if ok else "FAIL", flush=True)
+    sys.exit(0 if ok else 1)
+
+
 if __name__ == "__main__":
-    main()
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--moe", action="store_true",
+        help="soak the fused MoE dispatch/combine kernels instead of attention",
+    )
+    args = parser.parse_args()
+    main_moe() if args.moe else main()

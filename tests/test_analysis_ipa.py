@@ -677,6 +677,86 @@ def test_dt104_activation_downcast_is_fine():
     assert _hits(src, "DT104") == []
 
 
+# lax.dot_general without preferred_element_type — the raw MXU entry point
+# must always state its accumulator, Pallas kernel bodies included (ref
+# loads make operand dtypes unknowable there, so the upcast-flow check
+# above cannot see the problem)
+DT104_DOT_GENERAL_BARE = """
+import jax
+from jax import lax
+
+def qk(q, k):
+    return lax.dot_general(q, k, (((1,), (1,)), ((), ())))
+"""
+
+DT104_DOT_GENERAL_KERNEL = """
+import jax
+from jax.experimental import pallas as pl
+
+def attn_kernel(q_ref, k_ref, o_ref):
+    q = q_ref[...]
+    k = k_ref[...]
+    o_ref[...] = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
+"""
+
+# pl.dot is EXEMPT: it rejects the preferred_element_type kwarg outright
+# and already hardcodes f32 accumulation in the dot_general it emits —
+# flagging it would demand an impossible fix
+DT104_PL_DOT_KERNEL = """
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+def attn_kernel(q_ref, k_ref, o_ref):
+    q = q_ref[...]
+    k = k_ref[...]
+    o_ref[...] = pl.dot(q, k)
+"""
+
+DT104_DOT_GENERAL_PREFERRED = """
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+def qk(q, k):
+    return lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+"""
+
+DT104_DOT_GENERAL_F32_OPERANDS = """
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+def qk(q, k):
+    q32 = q.astype(jnp.float32)
+    k32 = k.astype(jnp.float32)
+    return lax.dot_general(q32, k32, (((1,), (1,)), ((), ())))
+"""
+
+
+def test_dt104_dot_general_missing_preferred():
+    assert _hits(DT104_DOT_GENERAL_BARE, "DT104") == [("snippet.py", 5)]
+
+
+def test_dt104_dot_general_in_kernel_body():
+    assert _hits(DT104_DOT_GENERAL_KERNEL, "DT104") == [("snippet.py", 7)]
+
+
+def test_dt104_pl_dot_is_exempt():
+    """pl.dot cannot take preferred_element_type (TypeError) and already
+    accumulates f32 internally — it must NOT be flagged."""
+    assert _hits(DT104_PL_DOT_KERNEL, "DT104") == []
+
+
+def test_dt104_dot_general_with_preferred_is_clean():
+    assert _hits(DT104_DOT_GENERAL_PREFERRED, "DT104") == []
+
+
+def test_dt104_dot_general_f32_operands_is_clean():
+    assert _hits(DT104_DOT_GENERAL_F32_OPERANDS, "DT104") == []
+
+
 # ---------------------------------------------------------------------------
 # regression pins: the real DT104/DT101 catches this PR fixed
 # ---------------------------------------------------------------------------

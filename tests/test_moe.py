@@ -160,6 +160,94 @@ def test_moe_rejects_expert_count_mismatch():
         f(params, x)
 
 
+def test_moe_bf16_capacity_boundary_matches_dense_fwd_and_grad():
+    """The exact overflow boundary under bf16 inputs: positive tokens all
+    forced to expert 0, capacity = n_local - 1, so precisely the last local
+    token drops per shard. Pins the f32-dispatch-einsum contract (routing
+    and combine weights in f32 even when activations are half precision,
+    dropped tokens exactly zero, zero gradient through dropped tokens) that
+    the fused kernel path must also honor (tests/test_moe_kernel.py)."""
+    n_local = 4
+    capacity = n_local - 1
+    mesh = create_mesh({"expert": E})
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(np.abs(rng.standard_normal((E, n_local, D))) + 0.1, jnp.float32)
+    y_t = jnp.asarray(rng.standard_normal((E, n_local, D)), jnp.float32)
+    params = make_params(jax.random.PRNGKey(4))
+    params["gate"] = jnp.zeros((D, E), jnp.float32).at[:, 0].set(5.0)
+
+    def body(gate, experts_local, x_local, y_local):
+        experts_local = jax.tree.map(lambda a: a[0], experts_local)
+        x_local, y_local = x_local[0], y_local[0]
+
+        def loss_fn(p):
+            out, aux = switch_moe(
+                x_local.astype(jnp.bfloat16), p["gate"], p["experts"],
+                expert_fn, capacity=capacity, axis_name="expert",
+            )
+            out32 = out.astype(jnp.float32)
+            return jnp.mean((out32 - y_local) ** 2) + 0.01 * aux, out32
+
+        (loss, out), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            {"gate": gate, "experts": experts_local}
+        )
+        return (
+            lax.pmean(loss, "expert"),
+            out[None],
+            jax.tree.map(lambda g: g[None] / E, grads["experts"]),
+        )
+
+    sharded = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P("expert"), P("expert"), P("expert")),
+            out_specs=(P(), P("expert"), P("expert")),
+            check_vma=False,
+        )
+    )
+    loss, out, exp_g = sharded(params["gate"], params["experts"], x, y_t)
+    out = np.asarray(out)
+
+    # exactly the last token per shard dropped, as zeros
+    assert np.abs(out[:, :capacity]).max() > 1e-3
+    np.testing.assert_array_equal(out[:, capacity:], 0.0)
+
+    # dense single-program oracle with the IDENTICAL cast contract: f32
+    # routing over the bf16-rounded tokens, bf16 expert compute, f32 combine
+    def dense(p):
+        loss_total = 0.0
+        for s in range(E):
+            xb = x[s].astype(jnp.bfloat16)
+            x32 = xb.astype(jnp.float32)
+            probs = jax.nn.softmax(x32 @ p["gate"], axis=-1)
+            top_p = jnp.take_along_axis(
+                probs, jnp.argmax(probs, -1)[:, None], axis=-1
+            )[:, 0]
+            keep = jnp.asarray(
+                [1.0] * capacity + [0.0] * (n_local - capacity), jnp.float32
+            )  # forced routing: token order IS slot order
+            ex = jax.tree.map(lambda a: a[0], p["experts"])  # expert 0
+            y = expert_fn(ex, xb).astype(jnp.float32)
+            out_s = y * (top_p * keep)[:, None]
+            f_e = jnp.zeros(E).at[0].set(1.0)
+            p_e = jnp.mean(probs, axis=0)
+            aux = E * jnp.sum(f_e * p_e)
+            loss_total = loss_total + jnp.mean(
+                (out_s.astype(jnp.bfloat16).astype(jnp.float32) - y_t[s]) ** 2
+            ) + 0.01 * aux
+        return loss_total / E
+
+    expect_loss, expect_grads = jax.value_and_grad(dense)(
+        {"gate": params["gate"], "experts": params["experts"]}
+    )
+    np.testing.assert_allclose(float(loss), float(expect_loss), rtol=1e-5)
+    for k in ("w", "v"):
+        np.testing.assert_allclose(
+            np.asarray(exp_g[k]), np.asarray(expect_grads["experts"][k]),
+            rtol=1e-4, atol=1e-5, err_msg=k,
+        )
+
+
 def test_token_slot_positions_are_int32():
     """Capacity slots are counted with an int32 cumsum: a float32 cumsum
     silently stops incrementing at 2^24 tokens per expert, which would

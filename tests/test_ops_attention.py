@@ -129,6 +129,60 @@ def test_mhsa_fused_equals_xla_path(rel):
     )
 
 
+def test_vmem_budget_guard_falls_back_at_large_l():
+    """L=1024 blows the per-tile VMEM estimate: the wrapper must fall back
+    to xla_attention (numerically identical, one warning, counter bumped)
+    instead of failing opaquely inside Mosaic."""
+    from distribuuuu_tpu.ops import attention
+
+    rng = np.random.default_rng(9)
+    l, d = 1024, 128  # both variants' estimates exceed the 12 MB budget here
+    q = jnp.asarray(rng.standard_normal((1, 1, l, d)) * 0.1, jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 1, l, d)) * 0.1, jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 1, l, d)), jnp.float32)
+    bias = jnp.asarray(rng.standard_normal((1, 1, l, l)) * 0.1, jnp.float32)
+    before = attention._VMEM_GUARD.fallbacks
+    got = fused_attention(q, k, v, bias, interpret=True)
+    assert attention._VMEM_GUARD.fallbacks == before + 1, "guard never fired"
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(xla_attention(q, k, v, bias)),
+        rtol=1e-6, atol=1e-6,
+    )
+    # the fallback path stays differentiable (it IS plain XLA)
+    g = jax.grad(
+        lambda *a: jnp.sum(fused_attention(*a, interpret=True) ** 2),
+        argnums=0,
+    )(q, k, v, bias)
+    assert bool(jnp.all(jnp.isfinite(g)))
+
+    # abs variant: same guard, fallback materializes the q·embᵀ bias
+    emb = jnp.asarray(rng.standard_normal((l, d)) * 0.1, jnp.float32)
+    before = attention._VMEM_GUARD.fallbacks
+    got_abs = fused_attention_abs(q, k, v, emb, interpret=True)
+    assert attention._VMEM_GUARD.fallbacks == before + 1
+    expect_abs = xla_attention(
+        q, k, v,
+        jnp.einsum("bnid,jd->bnij", q, emb, preferred_element_type=jnp.float32),
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_abs), np.asarray(expect_abs), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_vmem_budget_guard_keeps_kernel_at_botnet_shapes():
+    """L=196 (the shapes the kernel exists for) stays comfortably under the
+    budget — the guard must not regress the measured path."""
+    from distribuuuu_tpu.ops import attention
+
+    assert attention._tile_vmem_bytes(
+        196, 128, 128, 2, bias_input=True
+    ) < attention._VMEM_GUARD.budget_bytes()
+    q, k, v, bias = _inputs()
+    before = attention._VMEM_GUARD.fallbacks
+    fused_attention(q, k, v, bias, interpret=True)
+    assert attention._VMEM_GUARD.fallbacks == before
+
+
 def test_rectangular_dim_v():
     """dim_v != dim_qk must work on the fused path too."""
     rng = np.random.default_rng(2)

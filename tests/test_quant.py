@@ -1,0 +1,397 @@
+"""dtpu-quant: int8 PTQ units + the quantized serving path (docs/SERVING.md).
+
+Tiers:
+
+- **units** — per-channel weight roundtrip bound, gate verdict logic,
+  calibration structure discovery (sites, BN-fold adjacency, amax across
+  batches) on a purpose-built conv/BN/dense module. No zoo compiles.
+- **model tier** — int8 vs fp32 on the synthetic resnet18 the checked-in
+  golden fixture pins: quality gate passes at the default thresholds and
+  the int8 top-1s match the fixture's.
+- **engine tier** (module-scoped hosted engine) — a ``:int8`` spec hosts
+  through the AOT ladder: golden agreement, CompileGuard-pinned zero
+  steady-state compiles across mixed sizes, typed ``quant_quality`` +
+  ``serve_compile`` records, refuse-to-serve on a failing gate, and the
+  `obs summarize` serving section rendering both.
+"""
+
+import json
+import os
+import time
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures")
+
+from distribuuuu_tpu.convert import golden_inputs, synthetic_variables  # noqa: E402
+from distribuuuu_tpu.obs.journal import validate_record  # noqa: E402
+from distribuuuu_tpu.quant import (  # noqa: E402
+    calibrate,
+    compare_logits,
+    quantize,
+    quantize_weight,
+)
+
+IM, NC = 32, 8
+RN_SEED = 7  # must match tests/fixtures/golden_resnet18_s32.json
+
+
+# ---------------------------------------------------------------------------
+# units
+# ---------------------------------------------------------------------------
+
+def test_quantize_weight_roundtrip_bound_per_channel():
+    """|w - w_q·s| ≤ s/2 per channel — the symmetric-int8 roundtrip bound —
+    and the scale is exactly per-output-channel amax/127."""
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((3, 3, 4, 16)).astype(np.float32)
+    w[..., 3] *= 40.0  # one deliberately wild channel must not hurt others
+    w_q, scale = quantize_weight(w)
+    assert w_q.dtype == np.int8 and scale.shape == (16,)
+    np.testing.assert_allclose(
+        scale, np.abs(w).reshape(-1, 16).max(axis=0) / 127.0, rtol=1e-6
+    )
+    err = np.abs(w - w_q.astype(np.float32) * scale)
+    assert np.all(err <= scale / 2 + 1e-7), (
+        f"roundtrip error {err.max():.3e} exceeds the per-channel bound"
+    )
+    # int8 range actually used, symmetric (no zero-point)
+    assert w_q.max() == 127 or w_q.min() == -127
+
+
+def test_quantize_weight_zero_channel_stays_finite():
+    w = np.zeros((2, 2, 3, 4), np.float32)
+    w[..., 1] = 1.0
+    w_q, scale = quantize_weight(w)
+    assert np.all(np.isfinite(scale)) and np.all(scale > 0)
+    np.testing.assert_array_equal(w_q[..., 0], 0)
+
+
+def test_compare_logits_verdicts():
+    fp = np.asarray([[1.0, 0.0], [0.0, 1.0]], np.float32)
+    ok = compare_logits(fp, fp + 0.01, min_top1_agree=0.99, max_logit_rmse=0.25)
+    assert ok.passed and ok.top1_agree == 1.0
+    flipped = fp[:, ::-1].copy()
+    bad = compare_logits(fp, flipped, min_top1_agree=0.99, max_logit_rmse=10.0)
+    assert not bad.passed and bad.top1_agree == 0.0
+    drift = compare_logits(fp, fp + 5.0, min_top1_agree=0.5, max_logit_rmse=0.25)
+    assert not drift.passed and drift.logit_rmse == pytest.approx(5.0)
+    with pytest.raises(ValueError, match="shapes"):
+        compare_logits(fp, fp[:1], min_top1_agree=0.99, max_logit_rmse=0.25)
+
+
+class _ConvBnDense(nn.Module):
+    """conv→BN→relu→conv(pre-BN-free)→GAP→dense: one foldable BN, one not."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(8, (3, 3), padding=[(1, 1), (1, 1)], use_bias=False,
+                    name="conv1")(x)
+        x = nn.BatchNorm(use_running_average=not train, name="bn1")(x)
+        x = nn.relu(x)
+        x = nn.Conv(8, (3, 3), padding="SAME", name="conv2")(x)
+        x = nn.relu(x)  # relu between conv2 and bn2: NOT foldable
+        x = nn.BatchNorm(use_running_average=not train, name="bn2")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(4, name="fc")(x)
+
+
+def test_calibrate_discovers_sites_and_foldable_bn():
+    model = _ConvBnDense()
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)), train=False
+    )
+    rng = np.random.default_rng(1)
+    b1 = jnp.asarray(rng.standard_normal((2, 8, 8, 3)), jnp.float32)
+    b2 = jnp.asarray(3.0 * rng.standard_normal((2, 8, 8, 3)), jnp.float32)
+    sites = calibrate(model, dict(variables), [b1, b2])
+    assert set(sites) == {"conv1", "conv2", "fc"}
+    # only bn1 consumes its conv's output DIRECTLY (bn2 sees a relu output)
+    assert sites["conv1"].bn is not None and sites["conv1"].bn.path == ("bn1",)
+    assert sites["conv2"].bn is None
+    assert sites["fc"].kind == "dense" and sites["conv1"].kind == "conv"
+    # amax is the max over ALL calibration batches
+    assert sites["conv1"].amax == pytest.approx(
+        float(jnp.max(jnp.abs(b2))), rel=1e-6
+    )
+    qmodel, qparams = quantize(dict(variables), sites)
+    assert qmodel.folded == frozenset({"bn1"})
+    assert qparams["conv1"]["w_q"].dtype == jnp.int8
+    assert qparams["fc"]["scale"].shape == (4,)
+
+    # folded int8 forward == fp forward within PTQ tolerance (this tiny
+    # model's logits are O(1); the engine-tier gate measures the real zoo)
+    x = jnp.asarray(rng.standard_normal((4, 8, 8, 3)), jnp.float32)
+    fp = np.asarray(model.apply(variables, x, train=False))
+    q = np.asarray(qmodel.apply(model, dict(variables), qparams, x))
+    assert compare_logits(fp, q, min_top1_agree=0.99, max_logit_rmse=0.25).passed
+
+
+class _TappedConvBn(nn.Module):
+    """A branch taps the PRE-BN conv output (invisible to the module hook):
+    folding the BN would hand the tap post-BN values — must be rejected."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Conv(8, (1, 1), use_bias=False, name="conv")(x)
+        skip = h  # raw-op consumer of the pre-BN value
+        h = nn.BatchNorm(use_running_average=not train, name="bn")(h)
+        h = h + 2.0 * skip
+        return jnp.mean(h, axis=(1, 2)) @ jnp.ones((8, 4), jnp.float32)
+
+
+def test_fold_rejected_when_pre_bn_value_is_tapped():
+    model = _TappedConvBn()
+    key = jax.random.PRNGKey(1)
+    variables = model.init(key, jnp.zeros((1, 8, 8, 3)), train=False)
+    # non-trivial BN stats so the fold transformation is observable
+    variables = jax.tree.map(lambda a: a, variables)
+    variables = {
+        "params": variables["params"],
+        "batch_stats": jax.tree.map(
+            lambda a: a + 0.5, variables["batch_stats"]
+        ),
+    }
+    rng = np.random.default_rng(2)
+    batch = jnp.asarray(rng.standard_normal((2, 8, 8, 3)), jnp.float32)
+    sites = calibrate(model, variables, [batch])
+    # adjacency says foldable, the numeric fold check says NO
+    assert sites["conv"].bn is None, "unsound fold was not rejected"
+    qmodel, qparams = quantize(variables, sites)
+    assert qmodel.folded == frozenset()
+    # and the quantized model (BN left as an fp op) still tracks fp
+    fp = np.asarray(model.apply(variables, batch, train=False))
+    q = np.asarray(qmodel.apply(model, variables, qparams, batch))
+    assert compare_logits(fp, q, min_top1_agree=0.99, max_logit_rmse=0.25).passed
+
+
+# ---------------------------------------------------------------------------
+# model tier: the golden-fixture resnet18
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def rn18_quantized():
+    model_dtype = jnp.float32
+    from distribuuuu_tpu.models import build_model
+
+    model = build_model("resnet18", num_classes=NC, dtype=model_dtype)
+    v = synthetic_variables("resnet18", RN_SEED, IM, NC)
+    variables = {"params": v["params"], "batch_stats": v["batch_stats"]}
+    rng = np.random.default_rng(1234)
+    # 2 batches (not the serve default 4): eager calibration forwards are
+    # the tier-1 wall-clock cost here and the amax coverage is equivalent
+    batches = [
+        jnp.asarray(rng.standard_normal((8, IM, IM, 3)), jnp.float32)
+        for _ in range(2)
+    ]
+    sites = calibrate(model, variables, batches)
+    qmodel, qparams = quantize(variables, sites)
+    return model, variables, qmodel, qparams
+
+
+def test_rn18_int8_gate_passes_at_default_thresholds(rn18_quantized):
+    model, variables, qmodel, qparams = rn18_quantized
+    # every conv + the classifier quantized; every BN folded away
+    assert qmodel.n_quantized >= 20
+    assert len(qmodel.folded) >= 19
+    x = jnp.asarray(golden_inputs(16, IM, 0))
+    fp = np.asarray(model.apply(variables, x, train=False))
+    q_fn = jax.jit(lambda v_, qp, x_: qmodel.apply(model, v_, qp, x_))
+    q = np.asarray(q_fn(variables, qparams, x))
+    result = compare_logits(fp, q, min_top1_agree=0.99, max_logit_rmse=0.25)
+    assert result.passed, result
+    assert result.logit_rmse < 0.1  # headroom under the default threshold
+
+
+def test_rn18_int8_top1_matches_checked_in_golden(rn18_quantized):
+    """The acceptance chain: int8 top-1 == fp32 top-1 == the checked-in
+    golden fixture's top-1 on the fixture's own inputs."""
+    model, variables, qmodel, qparams = rn18_quantized
+    with open(os.path.join(FIXTURES, "golden_resnet18_s32.json")) as f:
+        gold = json.load(f)
+    assert gold["im_size"] == IM and gold["num_classes"] == NC
+    x = jnp.asarray(golden_inputs(gold["n"], IM, gold["input_seed"]))
+    q = np.asarray(qmodel.apply(model, variables, qparams, x))
+    want = np.asarray(gold["logits"], np.float32)
+    np.testing.assert_array_equal(q.argmax(1), want.argmax(1))
+
+
+# ---------------------------------------------------------------------------
+# engine tier: the :int8 serving path
+# ---------------------------------------------------------------------------
+
+def _save_weights(path, arch, init_seed):
+    import orbax.checkpoint as ocp
+
+    from distribuuuu_tpu import checkpoint as ckpt
+
+    variables = synthetic_variables(arch, init_seed, IM, NC)
+    ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).save(
+        os.path.abspath(str(path)),
+        {"params": variables["params"], "batch_stats": variables["batch_stats"]},
+        force=True,
+    )
+    ckpt.write_manifest(str(path))
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def int8_engine(tmp_path_factory):
+    from distribuuuu_tpu.runtime import data_mesh
+    from distribuuuu_tpu.serve.engine import InferenceEngine, parse_model_specs
+
+    tmp = tmp_path_factory.mktemp("quant_engine")
+    weights = _save_weights(tmp / "rn18", "resnet18", RN_SEED)
+    events = []
+
+    def sink(kind, **fields):
+        events.append({"kind": kind, "ts": time.time(), **fields})
+
+    engine = InferenceEngine(
+        data_mesh(-1),
+        batch_sizes=[1, 4],
+        im_size=IM,
+        num_classes=NC,
+        input_dtype="float32",
+        compute_dtype="float32",
+        journal_event=sink,
+        # default thresholds, leaner calibration (tier-1 wall clock)
+        quant_cfg={"calib_batches": 2},
+    )
+    spec = parse_model_specs([f"rn8=resnet18@{weights}:int8"])[0]
+    engine.load(spec)
+    return engine, events, weights
+
+
+def test_spec_suffix_parses_and_gs_paths_survive():
+    from distribuuuu_tpu.serve.engine import parse_model_specs
+
+    specs = parse_model_specs(
+        ["a=resnet18@/w/a:int8", "b=vit_s16@gs://bucket/w", "c=resnet50@/w/c"]
+    )
+    assert specs[0].quant == "int8" and specs[0].weights == "/w/a"
+    assert specs[1].quant == "" and specs[1].weights == "gs://bucket/w"
+    assert specs[2].quant == ""
+    # an unknown suffix is part of the path, not silently a quant mode
+    (odd,) = parse_model_specs(["d=resnet18@/w/d:int4"])
+    assert odd.quant == "" and odd.weights == "/w/d:int4"
+
+
+def test_int8_engine_passes_gate_and_journals(int8_engine):
+    engine, events, _ = int8_engine
+    hosted = engine.models["rn8"]
+    assert hosted.spec.quant == "int8"
+    assert hosted.gate is not None and hosted.gate.passed
+    (qq,) = [e for e in events if e["kind"] == "quant_quality"]
+    assert qq["passed"] is True and qq["mode"] == "int8"
+    assert qq["layers"] >= 20 and qq["folded_bn"] >= 19
+    compiles = [e for e in events if e["kind"] == "serve_compile"]
+    assert [c["batch_size"] for c in compiles] == [1, 4]
+    assert all(c["quant"] == "int8" and c["model"] == "rn8" for c in compiles)
+    for e in events:
+        assert validate_record(e) == [], e
+
+
+def test_int8_engine_golden_agreement_and_zero_recompiles(int8_engine):
+    from distribuuuu_tpu.analysis.guards import CompileGuard
+
+    engine, _, _ = int8_engine
+    engine.warmup()
+    with open(os.path.join(FIXTURES, "golden_resnet18_s32.json")) as f:
+        gold = json.load(f)
+    want = np.asarray(gold["logits"], np.float32)
+    with CompileGuard(exact=0, name="int8 serve steady state") as guard:
+        x = golden_inputs(gold["n"], IM, gold["input_seed"])
+        got = engine.forward("rn8", np.asarray(x))
+        # ≥ 99% top-1 agreement with the fp32 golden fixture (here: exact)
+        np.testing.assert_array_equal(got.argmax(1), want.argmax(1))
+        for i, n in enumerate((1, 4, 1, 4)):  # mixed ladder sizes
+            xi = np.asarray(golden_inputs(n, IM, i + 10))
+            assert engine.forward("rn8", xi).shape == (n, NC)
+    assert guard.compiles == 0
+
+
+def test_int8_engine_logits_close_to_fp(int8_engine):
+    """The served int8 logits vs a direct fp32 forward of the same weights:
+    the engine-level restatement of the gate (RMSE under threshold). The fp
+    oracle is re-derived from the seed — the hosted tree is pruned."""
+    from distribuuuu_tpu.models import build_model
+
+    engine, _, _ = int8_engine
+    model = build_model("resnet18", num_classes=NC, dtype=jnp.float32)
+    v = synthetic_variables("resnet18", RN_SEED, IM, NC)
+    x = golden_inputs(4, IM, 42)
+    fp_fn = jax.jit(
+        lambda p, s, x_: model.apply(
+            {"params": p, "batch_stats": s}, x_, train=False
+        ).astype(jnp.float32)
+    )
+    fp = np.asarray(fp_fn(v["params"], v["batch_stats"], jnp.asarray(x)))
+    got = engine.forward("rn8", np.asarray(x))
+    result = compare_logits(fp, got, min_top1_agree=0.99, max_logit_rmse=0.25)
+    assert result.passed, result
+
+
+def test_int8_engine_prunes_dead_fp_weights(int8_engine):
+    """The int8 host must not keep the fp model resident next to qparams:
+    quantized kernels and folded BN params are pruned from the hosted tree
+    (everything the interception forward never reads)."""
+    engine, _, _ = int8_engine
+    hosted = engine.models["rn8"]
+    leaves = [
+        "/".join(str(k) for k in path)
+        for path, _ in jax.tree_util.tree_flatten_with_path(hosted.params)[0]
+    ]
+    # resnet18 quantizes every conv + the fc and folds every BN, so the
+    # pruned fp tree holds no kernels and no BN arrays at all
+    assert not any("kernel" in k for k in leaves), sorted(leaves)[:5]
+    assert jax.tree.leaves(hosted.batch_stats) == []
+
+
+def test_failing_gate_refuses_to_serve(tmp_path):
+    from distribuuuu_tpu.runtime import data_mesh
+    from distribuuuu_tpu.serve.engine import InferenceEngine, parse_model_specs
+
+    weights = _save_weights(tmp_path / "rn18", "resnet18", RN_SEED)
+    events = []
+
+    def sink(kind, **fields):
+        events.append({"kind": kind, **fields})
+
+    engine = InferenceEngine(
+        data_mesh(-1),
+        batch_sizes=[1],
+        im_size=IM,
+        num_classes=NC,
+        input_dtype="float32",
+        compute_dtype="float32",
+        journal_event=sink,
+        # unsatisfiable threshold on purpose; minimal calibration/gate cost
+        quant_cfg={
+            "max_logit_rmse": 1e-9,
+            "calib_batches": 1,
+            "calib_batch_size": 4,
+            "gate_n": 4,
+        },
+    )
+    spec = parse_model_specs([f"rn8=resnet18@{weights}:int8"])[0]
+    with pytest.raises(RuntimeError, match="refusing to serve"):
+        engine.load(spec)
+    assert "rn8" not in engine.models
+    (qq,) = [e for e in events if e["kind"] == "quant_quality"]
+    assert qq["passed"] is False  # the failed measurement is still journaled
+
+
+def test_summarize_renders_quant_and_compile_lines(int8_engine):
+    from distribuuuu_tpu.obs.summarize import render
+
+    _, events, _ = int8_engine
+    report = render(list(events))
+    assert "quant[rn8]: int8 top-1 agree" in report
+    assert "PASSED" in report
+    assert "compile[rn8] (int8): b1" in report

@@ -501,11 +501,18 @@ def test_http_round_trip_and_journal(served):
     start = _by_kind(recs, "serve_start")[-1]
     assert start["batch_sizes"] == LADDER and start["aot_compiles"] == 2 * len(LADDER)
     assert _by_kind(recs, "serve_batch") and _by_kind(recs, "serve_request")
+    # every (model, ladder size) AOT compile journaled its wall time
+    compiles = _by_kind(recs, "serve_compile")
+    assert sorted((r["model"], r["batch_size"]) for r in compiles) == sorted(
+        (m, b) for m in ("rn18", "vit") for b in LADDER
+    )
+    assert all(r["wall_s"] >= 0 for r in compiles)
     slo = _by_kind(recs, "serve_slo")
     assert {r["model"] for r in slo} >= {"rn18", "vit"}
     report = render(recs)
     assert "serving: replica" in report
     assert "rn18:" in report and "p99" in report and "batch fill" in report
+    assert "compile[rn18]:" in report  # the serving compile column
 
 
 # ---------------------------------------------------------------------------
