@@ -818,10 +818,66 @@ class Agent:
     # -- the supervision loop ------------------------------------------------
 
     def run(self) -> int:
-        if self.fleet_host is not None:
-            return self.run_fleet_host()
-        if self.serve:
-            return self.run_serve()
+        # live telemetry plane (dtpu-obs v2): OBS.METRICS_PORT > 0 embeds a
+        # /metrics exporter + OBS.ALARMS evaluation over the journal this
+        # agent already heartbeat-watches — a supervised run gets live
+        # metrics without the export sidecar. Fleet-managed hosts skip it
+        # (the controller owns the pool's plane).
+        obs_plane = self._start_obs_plane() if self.fleet_host is None else None
+        try:
+            if self.fleet_host is not None:
+                return self.run_fleet_host()
+            if self.serve:
+                return self.run_serve()
+            return self._run_train()
+        finally:
+            if obs_plane is not None:
+                obs_plane.stop()
+
+    def _start_obs_plane(self):
+        """An embedded ObsPlane when OBS.METRICS_PORT is set, else None.
+
+        Alarm records ride their own ``.part<4001>`` supervisory
+        continuation: the training-mode SupervisorJournal shares the
+        workers' main journal file and only writes between attempts, but an
+        alarm can fire mid-attempt — a separate single-writer part keeps
+        the append discipline intact.
+        """
+        if int(cfg.OBS.METRICS_PORT) <= 0:
+            return None
+        alarm_journal = None
+        try:
+            from distribuuuu_tpu.obs.exporter import AGENT_PART, ObsPlane
+
+            alarm_journal = SupervisorJournal(cfg.OUT_DIR, part=AGENT_PART)
+            # serve mode: the plane aggregates + exports only — each
+            # replica's in-process engine already evaluates the same rules
+            # over the same serve_slo records, and a second engine here
+            # would journal duplicate alarm/alarm_clear transitions per
+            # breach (and double-fire any hook). Mirrors the fleet rule:
+            # one alarm engine per journal's records.
+            from distribuuuu_tpu.obs.alarms import AlarmEngine
+
+            plane = ObsPlane(
+                self._hb_path or (alarm_journal.path or ""),
+                alarm_event=alarm_journal.event,
+                alarm_engine=AlarmEngine([]) if self.serve else None,
+                port=int(cfg.OBS.METRICS_PORT),
+                host=str(cfg.OBS.METRICS_HOST),
+                interval_s=float(cfg.OBS.TAIL_INTERVAL_S),
+            )
+            plane.own(alarm_journal)
+            return plane.start()
+        except Exception as exc:
+            # e.g. METRICS_PORT already bound by a sidecar on this host;
+            # the already-opened part file must not leak for the life of
+            # the supervisor
+            if alarm_journal is not None:
+                alarm_journal.close()
+            logger.warning(f"agent: obs plane unavailable: {exc!r}")
+            return None
+
+    def _run_train(self) -> int:
         a = cfg.AGENT
         self._install_signals()
         tic = time.time()

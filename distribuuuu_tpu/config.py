@@ -213,6 +213,36 @@ _C.OBS.PROFILE_SIGUSR1 = True
 _C.OBS.PROFILE_TOP_OPS = 20
 # Live-array/HBM snapshot journaled at each epoch boundary.
 _C.OBS.MEMORY_SNAPSHOTS = True
+# Train-side tracing (obs/trace.py): journal typed `span` records per
+# PRINT_FREQ window (data-wait + compute phases, from the values the window
+# fetch already holds — zero added syncs) and per checkpoint dispatch.
+_C.OBS.TRAIN_SPANS = True
+# Declarative alarm rules (obs/alarms.py) evaluated by the live aggregator
+# (the export sidecar, the serve frontend, the fleet controller — never the
+# training process itself). Syntax: "name=metric<threshold" or
+# "name=metric>threshold", with an optional ":for=N" hysteresis suffix
+# (fire after N consecutive breaching evaluations; clear after N consecutive
+# healthy ones). Per-model serve metrics (serve_p99_ms, serve_qps,
+# serve_shed, serve_queue_depth) evaluate per hosted model. Fires/clears are
+# journaled as typed alarm/alarm_clear records and invoke registered hooks
+# (the fleet controller's hook journals fleet_alarm — the autoscaler
+# trigger, docs/OBSERVABILITY.md "Alarms").
+_C.OBS.ALARMS = [
+    "goodput_floor=goodput<0.1:for=3",
+    "data_wait_ceiling=data_wait_frac>0.5:for=3",
+    "heartbeat_stale=heartbeat_age_s>300",
+    "skip_streak=consecutive_skips>3",
+]
+# Standalone Prometheus /metrics exporter port for supervisory processes
+# (dtpu-agent, dtpu-fleet) and the default for the export sidecar
+# (`python -m distribuuuu_tpu.obs export`). 0 disables the embedded
+# exporter in agent/fleet; the serve frontend's /metrics rides its existing
+# HTTP port and needs no extra port. HOST defaults to loopback — set
+# "0.0.0.0" for a central Prometheus server to scrape across hosts.
+_C.OBS.METRICS_PORT = 0
+_C.OBS.METRICS_HOST = "127.0.0.1"
+# Journal tail cadence for the live aggregators (sidecar / fleet / agent).
+_C.OBS.TAIL_INTERVAL_S = 2.0
 
 # In-job supervision (TPU addition; docs/FAULT_TOLERANCE.md "Supervised
 # runs"). `python -m distribuuuu_tpu.agent --cfg ...` launches the training
@@ -340,6 +370,11 @@ _C.SERVE.VERIFY_INTEGRITY = True
 # exact but heavy; turn off for high-QPS deployments and keep the slo rollup.
 _C.SERVE.SLO_WINDOW_S = 10.0
 _C.SERVE.JOURNAL_REQUESTS = True
+# Request tracing (obs/trace.py): journal typed `span` records per request
+# (queue-wait / pad / execute / total) under the client-minted
+# x-dtpu-trace-id. Same volume class as JOURNAL_REQUESTS — turn off for
+# high-QPS deployments and keep the slo rollup.
+_C.SERVE.TRACE_SPANS = True
 
 # Post-training int8 quantization (dtpu-quant; docs/PERFORMANCE.md,
 # docs/SERVING.md "Serving int8"). A hosted model opts in per entry:

@@ -570,7 +570,15 @@ def prefetch_to_device(iterator, mesh, prefetch: int = 2):
     t.start()
     try:
         while True:
+            # producer-starvation wall: how long the STEP LOOP sat here
+            # waiting for a device batch. Fed to telemetry as the
+            # ``data_wait_s`` counter, whose per-window delta becomes the
+            # window record's ``data_wait_frac`` — the data-wait alarm's
+            # signal (docs/OBSERVABILITY.md). Host clock around a queue get:
+            # no device sync.
+            t_wait = time.monotonic()
             item = q.get()
+            obs.current().add_wait("data_wait_s", time.monotonic() - t_wait)
             if item is done:
                 break
             if isinstance(item, BaseException):

@@ -943,6 +943,51 @@ class FleetQueue:
         except ValueError:  # pragma: no cover - embedded (non-main-thread)
             logger.warning("fleet: signal handling not installed (not main thread)")
 
+    # -- live telemetry plane (dtpu-obs v2) ----------------------------------
+
+    def _start_obs_plane(self):
+        """Tail the pool journal into a live aggregator, evaluate the
+        OBS.ALARMS rules, and (OBS.METRICS_PORT > 0) serve ``/metrics``.
+
+        The controller's registered alarm hook relays every fire/clear as a
+        typed ``fleet_alarm`` record into its own journal part — the trigger
+        the SLO autoscaler will act on; today the controller only records
+        it. The plane observes; it must never take down the pool.
+        """
+        try:
+            from distribuuuu_tpu.obs.exporter import ObsPlane
+
+            path = _journal_path(cfg.OUT_DIR)
+            if path is None:
+                return None
+            port = int(cfg.OBS.METRICS_PORT)
+            plane = ObsPlane(
+                path,
+                alarm_event=self.journal.event,
+                port=port if port > 0 else None,
+                host=str(cfg.OBS.METRICS_HOST),
+                interval_s=float(cfg.OBS.TAIL_INTERVAL_S),
+            )
+            plane.register_alarm_hook(self._on_alarm)
+            return plane.start()
+        except Exception as exc:
+            logger.warning(f"fleet: obs plane unavailable: {exc!r}")
+            return None
+
+    def _on_alarm(self, transition: dict) -> None:
+        active = self._active
+        fields = {
+            "rule": str(transition.get("rule", "?")),
+            "metric": str(transition.get("metric", "?")),
+            "value": float(transition.get("value", 0.0)),
+            "threshold": float(transition.get("threshold", 0.0)),
+            "state": "fire" if transition.get("kind") == "alarm" else "clear",
+            "job": active.job.name if active is not None else "",
+        }
+        if transition.get("model"):
+            fields["model"] = str(transition["model"])
+        self.journal.event("fleet_alarm", **fields)
+
     def run(self) -> int:
         from distribuuuu_tpu.runtime import pathio
 
@@ -964,6 +1009,7 @@ class FleetQueue:
             f"rank(s), rendezvous at {self.rdzv.address}, "
             f"{len(self.jobs)} job(s) queued"
         )
+        obs_plane = self._start_obs_plane()
         rc = 0
         try:
             while self.jobs and not self._stop.is_set():
@@ -1018,6 +1064,8 @@ class FleetQueue:
                 elif verdict != "clean":
                     rc = 1
         finally:
+            if obs_plane is not None:
+                obs_plane.stop()
             self.rdzv.close()
             self.journal.close()
         if self._stop.is_set():

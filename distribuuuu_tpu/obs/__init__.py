@@ -16,9 +16,24 @@ The observable surface of the framework, in one subsystem:
 - **Profiler windows** (`obs.profiler` + `obs.traceparse`): config- and
   SIGUSR1-driven `jax.profiler` captures with the per-op device-time table
   journaled.
-- **CLI** (`obs.__main__`): ``python -m distribuuuu_tpu.obs summarize|validate``.
+- **Live telemetry plane** (dtpu-obs v2): incremental journal tailing +
+  current-state aggregation (`obs.stream`), Prometheus ``/metrics``
+  exporters + the embeddable `ObsPlane` (`obs.exporter`), request/step
+  tracing (`obs.trace`), and the declarative alarm engine (`obs.alarms`).
+- **CLI** (`obs.__main__`): ``python -m distribuuuu_tpu.obs
+  summarize|validate|export``.
 """
 
+from distribuuuu_tpu.obs.alarms import (  # noqa: F401
+    AlarmEngine,
+    AlarmRule,
+    parse_alarm_rules,
+)
+from distribuuuu_tpu.obs.exporter import (  # noqa: F401
+    MetricsServer,
+    ObsPlane,
+    render_prometheus,
+)
 from distribuuuu_tpu.obs.journal import (  # noqa: F401
     Journal,
     read_journal,
@@ -31,6 +46,10 @@ from distribuuuu_tpu.obs.profiler import (  # noqa: F401
     ProfilerWindows,
     install_sigusr1_handler,
     request_profile,
+)
+from distribuuuu_tpu.obs.stream import (  # noqa: F401
+    JournalTailer,
+    LiveAggregator,
 )
 from distribuuuu_tpu.obs.telemetry import (  # noqa: F401
     NullTelemetry,

@@ -95,6 +95,10 @@ SCHEMA: dict[str, tuple[dict[str, tuple], dict[str, tuple]]] = {
             "step_time_p50": _NUM,
             "step_time_p90": _NUM,
             "step_time_max": _NUM,
+            # producer-starvation time / window wall: how much of this
+            # window the step loop spent blocked on the input pipeline
+            # (the data-wait alarm's signal)
+            "data_wait_frac": _NUM,
         },
     ),
     "epoch_train": (
@@ -264,7 +268,7 @@ SCHEMA: dict[str, tuple[dict[str, tuple], dict[str, tuple]]] = {
     # one served request (SERVE.JOURNAL_REQUESTS; the slo rollup is always on)
     "serve_request": (
         {"model": _STR, "n": _INT, "latency_ms": _NUM, "ok": _BOOL},
-        {"queue_ms": _NUM},
+        {"queue_ms": _NUM, "trace_id": _STR},
     ),
     # one dispatched micro-batch: examples packed, compiled size chosen,
     # fill = examples/batch_size (the padding waste the ladder sizing tunes)
@@ -292,7 +296,8 @@ SCHEMA: dict[str, tuple[dict[str, tuple], dict[str, tuple]]] = {
             "p50_ms": _NUM,
             "p99_ms": _NUM,
         },
-        {"examples": _INT, "mean_fill": _NUM, "fill_hist": _DICT, "batches": _INT},
+        {"examples": _INT, "mean_fill": _NUM, "fill_hist": _DICT,
+         "batches": _INT, "queue_depth": _INT, "replica": _INT},
     ),
     # backpressure: a request was shed at the bounded queue (never silent)
     "serve_shed": (
@@ -325,6 +330,45 @@ SCHEMA: dict[str, tuple[dict[str, tuple], dict[str, tuple]]] = {
             "folded_bn": _INT,
             "wall_s": _NUM,
         },
+    ),
+    # tracing (dtpu-obs v2, obs/trace.py) ---------------------------------
+    # one timed phase of a traced request or train window, keyed by the
+    # trace id that ties the phases together: serve requests carry the
+    # client-minted ``x-dtpu-trace-id`` through frontend -> batcher ->
+    # engine (phases queue_wait / pad / execute / total); train windows
+    # mint ``train-<run>-g<gstep>`` ids (phases data_wait / compute) and
+    # checkpoint dispatches ``train-<run>-ck<epoch>`` (phase checkpoint)
+    "span": (
+        {"trace_id": _STR, "phase": _STR, "ms": _NUM},
+        {
+            "model": _STR,
+            "n": _INT,
+            "batch_size": _INT,
+            "requests": _INT,
+            "gstep": _INT,
+            "epoch": _INT,
+            "ok": _BOOL,
+        },
+    ),
+    # alarms (dtpu-obs v2, obs/alarms.py): a declarative rule (OBS.ALARMS)
+    # crossed its threshold for the configured hysteresis window...
+    "alarm": (
+        {"rule": _STR, "metric": _STR, "value": _NUM, "threshold": _NUM,
+         "op": _STR},
+        {"model": _STR, "windows": _INT},
+    ),
+    # ... and recovered (active_s = how long the alarm was firing)
+    "alarm_clear": (
+        {"rule": _STR, "metric": _STR, "value": _NUM, "threshold": _NUM},
+        {"model": _STR, "active_s": _NUM},
+    ),
+    # the fleet controller's registered alarm hook: the same transition,
+    # journaled from the controller's part so PR-12's autoscaler has its
+    # trigger record (state is fire|clear; no action is taken yet)
+    "fleet_alarm": (
+        {"rule": _STR, "metric": _STR, "value": _NUM, "threshold": _NUM,
+         "state": _STR},
+        {"model": _STR, "job": _STR},
     ),
     # counters / memory / profiler ---------------------------------------
     "counters": (
@@ -402,7 +446,8 @@ def _journal_parts(path: str) -> list[str]:
 
     Suffixes may nest: a *supervisory* journal is itself a part file
     (``.part2001`` for fleet host 1, ``.part3000`` for the controller,
-    ``.part1000+R`` for serve replicas), and on a remote OUT_DIR its own
+    ``.part1000+R`` for serve replicas, ``.part4000`` for the export
+    sidecar's alarm records), and on a remote OUT_DIR its own
     commit/reopen continuations land at ``.part2001.part1``, ``...part2``
     (object stores have no append — `Journal` opens the next part). Each
     dot-separated number chain sorts as a tuple, so nested continuations

@@ -419,6 +419,104 @@ def render(records: Iterable[dict]) -> str:
                 f"batch fill {100.0 * mean_fill:.0f}% [{hist_s or 'no batches'}]"
             )
 
+    # -- tracing (dtpu-obs v2: span records) --------------------------------
+    # per-phase totals plus the critical path of the slowest traces — the
+    # "where did the milliseconds go" view, reconstructed from the journal
+    # alone. Omitted when no spans were journaled, so older reports (and
+    # the golden test) are unchanged.
+    if by_kind["span"]:
+        out("")
+        out("tracing:")
+        by_phase: dict[str, list[float]] = defaultdict(list)
+        by_trace: dict[str, list[dict]] = defaultdict(list)
+        for s in by_kind["span"]:
+            by_phase[s.get("phase", "?")].append(float(s.get("ms", 0.0)))
+            by_trace[s.get("trace_id", "?")].append(s)
+        out("  phase      | spans |   p50 ms |   max ms | total")
+        for phase in sorted(by_phase):
+            vals = sorted(by_phase[phase])
+            out(
+                f"  {phase:<10} | {len(vals):5d} | {_median(vals):8.1f} | "
+                f"{vals[-1]:8.1f} | {_fmt_s(sum(vals) / 1000.0)}"
+            )
+
+        def trace_wall(spans: list[dict]) -> float:
+            # a request's "total" span IS its wall; phase sums otherwise
+            totals = [s["ms"] for s in spans if s.get("phase") == "total"]
+            return float(max(totals) if totals else sum(s.get("ms", 0.0) for s in spans))
+
+        slowest = sorted(by_trace.items(), key=lambda kv: -trace_wall(kv[1]))[:3]
+        for trace_id, spans in slowest:
+            phases = ", ".join(
+                f"{s.get('phase', '?')} {s.get('ms', 0.0):.1f}ms"
+                for s in sorted(spans, key=lambda s: s.get("ts", 0.0))
+            )
+            model = next((s["model"] for s in spans if s.get("model")), None)
+            out(
+                f"  slowest trace {trace_id}"
+                + (f" [{model}]" if model else "")
+                + f": {trace_wall(spans):.1f}ms ({phases})"
+            )
+
+    # -- alarms (dtpu-obs v2: declarative rules over the live aggregate) -----
+    if by_kind["alarm"] or by_kind["alarm_clear"] or by_kind["fleet_alarm"]:
+        out("")
+        # pair chronologically per (rule, model): a clear belongs to the
+        # fire it directly follows. One ENGINE alternates fire -> clear
+        # strictly, but an engine that dies while an alarm is active leaves
+        # an unpaired fire behind (its restart fires afresh) — index-based
+        # pairing would hand the eventual clear to the wrong firing.
+        clears_by_key: dict[tuple, list[dict]] = defaultdict(list)
+        for r in by_kind["alarm_clear"]:
+            clears_by_key[(r.get("rule"), r.get("model"))].append(r)
+        for clears in clears_by_key.values():
+            clears.sort(key=lambda r: r.get("ts", 0.0))
+        fires_by_key: dict[tuple, list[dict]] = defaultdict(list)
+        for r in by_kind["alarm"]:
+            fires_by_key[(r.get("rule"), r.get("model"))].append(r)
+        for fires in fires_by_key.values():
+            fires.sort(key=lambda r: r.get("ts", 0.0))
+
+        def fire_status(key: tuple, r: dict) -> str:
+            fires = fires_by_key[key]
+            i = fires.index(r)
+            t0 = r.get("ts", 0.0)
+            t1 = (
+                fires[i + 1].get("ts", float("inf"))
+                if i + 1 < len(fires)
+                else float("inf")
+            )
+            clear = next(
+                (c for c in clears_by_key[key] if t0 <= c.get("ts", 0.0) < t1),
+                None,
+            )
+            if clear is not None:
+                return f"cleared after {clear.get('active_s', 0.0):.0f}s"
+            if t1 != float("inf"):
+                # re-fired without a recorded clear: the firing engine died
+                # while active — the state was lost, not resolved
+                return "no clear recorded (engine restarted?)"
+            return "STILL ACTIVE at journal end"
+
+        out(
+            f"alarms: {len(by_kind['alarm'])} fired, "
+            f"{len(by_kind['alarm_clear'])} cleared"
+            + (
+                f", {len(by_kind['fleet_alarm'])} relayed to the fleet "
+                f"controller"
+                if by_kind["fleet_alarm"]
+                else ""
+            )
+        )
+        for r in by_kind["alarm"]:
+            key = (r.get("rule"), r.get("model"))
+            model_s = f"[{r['model']}]" if r.get("model") else ""
+            out(
+                f"  {r.get('rule', '?')}{model_s}: {r.get('metric', '?')} "
+                f"{r.get('value', 0.0):.4g} {r.get('op', '?')} "
+                f"{r.get('threshold', 0.0):.4g} — {fire_status(key, r)}"
+            )
+
     # -- checkpoints ---------------------------------------------------------
     saves = [r for r in by_kind["checkpoint"] if r.get("ckpt_kind") != "emergency"]
     if saves or by_kind["restore"]:

@@ -74,6 +74,12 @@ class NullTelemetry:
     def event(self, kind: str, **fields) -> None:
         pass
 
+    def span(self, trace_id: str, phase: str, ms: float, **fields) -> None:
+        pass
+
+    def trace_tag(self, tag: str) -> str:
+        return ""
+
     def add_wait(self, name: str, seconds: float) -> None:
         pass
 
@@ -141,7 +147,13 @@ class Telemetry:
         self._epoch_mark = self.bridge.snapshot()
         self._waits: dict[str, float] = {}
         self._waits_mark: dict[str, float] = {}
+        # separate per-WINDOW marks (data_wait_frac) so the per-epoch
+        # counters delta above is undisturbed
+        self._win_waits_mark: dict[str, float] = {}
         self._wait_lock = threading.Lock()
+        # run-scoped trace tag for train-side spans (obs/trace.py)
+        self._trace = uuid.uuid4().hex[:8]
+        self._train_spans = bool(oc.TRAIN_SPANS) if oc is not None else True
 
     # -- journal ------------------------------------------------------------
 
@@ -156,6 +168,18 @@ class Telemetry:
             logger.error(f"telemetry: invalid {kind!r} record dropped: {errors}")
             return
         self.journal.append(record)
+
+    # -- tracing -------------------------------------------------------------
+
+    def trace_tag(self, tag: str) -> str:
+        """A run-scoped trace id for train-side spans (``train-<run>-<tag>``)."""
+        return f"train-{self._trace}-{tag}"
+
+    def span(self, trace_id: str, phase: str, ms: float, **fields) -> None:
+        """One typed ``span`` record (obs/trace.py; host wall only)."""
+        from distribuuuu_tpu.obs import trace as _trace
+
+        self.event("span", **_trace.span_fields(trace_id, phase, ms, **fields))
 
     # -- cross-thread counters ----------------------------------------------
 
@@ -174,6 +198,15 @@ class Telemetry:
             }
             self._waits_mark = dict(self._waits)
         return delta
+
+    def _window_wait_delta(self, name: str) -> float:
+        """Per-window delta of one wait counter (window-scoped marks — the
+        per-epoch ``counters`` delta keeps its own)."""
+        with self._wait_lock:
+            total = self._waits.get(name, 0.0)
+            delta = total - self._win_waits_mark.get(name, 0.0)
+            self._win_waits_mark[name] = total
+        return max(0.0, delta)
 
     # -- step cost / MFU -----------------------------------------------------
 
@@ -205,6 +238,13 @@ class Telemetry:
     def epoch_start(self, epoch: int) -> None:
         self._epoch_step_times = []
         self._epoch_mark = self.bridge.snapshot()
+        # rebase the per-WINDOW wait marks: the eval loop rides the same
+        # prefetch_to_device consumer and its q.get() waits land in the
+        # run-global counters — without the rebase the whole inter-epoch
+        # eval wait would be billed to the next epoch's first window as a
+        # false data_wait_frac=1.0 starvation signal
+        with self._wait_lock:
+            self._win_waits_mark = dict(self._waits)
 
     def window(
         self,
@@ -239,6 +279,12 @@ class Telemetry:
             if not warmup
             else None
         )
+        # producer-starvation fraction: time the step loop spent blocked on
+        # q.get() in prefetch_to_device (the ``data_wait_s`` counter the
+        # loader feeds from the consumer thread) over this window's wall —
+        # the data-wait alarm's signal, measured where the stall is felt
+        data_wait_s = self._window_wait_delta("data_wait_s")
+        data_wait_frac = min(1.0, data_wait_s / wall_s)
         self.event(
             "window",
             epoch=epoch,
@@ -252,6 +298,7 @@ class Telemetry:
             step_time_p90=round(_percentile(times, 0.90), 6),
             step_time_max=round(times[-1], 6),
             data_time=round(float(data_time), 6),
+            data_wait_frac=round(data_wait_frac, 6),
             imgs_per_sec=round(imgs / wall_s, 3),
             goodput=round(self.goodput(), 6),
             mfu=round(mfu_val, 6) if mfu_val is not None else None,
@@ -261,6 +308,15 @@ class Telemetry:
             acc1=float(acc1) if acc1 is not None else None,
             acck=float(acck) if acck is not None else None,
         )
+        if self._train_spans:
+            # the window IS the trace: its wall splits into the time spent
+            # blocked on data and everything else (compute + dispatch) —
+            # both derived from values already on the host, zero syncs
+            tid = self.trace_tag(f"g{gstep}")
+            self.span(tid, "data_wait", 1000.0 * data_wait_s,
+                      gstep=gstep, epoch=epoch)
+            self.span(tid, "compute", 1000.0 * max(0.0, wall_s - data_wait_s),
+                      gstep=gstep, epoch=epoch)
 
     def epoch_end(
         self, *, epoch: int, steps: int, skipped: int, wall_s: float, imgs: float

@@ -1,0 +1,67 @@
+"""Request/step tracing: trace ids + typed ``span`` journal records.
+
+A *trace* is one unit of work whose phases should add up to an explainable
+wall time — one served request (queue-wait → pad → device execute → total)
+or one train PRINT_FREQ window (data-wait → compute, plus the checkpoint
+dispatch at epoch boundaries). Every phase lands as a ``span`` record keyed
+by the trace id, so ``obs summarize`` can reconstruct the critical path of
+the slowest traces from the journal alone.
+
+Propagation contract (docs/OBSERVABILITY.md "Tracing"):
+
+- The serve client mints the id (`mint_trace_id`) and sends it as the
+  ``x-dtpu-trace-id`` header; **retries reuse the same id**, so a request
+  that survived a replica kill reads as one trace with several attempts.
+- The frontend validates the header (`ensure_trace_id` mints one for
+  header-less callers), threads it through the batcher to the engine
+  dispatch, and echoes it back in the response.
+- Train-side ids are minted per window by `Telemetry.window`
+  (``train-<run>-g<gstep>``) — no propagation needed, the run is the trace
+  scope.
+
+Spans carry host-measured wall times only — tracing adds zero device syncs
+(the execute span is timed around the engine call whose result fetch *is*
+the response payload; train spans reuse the PRINT_FREQ boundary fetch).
+"""
+
+from __future__ import annotations
+
+import re
+import uuid
+
+#: HTTP header carrying the trace id end-to-end (client -> frontend).
+TRACE_HEADER = "x-dtpu-trace-id"
+
+# ids are log- and label-safe by construction; anything else is replaced
+# (a hostile header must not be able to inject journal/Prometheus syntax)
+_TRACE_ID_RE = re.compile(r"^[A-Za-z0-9._\-]{1,128}$")
+
+#: span phases of one served request, in causal order
+SERVE_PHASES = ("queue_wait", "pad", "execute", "total")
+#: span phases of one train window / epoch boundary
+TRAIN_PHASES = ("data_wait", "compute", "checkpoint")
+
+
+def mint_trace_id() -> str:
+    """A fresh 16-hex-char trace id (collision-safe at journal scale)."""
+    return uuid.uuid4().hex[:16]
+
+
+def valid_trace_id(trace_id) -> bool:
+    return isinstance(trace_id, str) and bool(_TRACE_ID_RE.match(trace_id))
+
+
+def ensure_trace_id(trace_id) -> str:
+    """The given id when well-formed, else a freshly minted one — malformed
+    header values are *replaced*, never propagated into the journal."""
+    return trace_id if valid_trace_id(trace_id) else mint_trace_id()
+
+
+def span_fields(
+    trace_id: str, phase: str, ms: float, **extra
+) -> dict:
+    """The fields of one ``span`` record (None-valued extras dropped, so
+    call sites can pass optional context unconditionally)."""
+    fields = {"trace_id": str(trace_id), "phase": str(phase), "ms": round(float(ms), 3)}
+    fields.update({k: v for k, v in extra.items() if v is not None})
+    return fields

@@ -37,15 +37,16 @@ class QueueFullError(RuntimeError):
 class _Pending:
     """One queued request: inputs + a done-event the submitter blocks on."""
 
-    __slots__ = ("inputs", "n", "t_enqueue", "event", "result", "error")
+    __slots__ = ("inputs", "n", "t_enqueue", "event", "result", "error", "trace_id")
 
-    def __init__(self, inputs: np.ndarray):
+    def __init__(self, inputs: np.ndarray, trace_id: str | None = None):
         self.inputs = inputs
         self.n = int(inputs.shape[0])
         self.t_enqueue = time.monotonic()
         self.event = threading.Event()
         self.result: np.ndarray | None = None
         self.error: BaseException | None = None
+        self.trace_id = trace_id  # obs/trace.py id riding the request
 
 
 class SLOTracker:
@@ -57,9 +58,24 @@ class SLOTracker:
     the CI smoke call it, so short runs still journal their SLO story).
     """
 
-    def __init__(self, journal_event: Callable[..., None], window_s: float = 10.0):
+    def __init__(
+        self,
+        journal_event: Callable[..., None],
+        window_s: float = 10.0,
+        on_flush: Callable[[], None] | None = None,
+    ):
         self._event = journal_event
         self.window_s = float(window_s)
+        # live queue-depth sampler, set by the batcher: the serve_slo record
+        # carries the depth AT rollup time — the autoscaler's backlog signal
+        self.depth_probe: Callable[[str], int] | None = None
+        # replica id stamped onto rollups (set by the frontend): N replicas
+        # of one model journal into one reassembled journal, and a tailing
+        # aggregator must not let a healthy replica's window overwrite a
+        # breaching one's gauges
+        self.replica: int | None = None
+        # post-rollup hook (the frontend evaluates its alarm rules here)
+        self._on_flush = on_flush
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
         self._lat: dict[str, list[float]] = {}
@@ -107,9 +123,17 @@ class SLOTracker:
                 lat = sorted(self._lat.get(m, []))
                 n = len(lat)
                 batches = self._batches.get(m, 0)
+                depth = {}
+                if self.depth_probe is not None:
+                    try:
+                        depth = {"queue_depth": int(self.depth_probe(m))}
+                    except Exception:  # a probe must never kill the rollup
+                        depth = {}
                 snapshot.append(
                     dict(
                         model=m,
+                        **({} if self.replica is None else {"replica": int(self.replica)}),
+                        **depth,
                         window_s=round(window, 3),
                         requests=n,
                         shed=self._shed.get(m, 0),
@@ -133,6 +157,11 @@ class SLOTracker:
             self._t0 = time.monotonic()
         for fields in snapshot:  # journal outside the lock
             self._event("serve_slo", **fields)
+        if snapshot and self._on_flush is not None:
+            try:
+                self._on_flush()
+            except Exception as exc:  # alarms must never kill the rollup
+                logger.warning(f"slo on_flush hook failed: {exc!r}")
 
 
 class MicroBatcher:
@@ -147,13 +176,22 @@ class MicroBatcher:
         max_depth: int,
         journal_event: Callable[..., None] | None = None,
         slo: SLOTracker | None = None,
+        timed_runner: "Callable[[str, np.ndarray], tuple[np.ndarray, float]] | None" = None,
+        trace_spans: bool = False,
     ):
         self._runner = runner
+        # device-execute wall measured engine-side (engine.forward_timed):
+        # the per-trace `execute` span. Falls back to timing the plain
+        # runner here when absent (test fakes, custom runners).
+        self._timed_runner = timed_runner
+        self._trace_spans = bool(trace_spans)
         self._ladders = {m: sorted(int(b) for b in ladder) for m, ladder in ladders.items()}
         self.max_delay_s = float(max_delay_ms) / 1000.0
         self.max_depth = int(max_depth)
         self._event = journal_event or (lambda kind, **fields: None)
         self._slo = slo
+        if slo is not None:
+            slo.depth_probe = self.queue_depth
         self._cond: dict[str, threading.Condition] = {}
         self._queue: dict[str, list[_Pending]] = {}
         self._depth: dict[str, int] = {}
@@ -194,13 +232,25 @@ class MicroBatcher:
 
     # -- submission ----------------------------------------------------------
 
-    def submit(self, model: str, inputs: np.ndarray, timeout_s: float = 60.0) -> np.ndarray:
+    def queue_depth(self, model: str) -> int:
+        """Pending examples queued for one model (the SLO depth probe)."""
+        return self._depth.get(model, 0)
+
+    def submit(
+        self,
+        model: str,
+        inputs: np.ndarray,
+        timeout_s: float = 60.0,
+        trace_id: str | None = None,
+    ) -> np.ndarray:
         """Block until the request's logits are ready; sheds raise.
 
         ``inputs`` is ``(n, H, W, C)`` with ``n`` ≤ the model's largest
         compiled size (a bigger request can't fit any executable — the
         caller splits, the server never does: split responses would
-        reorder against other requests).
+        reorder against other requests). ``trace_id`` rides the request
+        into the dispatch loop, which journals its queue-wait/pad/execute
+        spans under it (obs/trace.py).
         """
         ladder = self._ladders.get(model)
         if ladder is None:
@@ -213,7 +263,7 @@ class MicroBatcher:
                 f"request of {n} examples exceeds {model!r}'s largest compiled "
                 f"batch {ladder[-1]} — split the request client-side"
             )
-        req = _Pending(inputs)
+        req = _Pending(inputs, trace_id=trace_id)
         cond = self._cond[model]
         with cond:
             if self._depth[model] + n > self.max_depth:
@@ -283,7 +333,13 @@ class MicroBatcher:
                 for req in taken:
                     padded[row : row + req.n] = req.inputs
                     row += req.n
-                logits = self._runner(model, padded)
+                pad_ms = 1000.0 * (time.monotonic() - t_dispatch)
+                if self._timed_runner is not None:
+                    logits, execute_ms = self._timed_runner(model, padded)
+                else:
+                    t_exec = time.monotonic()
+                    logits = self._runner(model, padded)
+                    execute_ms = 1000.0 * (time.monotonic() - t_exec)
                 compute_ms = 1000.0 * (time.monotonic() - t_dispatch)
                 row = 0
                 for req in taken:
@@ -300,6 +356,27 @@ class MicroBatcher:
                     queue_ms=round(queue_ms, 3),
                     compute_ms=round(compute_ms, 3),
                 )
+                if self._trace_spans:
+                    # per-request phase spans under the client-minted id:
+                    # queue-wait is the request's own, pad/execute are the
+                    # shared batch costs every coalesced request paid
+                    from distribuuuu_tpu.obs.trace import span_fields
+
+                    for req in taken:
+                        if not req.trace_id:
+                            continue
+                        common = dict(model=model, n=req.n, batch_size=batch_size)
+                        self._event("span", **span_fields(
+                            req.trace_id, "queue_wait",
+                            1000.0 * (t_dispatch - req.t_enqueue), **common,
+                        ))
+                        self._event("span", **span_fields(
+                            req.trace_id, "pad", pad_ms,
+                            requests=len(taken), **common,
+                        ))
+                        self._event("span", **span_fields(
+                            req.trace_id, "execute", execute_ms, **common,
+                        ))
                 if self._slo is not None:
                     self._slo.batch(model, batch_size, n)
                     self._slo.maybe_emit()

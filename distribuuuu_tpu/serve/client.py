@@ -17,11 +17,24 @@ from __future__ import annotations
 import base64
 import json
 import random
+import re
 import time
 import urllib.error
 import urllib.request
+import uuid
 
 import numpy as np
+
+# local copies of the obs/trace.py contract (header name, id alphabet) so
+# this module stays liftable without the telemetry package (and jax)
+TRACE_HEADER = "x-dtpu-trace-id"
+_TRACE_ID_RE = re.compile(r"^[A-Za-z0-9._\-]{1,128}$")
+
+
+def _ensure_trace_id(trace_id) -> str:
+    if isinstance(trace_id, str) and _TRACE_ID_RE.match(trace_id):
+        return trace_id
+    return uuid.uuid4().hex[:16]
 
 
 class ServeUnavailable(RuntimeError):
@@ -52,6 +65,7 @@ class ServeClient:
         self.backoff_max_s = float(backoff_max_s)
         self.timeout_s = float(timeout_s)
         self.retries = 0  # total retry attempts across the client's lifetime
+        self.last_trace_id = ""  # the id the most recent predict() carried
         self._next = 0
         self._rng = random.Random(0x5E17E)
 
@@ -84,13 +98,23 @@ class ServeClient:
 
     # -- predict -------------------------------------------------------------
 
-    def predict(self, model: str, inputs: np.ndarray) -> np.ndarray:
+    def predict(
+        self, model: str, inputs: np.ndarray, trace_id: str | None = None
+    ) -> np.ndarray:
         """Batched inference with retry; returns float32 logits ``(n, K)``.
 
         Retries connection failures, timeouts and 5xx/503 (shed) responses
         against the next replica until the deadline; 4xx raises immediately
         (the request itself is wrong — replaying it can only fail again).
+
+        The request's trace id is minted HERE (or passed in) and sent as
+        the ``x-dtpu-trace-id`` header on every attempt — retries reuse the
+        same id, so the journaled spans of a request that survived a
+        replica kill read as one trace (obs/trace.py, docs/OBSERVABILITY.md
+        "Tracing"). The id used is kept in ``self.last_trace_id``.
         """
+        trace_id = _ensure_trace_id(trace_id)
+        self.last_trace_id = trace_id
         body = json.dumps(
             {
                 "model": model,
@@ -107,7 +131,9 @@ class ServeClient:
             url = self.urls[self._next % len(self.urls)]
             self._next += 1
             req = urllib.request.Request(
-                f"{url}/v1/predict", data=body, headers={"Content-Type": "application/json"}
+                f"{url}/v1/predict",
+                data=body,
+                headers={"Content-Type": "application/json", TRACE_HEADER: trace_id},
             )
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:

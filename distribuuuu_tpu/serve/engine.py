@@ -462,6 +462,18 @@ class InferenceEngine:
         out = compiled(*hosted.exec_args, jax.device_put(batch, sharding))
         return np.asarray(out)
 
+    def forward_timed(self, name: str, batch: np.ndarray) -> tuple[np.ndarray, float]:
+        """`forward` plus its wall in ms — the per-trace ``execute`` span.
+
+        Timed around the compiled call *including* the result fetch: the
+        fetch is the dispatch's one host sync and its cost belongs to the
+        request (the response payload IS the fetched array), so the span is
+        honest end-to-end device time with zero added syncs.
+        """
+        tic = time.monotonic()
+        logits = self.forward(name, batch)
+        return logits, 1000.0 * (time.monotonic() - tic)
+
     def runner(self) -> Callable[[str, np.ndarray], np.ndarray]:
         """The batcher-facing dispatch callable."""
         return self.forward

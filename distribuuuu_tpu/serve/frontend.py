@@ -16,6 +16,13 @@ framework):
   400/404 → client error.
 - ``GET /healthz`` — ``{"status": "ok", "models": [...], "replica": i}``;
   the agent's preflight and the client's liveness probe both read it.
+- ``GET /metrics`` — Prometheus text of the replica's live aggregate
+  (p50/p99/QPS/queue-depth per model, shed and batch counters; dtpu-obs v2,
+  docs/OBSERVABILITY.md "Live metrics").
+
+Requests may carry an ``x-dtpu-trace-id`` header (the serve client mints
+one); the queue-wait/pad/execute/total phases of the request are journaled
+as typed ``span`` records under that id and the header is echoed back.
 
 Stdin mode (``SERVE.MODE stdin``): one JSON request per line on stdin, one
 JSON response per line on stdout — the zero-socket smoke path.
@@ -37,7 +44,15 @@ import numpy as np
 
 from distribuuuu_tpu.config import cfg, load_cfg_fom_args
 from distribuuuu_tpu.logging import logger, setup_logger
+from distribuuuu_tpu.obs.alarms import engine_from_cfg
+from distribuuuu_tpu.obs.exporter import (
+    PROM_CONTENT_TYPE,
+    merged_snapshot,
+    render_prometheus,
+)
 from distribuuuu_tpu.obs.journal import ValidatedJournal
+from distribuuuu_tpu.obs.stream import LiveAggregator
+from distribuuuu_tpu.obs.trace import TRACE_HEADER, ensure_trace_id, span_fields
 from distribuuuu_tpu.serve.batcher import MicroBatcher, QueueFullError, SLOTracker
 from distribuuuu_tpu.serve.engine import InferenceEngine, ModelSpec, parse_model_specs
 
@@ -145,7 +160,24 @@ class ServeReplica:
         self.replica = int(os.environ.get("DTPU_SERVE_REPLICA", "0"))
         self.journal = ServeJournal(out_dir)
         self.journal_requests = bool(s.JOURNAL_REQUESTS)
-        self.slo = SLOTracker(self.journal.event, window_s=float(s.SLO_WINDOW_S))
+        self.trace_spans = bool(s.TRACE_SPANS)
+        # live telemetry plane (dtpu-obs v2): every journaled record also
+        # folds into the in-process aggregator — a replica must not tail
+        # its own open journal, and the fold is O(fields) host work — so
+        # GET /metrics renders current state with zero extra I/O, and the
+        # OBS.ALARMS rules evaluate on every SLO rollup
+        self.aggregator = LiveAggregator()
+        # heartbeat_age_s rules excluded: an idle replica journals nothing
+        # but is not dead — /healthz owns serve liveness
+        self.alarms = engine_from_cfg(
+            self.journal_event, exclude_metrics=("heartbeat_age_s",)
+        )
+        self.slo = SLOTracker(
+            self.journal_event,
+            window_s=float(s.SLO_WINDOW_S),
+            on_flush=self._evaluate_alarms,
+        )
+        self.slo.replica = self.replica
         self.engine = InferenceEngine(
             mesh,
             batch_sizes=list(s.BATCH_SIZES),
@@ -154,7 +186,7 @@ class ServeReplica:
             input_dtype=str(s.INPUT_DTYPE),
             compute_dtype=str(s.DTYPE) or str(cfg.MODEL.DTYPE),
             verify_integrity=bool(s.VERIFY_INTEGRITY),
-            journal_event=self.journal.event,
+            journal_event=self.journal_event,
             quant_cfg={
                 "calib_batches": int(cfg.QUANT.CALIB_BATCHES),
                 "calib_batch_size": int(cfg.QUANT.CALIB_BATCH_SIZE),
@@ -173,15 +205,37 @@ class ServeReplica:
             {name: self.engine.models[name].batch_sizes for name in self.engine.models},
             max_delay_ms=float(s.MAX_QUEUE_DELAY_MS),
             max_depth=int(s.MAX_QUEUE_DEPTH),
-            journal_event=self.journal.event,
+            journal_event=self.journal_event,
             slo=self.slo,
+            timed_runner=self.engine.forward_timed,
+            trace_spans=self.trace_spans,
         ).start()
         self.port = 0  # bound ingress port (http mode fills it in)
         self._warmup_s = warmup_s
 
+    def journal_event(self, kind: str, **fields) -> None:
+        """Journal one typed record AND fold it into the live aggregator."""
+        self.journal.event(kind, **fields)
+        try:
+            self.aggregator.ingest({"ts": time.time(), "kind": kind, **fields})
+        except Exception:  # pragma: no cover - the fold is already defensive
+            pass
+
+    def _evaluate_alarms(self) -> None:
+        if self.alarms is not None:
+            self.alarms.evaluate(self.aggregator.snapshot())
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition of the replica's live aggregate state
+        (GET /metrics). Alarm rules are evaluated per scrape too, so a
+        breach is detected even when traffic — and with it the SLO rollup
+        cadence — has collapsed."""
+        self._evaluate_alarms()
+        return render_prometheus(merged_snapshot(self.aggregator, self.alarms))
+
     def announce(self, port: int) -> None:
         self.port = int(port)
-        self.journal.event(
+        self.journal_event(
             "serve_start",
             models=sorted(self.engine.models),
             batch_sizes=self.engine.batch_sizes,
@@ -193,11 +247,20 @@ class ServeReplica:
             input_dtype=str(self.input_dtype),
         )
 
-    def predict(self, model: str, inputs: np.ndarray) -> tuple[np.ndarray, float]:
-        """Batched inference for one request; returns (logits, latency_ms)."""
+    def predict(
+        self, model: str, inputs: np.ndarray, trace_id: str | None = None
+    ) -> tuple[np.ndarray, float]:
+        """Batched inference for one request; returns (logits, latency_ms).
+
+        ``trace_id`` (the validated ``x-dtpu-trace-id``, minted here for
+        header-less callers) rides the request through the batcher into the
+        engine dispatch; the queue-wait/pad/execute spans land there and the
+        ``total`` span — the latency the client saw — lands here.
+        """
+        trace_id = ensure_trace_id(trace_id) if self.trace_spans else trace_id
         tic = time.monotonic()
         try:
-            logits = self.batcher.submit(model, inputs)
+            logits = self.batcher.submit(model, inputs, trace_id=trace_id)
         except QueueFullError:
             raise
         except (KeyError, ValueError) as exc:
@@ -208,25 +271,35 @@ class ServeReplica:
             raise BadRequest(str(exc)) from exc
         latency_ms = 1000.0 * (time.monotonic() - tic)
         self.slo.request(model, latency_ms)
+        n = int(inputs.shape[0])
+        if self.trace_spans and trace_id:
+            self.journal_event(
+                "span",
+                **span_fields(trace_id, "total", latency_ms, model=model, n=n, ok=True),
+            )
         if self.journal_requests:
-            self.journal.event(
+            extra = {"trace_id": trace_id} if trace_id else {}
+            self.journal_event(
                 "serve_request",
                 model=model,
-                n=int(inputs.shape[0]),
+                n=n,
                 latency_ms=round(latency_ms, 3),
                 ok=True,
+                **extra,
             )
         return logits, latency_ms
 
-    def handle(self, body: dict) -> dict:
+    def handle(self, body: dict, trace_id: str | None = None) -> dict:
         """One decoded request dict → response dict (shared by http/stdin)."""
         model = body.get("model", "")
+        trace_id = ensure_trace_id(trace_id or body.get("trace_id"))
         inputs = decode_inputs(body.get("inputs"), self.im_size, self.input_dtype)
-        logits, latency_ms = self.predict(model, inputs)
+        logits, latency_ms = self.predict(model, inputs, trace_id=trace_id)
         return {
             "model": model,
             "logits": logits.tolist(),
             "latency_ms": round(latency_ms, 3),
+            "trace_id": trace_id,
         }
 
     def shutdown(self) -> None:
@@ -243,10 +316,22 @@ def _make_handler(replica: ServeReplica):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
-        def _reply(self, code: int, payload: dict) -> None:
+        def _reply(
+            self, code: int, payload: dict, trace_id: str | None = None
+        ) -> None:
             data = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            if trace_id:  # echo the id so callers can correlate journal spans
+                self.send_header(TRACE_HEADER, trace_id)
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _reply_text(self, code: int, text: str, ctype: str) -> None:
+            data = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
@@ -262,6 +347,15 @@ def _make_handler(replica: ServeReplica):
                         "batch_sizes": replica.engine.batch_sizes,
                     },
                 )
+            elif self.path == "/metrics":
+                # Prometheus exposition of the live aggregate (dtpu-obs v2):
+                # rides the existing frontend server — no extra port, and a
+                # scrape reads host state only (zero added device syncs)
+                try:
+                    self._reply_text(200, replica.metrics_text(), PROM_CONTENT_TYPE)
+                except Exception as exc:  # scrape must never hang the socket
+                    logger.error(f"serve: /metrics failed: {exc!r}")
+                    self._reply_text(500, repr(exc), "text/plain")
             else:
                 self._reply(404, {"error": f"no route {self.path}"})
 
@@ -269,19 +363,22 @@ def _make_handler(replica: ServeReplica):
             if self.path not in ("/v1/predict", "/predict"):
                 self._reply(404, {"error": f"no route {self.path}"})
                 return
+            # the client-minted trace id (obs/trace.py); malformed or absent
+            # headers get a fresh id — the spans must always have a key
+            trace_id = ensure_trace_id(self.headers.get(TRACE_HEADER))
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 body = json.loads(self.rfile.read(length) or b"{}")
-                self._reply(200, replica.handle(body))
+                self._reply(200, replica.handle(body, trace_id), trace_id)
             except QueueFullError as exc:
-                self._reply(503, {"error": "shed", "detail": str(exc)})
+                self._reply(503, {"error": "shed", "detail": str(exc)}, trace_id)
             except BadRequest as exc:
-                self._reply(400, {"error": "bad_request", "detail": str(exc)})
+                self._reply(400, {"error": "bad_request", "detail": str(exc)}, trace_id)
             except (json.JSONDecodeError, UnicodeDecodeError) as exc:
-                self._reply(400, {"error": "bad_json", "detail": str(exc)})
+                self._reply(400, {"error": "bad_json", "detail": str(exc)}, trace_id)
             except Exception as exc:  # server-side: 500, never a hung socket
                 logger.error(f"serve: request failed: {exc!r}")
-                self._reply(500, {"error": "internal", "detail": repr(exc)})
+                self._reply(500, {"error": "internal", "detail": repr(exc)}, trace_id)
 
         def log_message(self, fmt, *args):  # access log → logger, not stderr
             logger.debug(f"serve http: {fmt % args}")

@@ -997,7 +997,17 @@ def train_model():
             is_best = acc1 > best_acc1
             best_acc1 = max(acc1, best_acc1)
             resilience.watchdog_beat(phase="checkpoint")  # long saves ≠ hangs
+            ck_tic = time.time()
             path = ckpt.save_checkpoint(cfg.OUT_DIR, epoch, state, best_acc1, is_best)
+            if cfg.OBS.TRAIN_SPANS:
+                # the epoch boundary's checkpoint phase as a typed span: the
+                # DISPATCH wall (saves are async — the write itself overlaps
+                # the next epoch; obs/trace.py, zero added syncs)
+                tel_run = obs.current()
+                tel_run.span(
+                    tel_run.trace_tag(f"ck{epoch}"), "checkpoint",
+                    1000.0 * (time.time() - ck_tic), epoch=epoch,
+                )
             logger.info(f"Saving checkpoint (async): {path} (best Acc@1 {best_acc1:.3f})")
     finally:
         # disarm BEFORE the final waits: a completed (or crashed) run must

@@ -328,6 +328,21 @@ def test_smoke_train_emits_schema_valid_journal(fresh_cfg, tmp_path):
         assert w["flops_per_step"] and w["flops_per_step"] > 0
         assert "mfu" in w  # None on CPU (peak unknown), but always present
         assert w["step_time"] > 0
+        # the data-wait alarm's signal (ISSUE-11): producer-starvation
+        # time / window wall, journaled on every window
+        assert 0.0 <= w["data_wait_frac"] <= 1.0
+
+    # train-side spans (dtpu-obs v2): each window journals its data-wait +
+    # compute phases under one trace id; epoch boundaries add a checkpoint
+    # span — all fed from the existing PRINT_FREQ fetch
+    spans = [r for r in recs if r["kind"] == "span"]
+    assert {s["phase"] for s in spans} >= {"data_wait", "compute", "checkpoint"}
+    by_trace = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], set()).add(s["phase"])
+    window_traces = [p for p in by_trace.values() if "compute" in p]
+    assert len(window_traces) == len(windows)
+    assert all({"data_wait", "compute"} == p for p in window_traces)
 
     # monitoring counters journaled per epoch; epoch 0 must have seen the
     # compile machinery (trace events fire even when the persistent compile

@@ -1,0 +1,762 @@
+"""dtpu-obs v2: the live telemetry plane (docs/OBSERVABILITY.md).
+
+Coverage map (the ISSUE-11 satellite list):
+
+- schema round-trips for the new ``span`` / ``alarm`` / ``alarm_clear`` /
+  ``fleet_alarm`` record kinds;
+- `JournalTailer` cursor units: committed bytes are never re-read, a torn
+  tail mid-tail is held (delivered exactly once on completion), nested
+  remote-style ``.part2001.part1`` continuations reassemble in order;
+- exporter scrape golden: Prometheus text parsed back and gauge values
+  checked against a hand-built journal;
+- alarm fire/clear hysteresis (``:for=N``), per-model rules, rule parsing;
+- the retrying serve client keeps one trace id across retries (stub HTTP
+  server capturing headers — no engine needed);
+- the export sidecar end-to-end over a journal on disk (ObsPlane +
+  MetricsServer scrape + alarm records into the ``.part4000`` part);
+- the fleet controller's alarm hook journals a schema-valid fleet_alarm.
+
+The full HTTP request → four-span-phases trace test lives in
+tests/test_serve.py (it reuses the module-scoped served fixture).
+"""
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from distribuuuu_tpu.obs.alarms import (
+    AlarmEngine,
+    parse_alarm_rules,
+)
+from distribuuuu_tpu.obs.exporter import (
+    ObsPlane,
+    render_prometheus,
+)
+from distribuuuu_tpu.obs.journal import (
+    Journal,
+    read_journal,
+    validate_journal,
+    validate_record,
+)
+from distribuuuu_tpu.obs.stream import JournalTailer, LiveAggregator
+from distribuuuu_tpu.obs.trace import ensure_trace_id, mint_trace_id, valid_trace_id
+
+# ---------------------------------------------------------------------------
+# schema round-trips for the new kinds
+# ---------------------------------------------------------------------------
+
+_NEW_KIND_RECORDS = [
+    {"ts": 1.0, "kind": "span", "trace_id": "abc123", "phase": "queue_wait",
+     "ms": 1.25, "model": "rn18", "n": 4, "batch_size": 8},
+    {"ts": 1.1, "kind": "span", "trace_id": "train-aa-g30", "phase": "data_wait",
+     "ms": 40.0, "gstep": 30, "epoch": 0},
+    {"ts": 2.0, "kind": "alarm", "rule": "goodput_floor", "metric": "goodput",
+     "value": 0.03, "threshold": 0.1, "op": "<", "windows": 3},
+    {"ts": 3.0, "kind": "alarm_clear", "rule": "goodput_floor",
+     "metric": "goodput", "value": 0.4, "threshold": 0.1, "active_s": 12.5},
+    {"ts": 4.0, "kind": "alarm", "rule": "p99", "metric": "serve_p99_ms",
+     "value": 400.0, "threshold": 250.0, "op": ">", "model": "rn18"},
+    {"ts": 5.0, "kind": "fleet_alarm", "rule": "p99", "metric": "serve_p99_ms",
+     "value": 400.0, "threshold": 250.0, "state": "fire", "job": "train",
+     "model": "rn18"},
+]
+
+
+def test_new_kinds_schema_roundtrip(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = Journal(path)
+    for r in _NEW_KIND_RECORDS:
+        j.append(r)
+    j.close()
+    recs = list(read_journal(path))
+    assert [r["kind"] for r in recs] == [r["kind"] for r in _NEW_KIND_RECORDS]
+    errors = [e for r in recs for e in validate_record(r)]
+    assert errors == []
+    assert validate_journal(path) == []
+
+
+def test_new_kinds_schema_catches_bad_records():
+    assert validate_record({"ts": 1.0, "kind": "span", "phase": "x", "ms": 1.0})
+    assert validate_record(
+        {"ts": 1.0, "kind": "alarm", "rule": "r", "metric": "m", "value": 1.0,
+         "threshold": "high", "op": "<"}
+    )
+    assert validate_record(
+        {"ts": 1.0, "kind": "fleet_alarm", "rule": "r", "metric": "m",
+         "value": 1.0, "threshold": 2.0}
+    )  # missing state
+
+
+# ---------------------------------------------------------------------------
+# trace ids
+# ---------------------------------------------------------------------------
+
+def test_trace_id_mint_and_validate():
+    tid = mint_trace_id()
+    assert valid_trace_id(tid) and len(tid) == 16
+    assert ensure_trace_id(tid) == tid
+    for bad in (None, "", "has space", "x" * 200, 'inj"ect', "a\nb", 42):
+        got = ensure_trace_id(bad)
+        assert got != bad and valid_trace_id(got)
+
+
+# ---------------------------------------------------------------------------
+# JournalTailer cursor units
+# ---------------------------------------------------------------------------
+
+def _rec(epoch, count=1):
+    return {"ts": float(epoch), "kind": "fault_skipped_steps",
+            "epoch": epoch, "count": count}
+
+
+def _append_line(path, obj, newline=True):
+    with open(path, "a") as f:
+        f.write(json.dumps(obj) + ("\n" if newline else ""))
+
+
+def test_tailer_incremental_no_byte_reread(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    _append_line(path, _rec(0))
+    _append_line(path, _rec(1))
+    tailer = JournalTailer(path)
+    first = tailer.poll()
+    assert [r["epoch"] for r in first] == [0, 1]
+    consumed = tailer.bytes_read
+    assert consumed == len(open(path, "rb").read())
+    # nothing new: zero bytes consumed, zero records
+    assert tailer.poll() == []
+    assert tailer.bytes_read == consumed
+    # one appended record: exactly its bytes are consumed, once
+    _append_line(path, _rec(2))
+    total = len(open(path, "rb").read())
+    assert [r["epoch"] for r in tailer.poll()] == [2]
+    assert tailer.bytes_read == total  # committed bytes read exactly once
+    assert tailer.poll() == []
+
+
+def test_tailer_holds_torn_tail_until_complete(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    _append_line(path, _rec(0))
+    tailer = JournalTailer(path)
+    assert [r["epoch"] for r in tailer.poll()] == [0]
+    # a writer mid-append: the fragment must be HELD, not skipped — when the
+    # newline lands the record is delivered exactly once
+    half = json.dumps(_rec(1))
+    with open(path, "a") as f:
+        f.write(half[: len(half) // 2])
+    assert tailer.poll() == []
+    with open(path, "a") as f:
+        f.write(half[len(half) // 2 :] + "\n")
+    assert [r["epoch"] for r in tailer.poll()] == [1]
+    assert tailer.poll() == []
+
+
+def test_tailer_reassembles_nested_remote_parts(tmp_path):
+    """Supervisory parts and their own remote-commit continuations
+    (``.part2001``, ``.part2001.part1``) tail in write order, and appends
+    to any part are picked up incrementally."""
+    base = str(tmp_path / "j.jsonl")
+    _append_line(base, _rec(0))
+    _append_line(base + ".part2001", _rec(1))
+    _append_line(base + ".part2001.part1", _rec(2))
+    tailer = JournalTailer(base)
+    assert [r["epoch"] for r in tailer.poll()] == [0, 1, 2]
+    # growth in a nested part is seen without re-reading anything else
+    consumed = tailer.bytes_read
+    _append_line(base + ".part2001.part1", _rec(3))
+    assert [r["epoch"] for r in tailer.poll()] == [3]
+    assert tailer.bytes_read == consumed + len(json.dumps(_rec(3))) + 1
+    # a NEW part appearing later is discovered on the next poll
+    _append_line(base + ".part3000", _rec(4))
+    assert [r["epoch"] for r in tailer.poll()] == [4]
+
+
+def test_tailer_skips_complete_corrupt_line_and_counts_it(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    _append_line(path, _rec(0))
+    with open(path, "a") as f:
+        f.write("not json at all\n")
+    _append_line(path, _rec(1))
+    tailer = JournalTailer(path)
+    assert [r["epoch"] for r in tailer.poll()] == [0, 1]
+    assert tailer.decode_errors == 1
+
+
+def test_tailer_tolerates_missing_main_file(tmp_path):
+    base = str(tmp_path / "j.jsonl")
+    _append_line(base + ".part3000", _rec(7))
+    tailer = JournalTailer(base)
+    assert [r["epoch"] for r in tailer.poll()] == [7]
+
+
+# ---------------------------------------------------------------------------
+# exporter scrape golden (hand-built journal -> parsed Prometheus text)
+# ---------------------------------------------------------------------------
+
+_GOLDEN_LIVE = [
+    {"ts": 100.0, "kind": "run_start", "run_id": "r1", "arch": "resnet50",
+     "hosts": 1, "devices": 8, "local_devices": 8, "platform": "tpu",
+     "device_kind": "TPU v5 lite", "global_batch": 2048,
+     "config_fingerprint": "deadbeef0123", "jax_version": "0.4.37"},
+    {"ts": 110.0, "kind": "window", "epoch": 0, "step": 30, "gstep": 30,
+     "steps": 30, "skipped": 2, "lr": 0.2, "step_time": 0.25,
+     "data_time": 0.01, "data_wait_frac": 0.125, "imgs_per_sec": 8192.0,
+     "goodput": 0.875, "warmup": False, "mfu": 0.41},
+    {"ts": 111.0, "kind": "serve_slo", "model": "rn18", "window_s": 10.0,
+     "requests": 100, "shed": 3, "qps": 10.0, "p50_ms": 4.5, "p99_ms": 21.0,
+     "queue_depth": 7},
+    {"ts": 112.0, "kind": "span", "trace_id": "t1", "phase": "execute",
+     "ms": 3.5},
+    {"ts": 112.5, "kind": "span", "trace_id": "t1", "phase": "execute",
+     "ms": 4.5},
+]
+
+
+def _parse_prom(text):
+    metrics = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        metrics[name] = float(value)
+    return metrics
+
+
+def test_exporter_scrape_golden():
+    agg = LiveAggregator()
+    agg.ingest_all(_GOLDEN_LIVE)
+    text = render_prometheus(agg.snapshot(now=160.0))
+    m = _parse_prom(text)
+    assert m["dtpu_goodput"] == pytest.approx(0.875)
+    assert m["dtpu_mfu"] == pytest.approx(0.41)
+    assert m["dtpu_step_time"] == pytest.approx(0.25)
+    assert m["dtpu_imgs_per_sec"] == pytest.approx(8192.0)
+    assert m["dtpu_data_wait_frac"] == pytest.approx(0.125)
+    assert m["dtpu_steps_total"] == pytest.approx(30.0)
+    assert m["dtpu_skipped_steps_total"] == pytest.approx(2.0)
+    assert m["dtpu_devices"] == pytest.approx(8.0)
+    # newest record ts 112.5, snapshot at 160 -> staleness is derived
+    assert m["dtpu_heartbeat_age_s"] == pytest.approx(160.0 - 112.5)
+    assert m['dtpu_serve_p50_ms{model="rn18"}'] == pytest.approx(4.5)
+    assert m['dtpu_serve_p99_ms{model="rn18"}'] == pytest.approx(21.0)
+    assert m['dtpu_serve_qps{model="rn18"}'] == pytest.approx(10.0)
+    assert m['dtpu_serve_queue_depth{model="rn18"}'] == pytest.approx(7.0)
+    assert m['dtpu_serve_requests_total{model="rn18"}'] == pytest.approx(100.0)
+    assert m['dtpu_serve_shed_total{model="rn18"}'] == pytest.approx(3.0)
+    assert m['dtpu_span_count{phase="execute"}'] == pytest.approx(2.0)
+    assert m['dtpu_span_ms_total{phase="execute"}'] == pytest.approx(8.0)
+    assert m["dtpu_alarm_active"] == 0.0
+    # run identity rides a labelled info gauge
+    assert 'arch="resnet50"' in text and 'run_id="r1"' in text
+
+
+def test_exporter_label_escaping():
+    agg = LiveAggregator()
+    agg.ingest({"ts": 1.0, "kind": "serve_slo", "model": 'we"ird\nname',
+                "window_s": 1.0, "requests": 1, "shed": 0, "qps": 1.0,
+                "p50_ms": 1.0, "p99_ms": 1.0})
+    text = render_prometheus(agg.snapshot(now=2.0))
+    assert '\\"' in text and "\\n" in text  # injected syntax is escaped
+
+
+# ---------------------------------------------------------------------------
+# alarm engine: parsing + fire/clear hysteresis
+# ---------------------------------------------------------------------------
+
+def test_parse_alarm_rules():
+    rules = parse_alarm_rules(
+        ["goodput_floor=goodput<0.1:for=3", "p99=serve_p99_ms>250"]
+    )
+    assert rules[0].name == "goodput_floor" and rules[0].for_windows == 3
+    assert rules[0].op == "<" and rules[0].threshold == pytest.approx(0.1)
+    assert rules[1].op == ">" and rules[1].for_windows == 1
+    for bad in ["noequals<1", "a=b=c<1", "r=m~5", "r=m<abc", "r=m<1:for=x"]:
+        with pytest.raises(ValueError):
+            parse_alarm_rules([bad])
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_alarm_rules(["r=m<1", "r=m>2"])
+
+
+def _snap(**gauges):
+    return {"gauges": gauges, "counters": {}, "per_model": {}}
+
+
+def test_alarm_fire_clear_hysteresis(tmp_path):
+    journal = Journal(str(tmp_path / "a.jsonl"))
+    events = []
+
+    def sink(kind, **fields):
+        events.append((kind, dict(fields)))
+        journal.append({"ts": 1.0, "kind": kind, **fields})
+
+    hooks = []
+    eng = AlarmEngine(parse_alarm_rules(["g=goodput<0.5:for=2"]), sink)
+    eng.register_hook(hooks.append)
+
+    assert eng.evaluate(_snap(goodput=0.2), now=0.0) == []  # 1st breach: held
+    fired = eng.evaluate(_snap(goodput=0.2), now=1.0)  # 2nd: fires
+    assert [t["kind"] for t in fired] == ["alarm"]
+    assert eng.active() == ["g"]
+    assert eng.evaluate(_snap(goodput=0.2), now=2.0) == []  # active: no refire
+    assert eng.evaluate(_snap(goodput=0.9), now=3.0) == []  # 1st ok: held
+    cleared = eng.evaluate(_snap(goodput=0.9), now=4.0)  # 2nd ok: clears
+    assert [t["kind"] for t in cleared] == ["alarm_clear"]
+    assert cleared[0]["active_s"] == pytest.approx(3.0)
+    assert eng.active() == []
+    # a single breach after recovery must NOT refire (hysteresis resets)
+    assert eng.evaluate(_snap(goodput=0.2), now=5.0) == []
+    # hooks saw exactly the two transitions, in order
+    assert [h["kind"] for h in hooks] == ["alarm", "alarm_clear"]
+    # the journaled records are schema-valid
+    journal.close()
+    assert validate_journal(str(tmp_path / "a.jsonl")) == []
+    assert [k for k, _ in events] == ["alarm", "alarm_clear"]
+
+
+def test_alarm_per_model_rules_fire_per_label():
+    eng = AlarmEngine(parse_alarm_rules(["p99=serve_p99_ms>100"]))
+    snap = {"gauges": {}, "counters": {},
+            "per_model": {"serve_p99_ms": {"rn18": 250.0, "vit": 50.0}}}
+    fired = eng.evaluate(snap)
+    assert len(fired) == 1 and fired[0]["model"] == "rn18"
+    assert eng.active() == ["p99[rn18]"]
+
+
+def test_alarm_unknown_metric_is_not_a_breach():
+    eng = AlarmEngine(parse_alarm_rules(["g=goodput<0.5"]))
+    assert eng.evaluate(_snap()) == []  # fresh journal: no gauges yet
+    assert eng.active() == []
+
+
+def test_alarm_hysteresis_counts_metric_windows_not_evaluation_passes():
+    """The plane evaluates every ~2s (and the frontend per scrape), but a
+    metric only changes when a record sets it: re-evaluating ONE stale bad
+    window must not burn through for=N — 'a single noisy window can
+    neither page nor silence' is the contract. Freshness keys on the
+    METRIC's own update generation, so unrelated record traffic (spans,
+    requests) can't stand in for a new window either."""
+    eng = AlarmEngine(parse_alarm_rules(["g=goodput<0.5:for=3"]))
+
+    def snap(goodput, gen):
+        return {"gauges": {"goodput": goodput}, "counters": {},
+                "per_model": {}, "metric_gen": {"goodput": gen}}
+
+    # one bad window (gen=1) re-evaluated five times: never fires
+    for _ in range(5):
+        assert eng.evaluate(snap(0.1, gen=1)) == []
+    assert eng.active() == []
+    # three DISTINCT bad windows: fires on the third
+    assert eng.evaluate(snap(0.1, gen=2)) == []
+    fired = eng.evaluate(snap(0.1, gen=3))
+    assert [t["kind"] for t in fired] == ["alarm"]
+
+
+def test_alarm_unrelated_traffic_is_not_metric_freshness():
+    """Through the real aggregator: span/request records between two SLO
+    rollups must not advance a serve_p99_ms rule's hysteresis."""
+    agg = LiveAggregator()
+    eng = AlarmEngine(parse_alarm_rules(["p99=serve_p99_ms>100:for=2"]))
+
+    def slo(p99):
+        agg.ingest({"ts": 1.0, "kind": "serve_slo", "model": "m",
+                    "window_s": 10.0, "requests": 5, "shed": 0, "qps": 0.5,
+                    "p50_ms": 1.0, "p99_ms": p99})
+
+    slo(500.0)
+    assert eng.evaluate(agg.snapshot(now=2.0)) == []  # 1st bad window
+    # unrelated traffic arrives; the p99 gauge itself has NOT rolled over
+    for i in range(5):
+        agg.ingest({"ts": 2.0 + i, "kind": "span", "trace_id": "t",
+                    "phase": "execute", "ms": 1.0})
+        assert eng.evaluate(agg.snapshot(now=3.0 + i)) == []
+    slo(400.0)  # the SECOND bad window fires
+    fired = eng.evaluate(agg.snapshot(now=20.0))
+    assert [t["kind"] for t in fired] == ["alarm"]
+
+
+def test_alarm_freshness_is_per_label_not_per_metric():
+    """Model A's rollups must not let model B's frozen stale value count
+    as fresh breaching windows (B went idle after one bad window)."""
+    agg = LiveAggregator()
+    eng = AlarmEngine(parse_alarm_rules(["p99=serve_p99_ms>100:for=3"]))
+
+    def slo(model, p99):
+        agg.ingest({"ts": 1.0, "kind": "serve_slo", "model": model,
+                    "window_s": 10.0, "requests": 5, "shed": 0, "qps": 0.5,
+                    "p50_ms": 1.0, "p99_ms": p99})
+
+    slo("b", 500.0)  # B's single bad window, then B goes idle
+    assert eng.evaluate(agg.snapshot(now=2.0)) == []
+    for i in range(5):  # A keeps rolling healthy windows
+        slo("a", 10.0)
+        fired = eng.evaluate(agg.snapshot(now=3.0 + i))
+        assert fired == [], f"B paged off its single stale window: {fired}"
+
+
+def test_aggregator_replica_stamped_slo_keeps_per_replica_series():
+    """Two replicas of one model in a tailed journal: a healthy replica's
+    rollup must not overwrite the breaching one's gauges."""
+    from distribuuuu_tpu.obs.exporter import render_prometheus as rp
+
+    agg = LiveAggregator()
+    for replica, p99 in ((0, 500.0), (1, 10.0)):
+        agg.ingest({"ts": 1.0, "kind": "serve_slo", "model": "rn18",
+                    "replica": replica, "window_s": 10.0, "requests": 5,
+                    "shed": 0, "qps": 0.5, "p50_ms": 1.0, "p99_ms": p99})
+    text = rp(agg.snapshot(now=2.0))
+    assert 'dtpu_serve_p99_ms{model="rn18",replica="0"} 500' in text
+    assert 'dtpu_serve_p99_ms{model="rn18",replica="1"} 10' in text
+    # a per-model alarm rule sees (and can fire for) the breaching replica
+    eng = AlarmEngine(parse_alarm_rules(["p99=serve_p99_ms>100"]))
+    fired = eng.evaluate(agg.snapshot(now=2.0))
+    assert [t["model"] for t in fired] == ["rn18#r0"]
+
+
+def test_tailer_read_limit_catches_up_over_polls(tmp_path):
+    """A late-started tailer over a big journal reads bounded chunks per
+    poll and still delivers every record exactly once."""
+    path = str(tmp_path / "j.jsonl")
+    n = 300
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write(json.dumps(_rec(i)) + "\n")
+    tailer = JournalTailer(path)
+    tailer.READ_LIMIT = 4096  # force multi-poll catch-up
+    seen = []
+    for _ in range(n):  # plenty of polls
+        got = tailer.poll()
+        if not got and len(seen) == n:
+            break
+        seen.extend(r["epoch"] for r in got)
+    assert seen == list(range(n))
+
+
+def test_alarm_clock_metric_evaluates_on_stale_snapshots():
+    """heartbeat_age_s grows precisely while nothing new arrives — the
+    freshness gate must not apply to clock-derived metrics."""
+    eng = AlarmEngine(parse_alarm_rules(["stale=heartbeat_age_s>300:for=2"]))
+
+    def snap(age):
+        return {"gauges": {"heartbeat_age_s": age}, "counters": {},
+                "per_model": {}, "metric_gen": {}}  # no record ever set it
+
+    assert eng.evaluate(snap(400.0)) == []
+    fired = eng.evaluate(snap(402.0))  # same stale journal, clock advanced
+    assert [t["kind"] for t in fired] == ["alarm"]
+
+
+def test_alarm_streak_resets_on_interleaved_ok():
+    eng = AlarmEngine(parse_alarm_rules(["g=goodput<0.5:for=3"]))
+    assert eng.evaluate(_snap(goodput=0.1)) == []
+    assert eng.evaluate(_snap(goodput=0.1)) == []
+    assert eng.evaluate(_snap(goodput=0.9)) == []  # streak broken
+    assert eng.evaluate(_snap(goodput=0.1)) == []
+    assert eng.evaluate(_snap(goodput=0.1)) == []
+    fired = eng.evaluate(_snap(goodput=0.1))
+    assert [t["kind"] for t in fired] == ["alarm"]
+
+
+def test_fleet_alarm_hook_record_is_schema_valid(tmp_path):
+    """The fleet controller's hook shape: every fire/clear becomes a typed
+    ``fleet_alarm`` record (the PR-12 autoscaler trigger, no action taken)."""
+    journal = Journal(str(tmp_path / "f.jsonl"))
+
+    def hook(transition):
+        fields = {
+            "rule": transition["rule"],
+            "metric": transition["metric"],
+            "value": transition["value"],
+            "threshold": transition["threshold"],
+            "state": "fire" if transition["kind"] == "alarm" else "clear",
+            "job": "train",
+        }
+        journal.append({"ts": 1.0, "kind": "fleet_alarm", **fields})
+
+    eng = AlarmEngine(parse_alarm_rules(["g=goodput<0.5"]))
+    eng.register_hook(hook)
+    eng.evaluate(_snap(goodput=0.1))
+    eng.evaluate(_snap(goodput=0.9))
+    journal.close()
+    recs = list(read_journal(str(tmp_path / "f.jsonl")))
+    assert [r["state"] for r in recs] == ["fire", "clear"]
+    assert validate_journal(str(tmp_path / "f.jsonl")) == []
+
+
+def test_failing_hook_does_not_stop_alarming():
+    eng = AlarmEngine(parse_alarm_rules(["g=goodput<0.5"]))
+    seen = []
+    eng.register_hook(lambda t: (_ for _ in ()).throw(RuntimeError("boom")))
+    eng.register_hook(seen.append)
+    fired = eng.evaluate(_snap(goodput=0.1))
+    assert len(fired) == 1 and len(seen) == 1
+
+
+# ---------------------------------------------------------------------------
+# serve client: one trace id across retries (stub server, no engine)
+# ---------------------------------------------------------------------------
+
+def test_client_retry_keeps_trace_id(tmp_path):
+    from distribuuuu_tpu.serve.client import TRACE_HEADER, ServeClient
+
+    seen_headers = []
+    logits = [[0.0, 1.0]]
+
+    class _Stub(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_POST(self):  # noqa: N802
+            self.rfile.read(int(self.headers.get("Content-Length", "0")))
+            seen_headers.append(self.headers.get(TRACE_HEADER))
+            if len(seen_headers) == 1:  # first attempt: shed -> retry
+                body = json.dumps({"error": "shed"}).encode()
+                self.send_response(503)
+            else:
+                body = json.dumps({"logits": logits}).encode()
+                self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _Stub)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        client = ServeClient([server.server_address[1]], deadline_s=10)
+        out = client.predict("m", np.zeros((1, 2, 2, 3), np.float32))
+        assert out.tolist() == logits
+        assert len(seen_headers) == 2  # 503 then 200
+        assert seen_headers[0] == seen_headers[1]  # the SAME id, both attempts
+        assert seen_headers[0] == client.last_trace_id
+        assert valid_trace_id(client.last_trace_id)
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# export sidecar end-to-end (ObsPlane over a journal on disk + HTTP scrape)
+# ---------------------------------------------------------------------------
+
+def test_obs_plane_scrape_and_alarm_over_disk_journal(tmp_path):
+    base = str(tmp_path / "telemetry.jsonl")
+    j = Journal(base)
+    for r in _GOLDEN_LIVE:
+        j.append(r)
+    j.close()
+
+    from distribuuuu_tpu.obs.journal import ValidatedJournal
+
+    alarm_journal = ValidatedJournal(base + ".part4000", label="test sidecar")
+    plane = ObsPlane(
+        base,
+        alarm_event=alarm_journal.event,
+        alarm_engine=AlarmEngine(
+            parse_alarm_rules(["goodput_floor=goodput<0.99"]),
+            alarm_journal.event,
+        ),
+        port=0,  # ephemeral
+        interval_s=0.1,
+    )
+    plane.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{plane.server.port}/metrics", timeout=5
+        ) as resp:
+            assert resp.status == 200
+            assert "version=0.0.4" in resp.headers["Content-Type"]
+            text = resp.read().decode()
+        m = _parse_prom(text)
+        assert m["dtpu_goodput"] == pytest.approx(0.875)
+        assert np.isfinite(m["dtpu_imgs_per_sec"])
+        # the deliberately-high floor fired and is visible in the scrape
+        assert m["dtpu_alarm_active"] == 1.0
+        assert 'dtpu_alarm_active_info{alarm="goodput_floor"} 1' in text
+    finally:
+        plane.stop()
+        alarm_journal.close()
+    # the alarm record landed in the sidecar's OWN part, and the whole
+    # reassembled journal (run records + alarm part) is schema-valid
+    recs = list(read_journal(base))
+    alarms = [r for r in recs if r["kind"] == "alarm"]
+    assert len(alarms) == 1 and alarms[0]["rule"] == "goodput_floor"
+    assert validate_journal(base) == []
+
+
+def test_obs_plane_drain_consumes_whole_journal_past_read_limit(tmp_path):
+    """--once rides drain(): a journal larger than one poll's byte budget
+    must still be fully aggregated (and its alarms evaluated) before the
+    metrics are reported."""
+    base = str(tmp_path / "telemetry.jsonl")
+    n = 200
+    j = Journal(base)
+    for i in range(n):
+        j.append(_rec(i))
+    j.close()
+    plane = ObsPlane(base, alarm_engine=AlarmEngine([]))
+    plane.tailer.READ_LIMIT = 1024  # force many catch-up chunks
+    plane.drain()
+    # every fault_skipped_steps record was folded, not just the first chunk
+    snap = plane.aggregator.snapshot()
+    assert snap["last_record_ts"] == pytest.approx(float(n - 1))
+
+
+def test_run_export_once_prints_metrics(tmp_path, capsys):
+    base = str(tmp_path / "telemetry.jsonl")
+    j = Journal(base)
+    for r in _GOLDEN_LIVE:
+        j.append(r)
+    j.close()
+    from distribuuuu_tpu.obs.__main__ import main as obs_cli
+
+    assert obs_cli(["export", base, "--once"]) == 0
+    out = capsys.readouterr().out
+    m = _parse_prom(out)
+    assert m["dtpu_goodput"] == pytest.approx(0.875)
+    assert m["dtpu_steps_total"] == pytest.approx(30.0)
+
+
+# ---------------------------------------------------------------------------
+# summarize: tracing + alarm sections render from the journal alone
+# ---------------------------------------------------------------------------
+
+def test_summarize_renders_tracing_and_alarm_sections():
+    from distribuuuu_tpu.obs.summarize import render
+
+    records = [
+        {"ts": 1.0, "kind": "span", "trace_id": "t1", "phase": "queue_wait",
+         "ms": 2.0, "model": "rn18", "n": 4},
+        {"ts": 1.1, "kind": "span", "trace_id": "t1", "phase": "execute",
+         "ms": 30.0, "model": "rn18", "n": 4},
+        {"ts": 1.2, "kind": "span", "trace_id": "t1", "phase": "total",
+         "ms": 33.0, "model": "rn18", "n": 4},
+        {"ts": 2.0, "kind": "alarm", "rule": "goodput_floor",
+         "metric": "goodput", "value": 0.03, "threshold": 0.1, "op": "<"},
+        {"ts": 9.0, "kind": "alarm_clear", "rule": "goodput_floor",
+         "metric": "goodput", "value": 0.4, "threshold": 0.1, "active_s": 7.0},
+    ]
+    report = render(records)
+    assert "tracing:" in report
+    assert "execute" in report and "queue_wait" in report
+    assert "slowest trace t1 [rn18]: 33.0ms" in report
+    assert "alarms: 1 fired, 1 cleared" in report
+    assert "goodput_floor: goodput 0.03 < 0.1 — cleared after 7s" in report
+
+
+def test_summarize_still_active_alarm_is_loud():
+    from distribuuuu_tpu.obs.summarize import render
+
+    report = render([
+        {"ts": 2.0, "kind": "alarm", "rule": "p99", "metric": "serve_p99_ms",
+         "value": 400.0, "threshold": 250.0, "op": ">", "model": "rn18"},
+    ])
+    assert "STILL ACTIVE" in report and "p99[rn18]" in report
+
+
+def test_summarize_refired_alarm_is_not_reported_cleared():
+    """fire -> clear -> fire again, journal ends: the second firing pairs
+    with NO clear and must render STILL ACTIVE (a (rule, model)-keyed
+    lookup would match the old clear against both fires)."""
+    from distribuuuu_tpu.obs.summarize import render
+
+    report = render([
+        {"ts": 1.0, "kind": "alarm", "rule": "g", "metric": "goodput",
+         "value": 0.05, "threshold": 0.1, "op": "<"},
+        {"ts": 2.0, "kind": "alarm_clear", "rule": "g", "metric": "goodput",
+         "value": 0.4, "threshold": 0.1, "active_s": 1.0},
+        {"ts": 3.0, "kind": "alarm", "rule": "g", "metric": "goodput",
+         "value": 0.03, "threshold": 0.1, "op": "<"},
+    ])
+    assert "cleared after 1s" in report
+    assert "STILL ACTIVE at journal end" in report
+
+
+def test_summarize_engine_restart_does_not_misattribute_clear():
+    """fire (engine dies, no clear) -> restarted engine fires -> clears:
+    the clear belongs to the SECOND firing chronologically; the first must
+    read as lost state, not cleared, and the second must not read active."""
+    from distribuuuu_tpu.obs.summarize import render
+
+    report = render([
+        {"ts": 1.0, "kind": "alarm", "rule": "g", "metric": "goodput",
+         "value": 0.05, "threshold": 0.1, "op": "<"},
+        {"ts": 3.0, "kind": "alarm", "rule": "g", "metric": "goodput",
+         "value": 0.03, "threshold": 0.1, "op": "<"},
+        {"ts": 4.0, "kind": "alarm_clear", "rule": "g", "metric": "goodput",
+         "value": 0.4, "threshold": 0.1, "active_s": 1.0},
+    ])
+    assert "no clear recorded (engine restarted?)" in report
+    assert "cleared after 1s" in report
+    assert "STILL ACTIVE" not in report
+
+
+# ---------------------------------------------------------------------------
+# aggregator details the exporter golden doesn't cover
+# ---------------------------------------------------------------------------
+
+def test_aggregator_consecutive_skip_streak_and_reset():
+    agg = LiveAggregator()
+
+    def window(skipped, steps=4):
+        agg.ingest({"ts": 1.0, "kind": "window", "epoch": 0, "step": 0,
+                    "gstep": 0, "steps": steps, "skipped": skipped, "lr": 0.1,
+                    "step_time": 0.1, "data_time": 0.0, "imgs_per_sec": 1.0,
+                    "goodput": 0.5, "warmup": False})
+
+    window(4)  # fully-skipped windows extend the streak...
+    window(4)
+    assert agg.snapshot()["gauges"]["consecutive_skips"] == 8.0
+    window(0)  # a healthy window resets it
+    assert agg.snapshot()["gauges"]["consecutive_skips"] == 0.0
+    # sporadic skips must NOT accumulate across windows: 1 skip per window
+    # with healthy steps in between rebases to the window's own count, so
+    # the default skip_streak>3 alarm can't page on non-consecutive skips
+    for _ in range(5):
+        window(1)
+    assert agg.snapshot()["gauges"]["consecutive_skips"] == 1.0
+
+
+def test_aggregator_alarm_records_never_count_as_liveness():
+    """heartbeat_age_s must latch on a dead run: the plane's own alarm
+    records tail back in, and if they bumped last_record_ts the staleness
+    alarm would clear itself and flap forever."""
+    agg = LiveAggregator()
+    agg.ingest(_rec(0))  # worker record at ts=0
+    agg.ingest({"ts": 500.0, "kind": "alarm", "rule": "heartbeat_stale",
+                "metric": "heartbeat_age_s", "value": 400.0,
+                "threshold": 300.0, "op": ">"})
+    snap = agg.snapshot(now=600.0)
+    # age derives from the WORKER record (ts=0), not the alarm (ts=500)
+    assert snap["gauges"]["heartbeat_age_s"] == pytest.approx(600.0)
+    assert snap["active_alarms"] == ["heartbeat_stale"]  # still folded as state
+
+
+def test_aggregator_malformed_record_never_raises():
+    agg = LiveAggregator()
+    agg.ingest({"ts": 1.0, "kind": "serve_slo"})  # missing model
+    agg.ingest({"ts": 1.0, "kind": "window", "steps": "many"})
+    agg.ingest("not a dict")
+    assert agg.snapshot()["counters"].get("aggregator_fold_errors_total", 0) >= 1
+
+
+def test_aggregator_supervision_state():
+    agg = LiveAggregator()
+    agg.ingest({"ts": 1.0, "kind": "supervisor_launch", "attempt": 2,
+                "nprocs": 1, "host": 1})
+    agg.ingest({"ts": 2.0, "kind": "supervisor_exit", "attempt": 2,
+                "outcome": "crash", "codes": [1], "host": 1})
+    agg.ingest({"ts": 3.0, "kind": "supervisor_recovery", "attempt": 2,
+                "outcome": "crash", "action": "restart"})
+    snap = agg.snapshot(now=10.0)
+    assert snap["gauges"]["attempt"] == 2.0
+    assert snap["per_host"]["attempt"]["1"] == 2.0
+    assert snap["per_host"]["exits_total"]["1"] == 1.0
+    assert snap["counters"]["restarts_total"] == 1.0

@@ -515,6 +515,115 @@ def test_http_round_trip_and_journal(served):
     assert "compile[rn18]:" in report  # the serving compile column
 
 
+def test_http_trace_spans_under_one_client_minted_id(served):
+    """The ISSUE-11 acceptance path: one HTTP request produces journaled
+    ``span`` records covering queue-wait/pad/execute/total under the SINGLE
+    client-minted trace id, which the response echoes back."""
+    import urllib.request
+
+    from distribuuuu_tpu.serve.client import TRACE_HEADER, ServeClient
+
+    client = ServeClient([served.port], deadline_s=30)
+    x = np.random.default_rng(99).standard_normal((3, IM, IM, 3), dtype=np.float32)
+    client.predict("rn18", x)
+    tid = client.last_trace_id
+    assert tid
+
+    recs = list(read_journal(served.journal.path))
+    spans = [r for r in recs if r["kind"] == "span" and r["trace_id"] == tid]
+    phases = {s["phase"] for s in spans}
+    assert phases == {"queue_wait", "pad", "execute", "total"}, spans
+    for s in spans:
+        assert s["ms"] >= 0 and s["model"] == "rn18" and s["n"] == 3
+    total = next(s for s in spans if s["phase"] == "total")
+    execute = next(s for s in spans if s["phase"] == "execute")
+    assert total["ms"] >= execute["ms"]  # phases nest inside the total
+    # the serve_request record carries the id too (trace <-> request join)
+    reqs = [r for r in recs if r["kind"] == "serve_request"
+            and r.get("trace_id") == tid]
+    assert len(reqs) == 1
+
+    # the response echoes the id as a header (raw urllib, explicit header)
+    body = json.dumps({
+        "model": "rn18",
+        "inputs": x.tolist(),
+    }).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{served.port}/v1/predict", data=body,
+        headers={"Content-Type": "application/json", TRACE_HEADER: "my-trace-1"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.headers[TRACE_HEADER] == "my-trace-1"
+        assert json.loads(resp.read())["trace_id"] == "my-trace-1"
+    recs = list(read_journal(served.journal.path))
+    assert {s["phase"] for s in recs
+            if s["kind"] == "span" and s["trace_id"] == "my-trace-1"} == {
+        "queue_wait", "pad", "execute", "total"}
+    assert validate_journal(served.journal.path) == []
+
+
+def test_http_metrics_scrape_matches_slo_rollup(served):
+    """GET /metrics on the live frontend returns Prometheus gauges
+    (p50/p99/QPS/queue_depth) that match the journal's serve_slo rollup —
+    the other ISSUE-11 acceptance criterion."""
+    import urllib.request
+
+    from distribuuuu_tpu.serve.client import ServeClient
+
+    client = ServeClient([served.port], deadline_s=30)
+    for i in range(4):
+        x = np.random.default_rng(i).standard_normal((2, IM, IM, 3), dtype=np.float32)
+        client.predict("rn18", x)
+    served.slo.flush()  # roll the window -> serve_slo journaled + aggregated
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{served.port}/metrics", timeout=10
+    ) as resp:
+        assert resp.status == 200
+        assert "version=0.0.4" in resp.headers["Content-Type"]
+        text = resp.read().decode()
+    metrics = {}
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            name, value = line.rsplit(" ", 1)
+            metrics[name] = float(value)
+
+    # the newest serve_slo record for rn18 is exactly what the gauges show
+    slo = [r for r in read_journal(served.journal.path)
+           if r["kind"] == "serve_slo" and r["model"] == "rn18"][-1]
+    assert "queue_depth" in slo  # the autoscaler's backlog input
+    assert slo["replica"] == 0  # rollups are replica-stamped
+    labels = '{model="rn18",replica="0"}'
+    for field, metric in [("p50_ms", "dtpu_serve_p50_ms"),
+                          ("p99_ms", "dtpu_serve_p99_ms"),
+                          ("qps", "dtpu_serve_qps"),
+                          ("queue_depth", "dtpu_serve_queue_depth")]:
+        assert metrics[f"{metric}{labels}"] == pytest.approx(
+            slo[field]
+        ), f"{metric} != journal serve_slo.{field}"
+    # request/batch counters aggregate over the whole run
+    assert metrics[f"dtpu_serve_requests_total{labels}"] >= 4
+    assert metrics["dtpu_alarm_active"] >= 0.0
+
+
+def test_serve_steady_state_zero_compiles_with_tracing_on(served):
+    """Tracing + live aggregation must not perturb the AOT contract: a
+    traced request stream still compiles NOTHING (spans are host wall
+    timing only) — the acceptance's CompileGuard clause."""
+    from distribuuuu_tpu.analysis.guards import CompileGuard
+    from distribuuuu_tpu.serve.client import ServeClient
+
+    client = ServeClient([served.port], deadline_s=30)
+    with CompileGuard(exact=0, name="traced serve steady state") as guard:
+        for i, n in enumerate((1, 4, 8, 2)):
+            x = np.random.default_rng(100 + i).standard_normal(
+                (n, IM, IM, 3), dtype=np.float32
+            )
+            client.predict("vit", x)
+        served.metrics_text()  # a scrape is host work only
+    assert guard.compiles == 0
+
+
 # ---------------------------------------------------------------------------
 # agent tier: poison guard + serve-mode supervision
 # ---------------------------------------------------------------------------
