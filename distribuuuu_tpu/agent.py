@@ -575,6 +575,16 @@ class Agent:
         a = cfg.AGENT
         self.nprocs = int(a.NPROCS)
         self.serve = bool(a.SERVE) if "SERVE" in a else False
+        # dataplane mode: supervise one dtpu-dataplane service through the
+        # ordinary training-mode loop — the service is resume-incapable (no
+        # checkpoints), so poison exits take the backoff path via the
+        # existing _rollback_history_exists guard, and crash restarts ride
+        # the same budget/backoff every worker does (docs/DATA.md)
+        self.dataplane = bool(a.DATAPLANE) if "DATAPLANE" in a else False
+        if self.dataplane:
+            # one service per supervisor: a second process would lose the
+            # race for the same derived DATA.PORT and crash-loop the budget
+            self.nprocs = 1
         # fleet-managed mode (launched by the dtpu-fleet controller): the
         # recovery policy moves up to the controller — this agent runs ONE
         # attempt and forwards the merged outcome as its own exit code
@@ -618,6 +628,11 @@ class Agent:
     def _worker_cmd(self) -> list[str]:
         if cfg.AGENT.CMD:
             return shlex.split(cfg.AGENT.CMD)
+        if self.dataplane:
+            # dataplane mode's built-in worker is the input service with
+            # this same --cfg/overrides argv (it binds its derived DATA.PORT
+            # itself — no rendezvous env, no accelerator)
+            return [sys.executable, "-m", "distribuuuu_tpu.dataplane", *self._worker_argv]
         if self.serve:
             # serving mode's built-in worker is a dtpu-serve replica with
             # this same --cfg/overrides argv; its port rides DTPU_SERVE_PORT
@@ -643,7 +658,7 @@ class Agent:
             env["DTPU_SERVE_REPLICA"] = str(rank)
             if port is not None:
                 env["DTPU_SERVE_PORT"] = str(port)
-        elif self.nprocs > 1:
+        elif self.nprocs > 1:  # never in dataplane mode (nprocs forced to 1)
             env.update(
                 RANK=str(rank),
                 WORLD_SIZE=str(self.nprocs),
@@ -747,6 +762,11 @@ class Agent:
             self._hb_path,
             float(cfg.AGENT.HEARTBEAT_TIMEOUT_S),
             float(cfg.AGENT.HEARTBEAT_STARTUP_GRACE_S),
+            # dataplane mode: the supervised service journals into its
+            # supervisory .part3500 (dataplane_cache every ~10s) — the
+            # workers-only filter would blind the heartbeat to the ONLY
+            # writer and kill a healthy service on a timer
+            size_fn=_journal_bytes if self.dataplane else _worker_journal_bytes,
         )
         exit_deadline: float | None = None
         stop_deadline: float | None = None
@@ -905,7 +925,7 @@ class Agent:
             attempt += 1
             self._attempt = attempt
             port = None
-            if self.nprocs > 1:
+            if self.nprocs > 1:  # never in dataplane mode (nprocs forced to 1)
                 from distribuuuu_tpu.runtime.dist import pick_rendezvous_port
 
                 # never hand the fleet a rendezvous port a dtpu-serve
@@ -919,7 +939,9 @@ class Agent:
                 rollback=rollback,
                 port=port,
                 min_free_disk_gb=float(a.MIN_FREE_DISK_GB),
-                device_probe=bool(a.PREFLIGHT_DEVICE_PROBE),
+                # the dataplane never touches an accelerator: probing one
+                # would serialize a pointless jax bring-up into every launch
+                device_probe=bool(a.PREFLIGHT_DEVICE_PROBE) and not self.dataplane,
                 device_probe_timeout_s=float(a.DEVICE_PROBE_TIMEOUT_S),
                 probe_env=self._worker_env(0, attempt, rollback, port),
             )

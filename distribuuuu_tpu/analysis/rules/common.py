@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import ast
 import re
+from collections import deque
 from dataclasses import dataclass, field
 
 
@@ -170,10 +171,17 @@ class ParentMap:
     def __init__(self, tree: ast.AST):
         self._parent: dict[ast.AST, ast.AST] = {}
         self.nodes: list[ast.AST] = [tree]
-        for parent in ast.walk(tree):
+        # fused BFS: ast.walk(tree) + iter_child_nodes(parent) per yield
+        # would iterate every child list twice — this single queue walk
+        # produces the identical BFS node order at half the iteration cost
+        # (the analyzer's --stats wall budget is a pinned CI constraint)
+        todo = deque([tree])
+        while todo:
+            parent = todo.popleft()
             for child in ast.iter_child_nodes(parent):
                 self._parent[child] = parent
                 self.nodes.append(child)
+                todo.append(child)
 
     def parent(self, node: ast.AST) -> ast.AST | None:
         return self._parent.get(node)

@@ -150,6 +150,47 @@ _C.MESH.FSDP = 1
 # a collective). The census of what sharded is logged and journaled.
 _C.MESH.FSDP_MIN_SIZE = 16384
 
+# Dataplane (TPU addition; docs/DATA.md). `dtpu-dataplane --cfg ...` runs a
+# disaggregated input service — a dispatcher owning the seed+epoch-keyed
+# sample permutation plus N decode workers — and trainers opt in per run:
+# the sample stream is bitwise-identical to local decode either way.
+_C.DATA = CN()
+# Where this run's loaders get batches: "" or "local" = decode on this host
+# (the default per-host thread producer); "host:port" = stream from a
+# running dtpu-dataplane dispatcher; "fleet" = the fleet controller
+# co-schedules a service next to the gangs and injects its address via the
+# DTPU_DATA_SERVICE env var (which always overrides this key).
+_C.DATA.SERVICE = ""
+# Dispatcher bind. PORT 0 derives a stable port from OUT_DIR
+# (runtime/dist.derive_dataplane_port) so trainer hosts and the service
+# agree on the address without parsing each other's output.
+_C.DATA.HOST = "127.0.0.1"
+_C.DATA.PORT = 0
+# The address CLIENTS are told to connect to ("" = DATA.HOST). Separate
+# because bind and connect addresses diverge the moment the fleet spans
+# machines: a dispatcher bound to 0.0.0.0 must advertise its routable IP,
+# never the bind wildcard (and never loopback, which every remote host
+# resolves to itself).
+_C.DATA.ADVERTISE_HOST = ""
+# Decode worker pool: processes x threads (THREADS 0: cpu_count/WORKERS).
+_C.DATA.WORKERS = 2
+_C.DATA.WORKER_THREADS = 0
+# Decoded-batch LRU cache, keyed by (shards, index range, transform
+# fingerprint, epoch seed): multiple jobs / eval re-reads / epoch replays
+# share one decode. Size it to a few epochs of the hot streams.
+_C.DATA.CACHE_MB = 256
+# A lease not completed within this window re-issues to another worker
+# (a worker whose CONNECTION drops re-issues immediately; this clock only
+# covers silently-wedged workers).
+_C.DATA.LEASE_TIMEOUT_S = 30.0
+# How many batches ahead of the slowest consumer the dispatcher keeps
+# leased per stream (the decode-ahead depth, and the ready-buffer bound).
+_C.DATA.WINDOW = 8
+# Client behavior when the dispatcher dies mid-epoch: fall back to local
+# decode at the exact next undelivered batch (bitwise-identical stream,
+# typed dataplane_fallback journal record). Off = fail the run loudly.
+_C.DATA.FALLBACK = True
+
 # Fault tolerance (TPU addition; docs/FAULT_TOLERANCE.md). The reference has
 # no mid-epoch failure story; these knobs govern the resilience layer.
 _C.FAULT = CN()
@@ -316,6 +357,11 @@ _C.AGENT.CPU_DEVICES_PER_WORKER = 0
 # exits never attempt checkpoint rollback here (a serving replica has no
 # checkpoints): they take the backoff/budget path with a typed reason.
 _C.AGENT.SERVE = False
+# Dataplane mode (docs/DATA.md): supervise one dtpu-dataplane service
+# instead of a training fleet. Rides the exact restart budget / backoff /
+# preflight machinery; the service has no checkpoints, so a poison exit
+# takes the backoff path (the same resume-incapable-worker rule as serve).
+_C.AGENT.DATAPLANE = False
 
 # Serving (TPU addition; docs/SERVING.md). `dtpu-serve --cfg ...` hosts the
 # model zoo behind a batched inference engine: AOT-compiled forward passes at

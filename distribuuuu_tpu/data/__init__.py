@@ -7,9 +7,12 @@ from distribuuuu_tpu.data.dataset import (
     open_image_dataset,
 )
 from distribuuuu_tpu.data.loader import (
+    aug_seed_base,
     construct_train_loader,
     construct_val_loader,
     prefetch_to_device,
+    shard_indices,
+    transform_fingerprint,
 )
 
 __all__ = [
@@ -17,7 +20,10 @@ __all__ = [
     "ImageFolder",
     "TarImageFolder",
     "open_image_dataset",
+    "aug_seed_base",
     "construct_train_loader",
     "construct_val_loader",
     "prefetch_to_device",
+    "shard_indices",
+    "transform_fingerprint",
 ]

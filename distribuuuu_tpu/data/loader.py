@@ -44,6 +44,55 @@ from distribuuuu_tpu.data.transforms import eval_transform_u8, train_transform_u
 from distribuuuu_tpu.logging import logger
 
 
+def shard_indices(
+    total: int,
+    *,
+    train: bool,
+    seed: int,
+    epoch: int,
+    process_index: int,
+    process_count: int,
+) -> np.ndarray:
+    """The per-host sample-index stream for one (seed, epoch) — the
+    DistributedSampler contract `HostDataLoader._shard_indices` documents,
+    as a pure function so the dataplane service (distribuuuu_tpu/dataplane/)
+    derives the exact same stream dispatcher-side. This function IS the
+    sample-order oracle: service-vs-local bitwise equality reduces to both
+    sides calling it with the same arguments."""
+    shard_size = (total + process_count - 1) // process_count
+    if train:
+        g = np.random.default_rng(seed + epoch)
+        order = g.permutation(total)
+    else:
+        order = np.arange(total)
+    pad = shard_size * process_count - total
+    if pad > 0:
+        if train:
+            order = np.concatenate([order, order[:pad]])
+        else:
+            order = np.concatenate([order, np.full(pad, -1, dtype=order.dtype)])
+    return order[process_index::process_count]
+
+
+def aug_seed_base(seed: int, epoch: int, process_index: int) -> int:
+    """Base of the per-host, per-epoch augmentation-seed stream (the
+    reference's seed+rank analog, `utils.py:60-65`); slot ``b*host_batch+i``
+    augments with ``base + b*host_batch + i``. Pure for the same reason as
+    :func:`shard_indices` — both sides of the dataplane must agree."""
+    return ((seed * 1_000_003 + epoch) * 7919 + process_index * 104_729) & 0x7FFFFFFF
+
+
+def transform_fingerprint(*, train: bool, im_size: int, crop_size: int) -> str:
+    """Identity of the decode+augment pipeline a batch was produced by —
+    the dataplane cache-key component that keeps a cache shared by many
+    jobs from serving eval-transformed pixels to a train stream (or
+    native-decoded pixels to a PIL host: the two backends are not bitwise
+    aliases, so the backend is part of the identity)."""
+    backend = "native" if native.available() else "pil"
+    mode = f"train{im_size}" if train else f"eval{im_size}c{crop_size}"
+    return f"{backend}:{mode}"
+
+
 def _qput(out_q: queue.Queue, item, stop: threading.Event) -> bool:
     """Bounded put that gives up when the consumer is gone (never blocks
     forever on a full queue after an aborted epoch). Used by the decode
@@ -139,19 +188,14 @@ class HostDataLoader:
         when ``shard_size % host_batch`` leaves them before the drop_last
         tail — identical to torch's DistributedSampler, which also trains on
         its wrap padding (`utils.py:141-152` parity, not a divergence)."""
-        total = len(self.dataset)
-        if self.train:
-            g = np.random.default_rng(self.seed + self.epoch)
-            order = g.permutation(total)
-        else:
-            order = np.arange(total)
-        pad = self.shard_size * self.process_count - total
-        if pad > 0:
-            if self.train:
-                order = np.concatenate([order, order[:pad]])
-            else:
-                order = np.concatenate([order, np.full(pad, -1, dtype=order.dtype)])
-        return order[self.process_index :: self.process_count]
+        return shard_indices(
+            len(self.dataset),
+            train=self.train,
+            seed=self.seed,
+            epoch=self.epoch,
+            process_index=self.process_index,
+            process_count=self.process_count,
+        )
 
     def _load_one(self, idx: int, slot_seed: int):
         """Retryable per-sample load with graceful degradation.
@@ -245,9 +289,7 @@ class HostDataLoader:
         indices = self._shard_indices()
         # per-host, per-epoch augmentation stream (the reference's seed+rank
         # analog, `utils.py:60-65`): distinct crops/flips on every host
-        base = (
-            (self.seed * 1_000_003 + self.epoch) * 7919 + self.process_index * 104_729
-        ) & 0x7FFFFFFF
+        base = aug_seed_base(self.seed, self.epoch, self.process_index)
         try:
             self._produce_batches(out_q, stop, indices, base)
         except BaseException as exc:
@@ -261,34 +303,46 @@ class HostDataLoader:
             # end-marker: waits for queue space unless the consumer is gone
             _qput(out_q, None, stop)
 
+    def decode_batch(self, b: int, *, indices=None, base=None, pool=None) -> dict:
+        """Decode batch ``b`` of the current (seed, epoch) stream.
+
+        The one decode path both the in-process producer and the dataplane
+        decode worker (distribuuuu_tpu/dataplane/worker.py) run — which is
+        what makes a service-fed stream bitwise-identical to local decode.
+        ``indices``/``base``/``pool`` are loop-hoisted by callers that decode
+        many batches; one-shot callers omit them.
+        """
+        if indices is None:
+            indices = self._shard_indices()
+        if base is None:
+            base = aug_seed_base(self.seed, self.epoch, self.process_index)
+        chunk = indices[b * self.host_batch : (b + 1) * self.host_batch]
+        slot0 = b * self.host_batch
+        seeds = [base + slot0 + i for i in range(len(chunk))]
+        if pool is not None:
+            results = list(pool.map(self._load_one, chunk, seeds))
+        else:
+            results = [self._load_one(i, s) for i, s in zip(chunk, seeds)]
+        images = np.stack([r[0] for r in results])
+        labels = np.array([r[1] for r in results], dtype=np.int32)
+        weights = np.array([r[2] for r in results], dtype=np.float32)
+        if not self.train and len(chunk) < self.host_batch:
+            # pad final eval batch to a static shape (weight 0)
+            short = self.host_batch - len(chunk)
+            images = np.concatenate([images, np.zeros((short, *images.shape[1:]), images.dtype)])
+            labels = np.concatenate([labels, np.zeros((short,), labels.dtype)])
+            weights = np.concatenate([weights, np.zeros((short,), weights.dtype)])
+        return {"image": images, "label": labels, "weight": weights}
+
     def _produce_batches(self, out_q, stop, indices, base) -> None:
         with ThreadPoolExecutor(self.workers) as pool:
             for b in range(self.start_batch, self.num_batches):
                 if stop.is_set():
                     return
-                chunk = indices[b * self.host_batch : (b + 1) * self.host_batch]
-                if self.train and len(chunk) < self.host_batch:
-                    break
-                slot0 = b * self.host_batch
-                results = list(
-                    pool.map(
-                        self._load_one,
-                        chunk,
-                        [base + slot0 + i for i in range(len(chunk))],
-                    )
-                )
-                images = np.stack([r[0] for r in results])
-                labels = np.array([r[1] for r in results], dtype=np.int32)
-                weights = np.array([r[2] for r in results], dtype=np.float32)
-                if not self.train and len(chunk) < self.host_batch:
-                    # pad final eval batch to a static shape (weight 0)
-                    short = self.host_batch - len(chunk)
-                    images = np.concatenate([images, np.zeros((short, *images.shape[1:]), images.dtype)])
-                    labels = np.concatenate([labels, np.zeros((short,), labels.dtype)])
-                    weights = np.concatenate([weights, np.zeros((short,), weights.dtype)])
-                if not _qput(
-                    out_q, {"image": images, "label": labels, "weight": weights}, stop
-                ):
+                if self.train and len(indices) < (b + 1) * self.host_batch:
+                    break  # defensive: drop_last tail (num_batches bounds it)
+                batch = self.decode_batch(b, indices=indices, base=base, pool=pool)
+                if not _qput(out_q, batch, stop):
                     return
 
     @staticmethod
@@ -400,6 +454,42 @@ def _topology(mesh=None):
     )
 
 
+def _service_address() -> str:
+    """The dataplane service address this process should stream from.
+
+    ``DTPU_DATA_SERVICE`` (set by the fleet controller for co-scheduled
+    gangs, dataplane/service.py for ad-hoc runs) overrides ``DATA.SERVICE``;
+    ``""``/``"local"`` both mean decode on this host."""
+    addr = os.environ.get("DTPU_DATA_SERVICE", "").strip()
+    if not addr and "DATA" in cfg:
+        addr = str(cfg.DATA.SERVICE).strip()
+    return "" if addr.lower() in ("", "local", "fleet") else addr
+
+
+def _service_loader(root: str, *, train: bool, host_batch: int, im_size: int,
+                    crop_size: int, proc: int, nproc: int):
+    """A ServiceLoader for the resolved DATA.SERVICE address (None when the
+    run is configured for local decode)."""
+    address = _service_address()
+    if not address:
+        return None
+    from distribuuuu_tpu.dataplane.client import ServiceLoader
+
+    return ServiceLoader(
+        address,
+        root=root,
+        train=train,
+        host_batch=host_batch,
+        im_size=im_size,
+        crop_size=crop_size,
+        process_index=proc,
+        process_count=nproc,
+        seed=cfg.RNG_SEED or 0,
+        workers=cfg.TRAIN.WORKERS,
+        prefetch_batches=cfg.TRAIN.PREFETCH * 2,
+    )
+
+
 def construct_train_loader(mesh=None):
     """Train loader (reference `construct_train_loader`, `utils.py:121-152`)."""
     proc, nproc, local_dev, global_dev = _topology(mesh)
@@ -417,7 +507,14 @@ def construct_train_loader(mesh=None):
             num_batches=cfg.TRAIN.DUMMY_EPOCH_SAMPLES
             // max(1, step_batch * global_dev),
         )
-    dataset = open_image_dataset(os.path.join(cfg.TRAIN.DATASET, cfg.TRAIN.SPLIT))
+    root = os.path.join(cfg.TRAIN.DATASET, cfg.TRAIN.SPLIT)
+    service = _service_loader(
+        root, train=True, host_batch=host_batch, im_size=cfg.TRAIN.IM_SIZE,
+        crop_size=cfg.TEST.CROP_SIZE, proc=proc, nproc=nproc,
+    )
+    if service is not None:
+        return service
+    dataset = open_image_dataset(root)
     return HostDataLoader(
         dataset,
         host_batch=host_batch,
@@ -458,7 +555,14 @@ def construct_val_loader(mesh=None):
         if cfg.TEST.DATASET != get_default("TEST.DATASET")
         else cfg.TRAIN.DATASET
     )
-    dataset = open_image_dataset(os.path.join(val_root, cfg.TEST.SPLIT))
+    root = os.path.join(val_root, cfg.TEST.SPLIT)
+    service = _service_loader(
+        root, train=False, host_batch=host_batch, im_size=cfg.TEST.IM_SIZE,
+        crop_size=cfg.TEST.CROP_SIZE, proc=proc, nproc=nproc,
+    )
+    if service is not None:
+        return service
+    dataset = open_image_dataset(root)
     return HostDataLoader(
         dataset,
         host_batch=host_batch,

@@ -1,0 +1,78 @@
+"""CLI: ``python -m distribuuuu_tpu.dataplane`` / ``dtpu-dataplane``.
+
+Two modes, one entry point:
+
+- **service** (default): the dispatcher + worker pool, same
+  ``--cfg``/overrides contract as every other CLI. Prints the address and
+  exports it as ``DTPU_DATA_SERVICE`` for any child it spawns. Runs until
+  SIGTERM/SIGINT.
+- **worker** (``--worker --address H:P --id wN``): one decode worker child —
+  what the service mode spawns; also what a remote CPU VM runs to join an
+  existing dispatcher from another machine.
+
+The process never initializes an accelerator backend — the chips belong to
+the trainers this tier feeds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    parser = argparse.ArgumentParser(
+        prog="dtpu-dataplane",
+        description="Disaggregated input service: decode-once shard serving "
+        "for pod-scale training (docs/DATA.md).",
+        add_help=False,
+    )
+    parser.add_argument("--worker", action="store_true")
+    parser.add_argument("--address", default="")
+    parser.add_argument("--id", default="w0", dest="worker_id")
+    parser.add_argument("--threads", type=int, default=4)
+    args, rest = parser.parse_known_args(argv)
+
+    from distribuuuu_tpu.config import load_cfg_fom_args
+    from distribuuuu_tpu.logging import setup_logger
+
+    load_cfg_fom_args("dtpu-dataplane: disaggregated input service.", argv=rest)
+    setup_logger(None, 0)  # stderr only: OUT_DIR's log file belongs to rank 0
+
+    if args.worker:
+        if not args.address:
+            print("--worker requires --address host:port", file=sys.stderr)
+            return 2
+        from distribuuuu_tpu.dataplane.worker import run_worker
+
+        stop = threading.Event()
+        for signum in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(signum, lambda *_: stop.set())
+        run_worker(
+            args.address, args.worker_id, threads=args.threads, stop=stop
+        )
+        return 0
+
+    from distribuuuu_tpu.dataplane.service import DataPlaneService
+
+    service = DataPlaneService.from_cfg(worker_argv=rest)
+    stop = threading.Event()
+    for signum in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(signum, lambda *_: stop.set())
+    service.start()
+    service.start_obs_plane()
+    print(f"dtpu-dataplane: serving at {service.address}", flush=True)
+    try:
+        # periodic cache/lease rollup so a tailing ObsPlane sees live gauges
+        while not stop.wait(10.0):
+            service.journal_stats()
+    finally:
+        service.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,345 @@
+"""`ServiceLoader`: the trainer-side dataplane client (``DATA.SERVICE``).
+
+A drop-in for `HostDataLoader` — same ``set_epoch(epoch, start_batch)`` /
+``__len__`` / dict-batch iteration contract — that streams ready
+``{image,label,weight}`` batches from the dispatcher instead of decoding
+locally, feeding the existing `prefetch_to_device` double-buffering
+unchanged (identical shapes and dtypes: `CompileGuard` sees zero new
+compiles when a run flips to service feed).
+
+Failure policy (every socket path rides `resilience.retry` + the
+`FaultInjector` seam):
+
+- transient blips reconnect and re-register the stream *at the next
+  undelivered batch* — the dispatcher's visit-once accounting means nothing
+  is lost or double-seen across the gap;
+- a dispatcher that stays dead triggers **local fallback** (``DATA.
+  FALLBACK``): the loader builds the plain `HostDataLoader` it replaced,
+  fast-forwards it to the next undelivered batch, and finishes the epoch
+  bitwise-identically (both sides decode the same `shard_indices` stream) —
+  journaled as a typed ``dataplane_fallback`` record so the data-wait alarm
+  points at the tier that actually failed.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from distribuuuu_tpu import obs, resilience
+from distribuuuu_tpu.dataplane import protocol
+from distribuuuu_tpu.dataplane.protocol import StreamSpec
+from distribuuuu_tpu.logging import logger
+
+
+def _fallback_enabled() -> bool:
+    from distribuuuu_tpu.config import cfg
+
+    return bool(cfg.DATA.FALLBACK) if "DATA" in cfg else True
+
+
+def _io_timeout_s() -> float:
+    """Data-path socket timeout: a `next` legitimately blocks while a batch
+    decodes (worst case: its lease must expire and re-issue first), so the
+    read timeout must comfortably exceed DATA.LEASE_TIMEOUT_S — a 30s-fixed
+    timeout would misread a merely-slow service as dead and silently
+    degrade the run to local decode."""
+    from distribuuuu_tpu.config import cfg
+
+    lease = float(cfg.DATA.LEASE_TIMEOUT_S) if "DATA" in cfg else 30.0
+    return max(60.0, 4.0 * lease)
+
+
+class ServiceLoader:
+    """Per-host loader over a dataplane service stream."""
+
+    def __init__(
+        self,
+        address: str,
+        *,
+        root: str,
+        train: bool,
+        host_batch: int,
+        im_size: int,
+        crop_size: int = 224,
+        process_index: int,
+        process_count: int,
+        seed: int,
+        workers: int = 4,
+        prefetch_batches: int = 4,
+        fallback: bool | None = None,
+        injector: "resilience.FaultInjector | None" = None,
+    ):
+        from distribuuuu_tpu.data.loader import transform_fingerprint
+
+        self.address = str(address)
+        self.root = str(root)
+        self.train = bool(train)
+        self.host_batch = int(host_batch)
+        self.im_size = int(im_size)
+        self.crop_size = int(crop_size)
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self.seed = int(seed)
+        self.workers = int(workers)
+        self.prefetch_batches = max(1, int(prefetch_batches))
+        self.fallback = _fallback_enabled() if fallback is None else bool(fallback)
+        self.injector = injector if injector is not None else resilience.FaultInjector()
+        self.fingerprint = transform_fingerprint(
+            train=self.train, im_size=self.im_size, crop_size=self.crop_size
+        )
+        self.epoch = 0
+        self.start_batch = 0
+        self._local = None  # the HostDataLoader this stream degraded to
+        try:
+            info = resilience.retry(
+                self._request_info,
+                retry_on=(OSError, EOFError),
+                desc=f"dataplane info {self.address}",
+            )
+            self.num_batches = int(info["num_batches"])
+            self._total = int(info["total"])
+        except (OSError, EOFError) as exc:
+            # service unreachable at construction: degrade to local decode
+            # for the whole run (or fail loudly when fallback is off)
+            if not self.fallback:
+                raise
+            self._note_fallback("connect_failed", 0, 0, exc)
+            self._build_local(0)
+        if self.train and self.num_batches == 0:
+            raise ValueError(
+                f"Training dataset at {self.root} yields zero batches per "
+                f"epoch at host batch {self.host_batch} x "
+                f"{self.process_count} host(s); reduce TRAIN.BATCH_SIZE / "
+                f"TRAIN.ACCUM_STEPS"
+            )
+
+    # -- HostDataLoader contract ---------------------------------------------
+
+    def set_epoch(self, epoch: int, start_batch: int = 0) -> None:
+        if not 0 <= start_batch <= self.num_batches:
+            raise ValueError(
+                f"set_epoch(start_batch={start_batch}) outside this "
+                f"topology's epoch of {self.num_batches} batches"
+            )
+        self.epoch = int(epoch)
+        self.start_batch = int(start_batch)
+        if self._local is not None:
+            # fallback is per-EPOCH, not per-run: a restarted dispatcher (the
+            # fleet sidecar's whole recovery story) gets this stream back at
+            # the next epoch boundary — one cheap probe, no retry storm
+            try:
+                self._request_info(timeout_s=3.0)
+            except (OSError, EOFError):
+                self._local.set_epoch(epoch, start_batch)
+                return
+            logger.info(
+                f"dataplane: service at {self.address} is back; epoch "
+                f"{epoch} returns to service feed"
+            )
+            self._local = None
+
+    def __len__(self) -> int:
+        return self.num_batches
+
+    def __iter__(self):
+        if self._local is not None:
+            yield from self._local
+            return
+        yield from self._stream_epoch()
+
+    # -- wire ----------------------------------------------------------------
+
+    def _spec(self, start_batch: int) -> StreamSpec:
+        return StreamSpec(
+            root=self.root,
+            train=self.train,
+            seed=self.seed,
+            epoch=self.epoch,
+            im_size=self.im_size,
+            crop_size=self.crop_size,
+            host_batch=self.host_batch,
+            process_index=self.process_index,
+            process_count=self.process_count,
+            start_batch=int(start_batch),
+            fingerprint=self.fingerprint,
+        )
+
+    def _request_info(self, timeout_s: float = 10.0) -> dict:
+        sock, f = protocol.connect(self.address, timeout_s=timeout_s)
+        try:
+            protocol.send_msg(f, {"op": "info", "spec": self._spec(0).to_dict()})
+            reply, _ = protocol.recv_msg(f)
+            if not reply.get("ok"):
+                raise protocol.ProtocolError(f"info refused: {reply}")
+            return reply
+        finally:
+            f.close()
+            sock.close()
+
+    def _open_stream(self, start_batch: int):
+        """Connect + register (retried); returns ``(sock, rwfile)``."""
+
+        def _dial():
+            sock, f = protocol.connect(self.address, timeout_s=_io_timeout_s())
+            try:
+                protocol.send_msg(
+                    f,
+                    {"op": "register_stream", "spec": self._spec(start_batch).to_dict()},
+                )
+                reply, _ = protocol.recv_msg(f)
+                if not reply.get("ok"):
+                    raise protocol.ProtocolError(f"stream refused: {reply}")
+                return sock, f
+            except BaseException:
+                f.close()
+                sock.close()
+                raise
+
+        return resilience.retry(
+            _dial, retry_on=(OSError, EOFError),
+            desc=f"dataplane stream {self.address}",
+        )
+
+    def _stream_epoch(self):
+        """Pull batches ``[start_batch, num_batches)`` in order, pipelining
+        up to ``prefetch_batches`` requests so the link stays full; on an
+        unrecoverable service loss, hand the rest of the epoch to local
+        decode at the exact next undelivered batch."""
+        delivered = self.start_batch
+        sock = f = None
+        # consecutive recoveries without yielding a batch: a dispatcher that
+        # is ALIVE but keeps refusing (e.g. restarted over a changed dataset
+        # root, so our num_batches no longer matches its geometry) must hit
+        # the fallback/failure path, not reconnect-loop forever
+        stalled_recoveries = 0
+        try:
+            while delivered < self.num_batches:
+                try:
+                    if f is None:
+                        sock, f = self._open_stream(delivered)
+                        inflight: list[int] = []
+                        next_req = delivered
+                    while (
+                        next_req < self.num_batches
+                        and len(inflight) < self.prefetch_batches
+                    ):
+                        self.injector.maybe_fail_io(next_req)
+                        protocol.send_msg(f, {"op": "next", "batch": next_req})
+                        inflight.append(next_req)
+                        next_req += 1
+                    t_wait = time.monotonic()
+                    reply, arrays = protocol.recv_msg(f)
+                    obs.current().add_wait(
+                        "decode_wait_s", time.monotonic() - t_wait
+                    )
+                    if not reply.get("ok"):
+                        error = str(reply.get("error", "?"))
+                        if error.startswith("decode_failed"):
+                            # the batch is poisoned service-side (a corrupt
+                            # shard region no worker could decode): local
+                            # decode would fail the same way — fail loudly,
+                            # do NOT reconnect-loop or silently fall back
+                            raise RuntimeError(
+                                f"dataplane batch {inflight[0]} undecodable: "
+                                f"{error}"
+                            )
+                        raise protocol.ProtocolError(f"next refused: {error}")
+                    b = inflight.pop(0)  # replies come back in request order
+                    if int(reply.get("batch", b)) != b:
+                        raise protocol.ProtocolError(
+                            f"out-of-order reply: wanted {b}, "
+                            f"got {reply.get('batch')}"
+                        )
+                except (OSError, EOFError) as exc:
+                    for closeable in (f, sock):
+                        if closeable is not None:
+                            try:
+                                closeable.close()
+                            except OSError:
+                                pass
+                    sock = f = None
+                    stalled_recoveries += 1
+                    try:
+                        if stalled_recoveries > 5:
+                            raise exc  # no progress across 5 reconnects:
+                            # the service is up but unusable — degrade
+                        sock, f = self._open_stream(delivered)
+                        inflight, next_req = [], delivered
+                        continue  # visit-once accounting upstream: nothing
+                        # was lost or double-seen across the reconnect
+                    except (OSError, EOFError) as exc2:
+                        if not self.fallback:
+                            # no dataplane_fallback record here: nothing
+                            # fell back — the run dies loudly instead
+                            raise RuntimeError(
+                                f"dataplane service {self.address} lost "
+                                f"mid-epoch (batch {delivered}) and "
+                                f"DATA.FALLBACK is off"
+                            ) from exc
+                        self._note_fallback(
+                            "dispatcher_lost", self.epoch, delivered, exc2
+                        )
+                        self._build_local(delivered)
+                        yield from self._local
+                        return
+                yield {
+                    "image": arrays["image"],
+                    "label": arrays["label"],
+                    "weight": arrays["weight"],
+                }
+                delivered = b + 1
+                stalled_recoveries = 0  # progress: the link works again
+        finally:
+            for closeable in (f, sock):
+                if closeable is not None:
+                    try:
+                        if closeable is f:
+                            protocol.send_msg(f, {"op": "end"})
+                        closeable.close()
+                    except OSError:
+                        pass
+
+    # -- local fallback ------------------------------------------------------
+
+    def _build_local(self, start_batch: int) -> None:
+        """The HostDataLoader this service stream replaces, fast-forwarded to
+        the next undelivered batch — the remaining stream is bitwise what the
+        service would have sent (both decode `shard_indices` order)."""
+        from distribuuuu_tpu.data.dataset import open_image_dataset
+        from distribuuuu_tpu.data.loader import HostDataLoader
+
+        self._local = HostDataLoader(
+            open_image_dataset(self.root),
+            host_batch=self.host_batch,
+            train=self.train,
+            im_size=self.im_size,
+            process_index=self.process_index,
+            process_count=self.process_count,
+            workers=self.workers,
+            seed=self.seed,
+            prefetch_batches=self.prefetch_batches,
+            crop_size=self.crop_size,
+        )
+        self.num_batches = getattr(self, "num_batches", len(self._local)) or len(
+            self._local
+        )
+        self._local.set_epoch(self.epoch, start_batch)
+
+    def _note_fallback(self, reason: str, epoch: int, batch: int, exc) -> None:
+        logger.warning(
+            f"dataplane: falling back to local decode ({reason} at epoch "
+            f"{epoch} batch {batch}): {exc!r}"
+        )
+        obs.current().event(
+            "dataplane_fallback",
+            reason=reason,
+            epoch=int(epoch),
+            batch=int(batch),
+            error=repr(exc),
+        )
+
+
+def service_env_address() -> str:
+    """The co-scheduled service address, if a supervisor exported one."""
+    return os.environ.get("DTPU_DATA_SERVICE", "").strip()

@@ -1,0 +1,548 @@
+"""The dataplane dispatcher: stream registry, lease accounting, batch cache.
+
+The dispatcher owns the *order* of every sample stream and the *identity* of
+every decoded batch; decode workers own only CPU time. Three pieces:
+
+- `LeaseTable` — visit-once accounting for one stream's batch indices. A
+  batch is leased to exactly one worker at a time; a worker that dies (its
+  connection drops) or stalls past the lease timeout gets its leases
+  re-issued, and a late completion from the original worker is *dropped*,
+  never double-delivered. Whatever the failure interleaving, each batch is
+  accepted exactly once — the "zero lost / zero double-seen samples"
+  invariant the chaos tests pin.
+- `BatchCache` — byte-bounded LRU of decoded batches keyed by
+  `StreamSpec.cache_key` (shards, index range, transform fingerprint,
+  epoch seed). Before leasing a batch the dispatcher consults the cache, so
+  a second job / an eval re-read / a resumed epoch with the same spec is a
+  cache hit, not a second decode — the decode-once story.
+- `Dispatcher` — the threaded TCP server speaking `protocol`'s framed
+  JSON-line dialect to clients (register_stream / next / end) and workers
+  (register_worker / lease / done). Per-stream `ready` buffers hold decoded
+  batches from lease to delivery with strong references, so cache eviction
+  can never lose an unconsumed batch.
+
+The dispatcher never decodes and never touches an accelerator — it is pure
+bookkeeping plus sendfile-shaped byte shuffling, sized to run beside the
+fleet controller on a CPU VM.
+"""
+
+from __future__ import annotations
+
+import socketserver
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable
+
+import numpy as np
+
+from distribuuuu_tpu.dataplane import protocol
+from distribuuuu_tpu.dataplane.protocol import StreamSpec
+from distribuuuu_tpu.logging import logger
+
+
+class LeaseTable:
+    """Visit-once lease accounting for one stream's batch indices.
+
+    Not thread-safe by itself — the dispatcher serializes access under its
+    lock; kept lock-free so the unit tests can drive interleavings directly.
+    """
+
+    def __init__(self, lease_timeout_s: float = 30.0):
+        self.lease_timeout_s = float(lease_timeout_s)
+        self._leases: dict[int, tuple[str, float]] = {}  # batch -> (worker, deadline)
+        self._done: set[int] = set()
+        self._retries: dict[int, int] = {}
+        self.reissues = 0
+
+    def done(self, batch: int) -> bool:
+        return batch in self._done
+
+    def leased(self, batch: int) -> bool:
+        return batch in self._leases
+
+    def claim(self, candidates, worker: str, now: float | None = None) -> int | None:
+        """Lease the first candidate that is neither done nor actively
+        leased. An *expired* lease re-issues (counted) — its worker stalled
+        or its death was not observed as a disconnect."""
+        now = time.monotonic() if now is None else now
+        for b in candidates:
+            if b in self._done:
+                continue
+            held = self._leases.get(b)
+            if held is not None:
+                if held[1] > now:
+                    continue
+                self.reissues += 1  # expired: re-issue to this worker
+            self._leases[b] = (worker, now + self.lease_timeout_s)
+            return b
+        return None
+
+    def complete(self, worker: str, batch: int) -> bool:
+        """Accept a completion. Returns False (drop it) when the batch was
+        already accepted — the visit-once half of zero-double-seen: a lease
+        that expired and re-issued can complete twice, but only the first
+        completion lands."""
+        if batch in self._done:
+            return False
+        self._done.add(batch)
+        self._leases.pop(batch, None)
+        self._retries.pop(batch, None)
+        return True
+
+    def reopen(self, batch: int) -> None:
+        """Re-queue a DONE batch whose payload no longer exists anywhere
+        (evicted from the cache before a lagging consumer collected it) —
+        'done' means 'the bytes are available', not 'decoded once ever'.
+        Without this, a second equal-spec client arriving after eviction
+        would wait forever on a batch nobody will ever re-decode."""
+        self._done.discard(batch)
+
+    def fail(self, worker: str, batch: int, *, max_retries: int = 3) -> bool:
+        """A worker reported a decode failure; re-queue the batch for another
+        attempt. Returns False once the batch burned ``max_retries`` attempts
+        — the stream is poisoned and the client must hear about it."""
+        self._leases.pop(batch, None)
+        n = self._retries.get(batch, 0) + 1
+        self._retries[batch] = n
+        return n < max_retries
+
+    def fail_worker(self, worker: str) -> list[int]:
+        """The worker's connection dropped (SIGKILL, network): every lease it
+        held re-queues immediately — no waiting out the timeout."""
+        lost = [b for b, (w, _) in self._leases.items() if w == worker]
+        for b in lost:
+            del self._leases[b]
+        self.reissues += len(lost)
+        return sorted(lost)
+
+
+class BatchCache:
+    """Byte-bounded LRU of decoded batches (numpy array dicts)."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = int(max_bytes)
+        self._entries: OrderedDict[tuple, dict[str, np.ndarray]] = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    @staticmethod
+    def _nbytes(arrays: dict[str, np.ndarray]) -> int:
+        return sum(int(a.nbytes) for a in arrays.values())
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def bytes(self) -> int:
+        return self._bytes
+
+    def get(self, key: tuple) -> dict[str, np.ndarray] | None:
+        arrays = self._entries.get(key)
+        if arrays is None:
+            return None
+        self._entries.move_to_end(key)
+        return arrays
+
+    def put(self, key: tuple, arrays: dict[str, np.ndarray]) -> None:
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return
+        self._entries[key] = arrays
+        self._bytes += self._nbytes(arrays)
+        while self._bytes > self.max_bytes and len(self._entries) > 1:
+            _, evicted = self._entries.popitem(last=False)
+            self._bytes -= self._nbytes(evicted)
+            self.evictions += 1
+
+    def stats(self) -> dict:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "bytes": self._bytes,
+            "entries": len(self._entries),
+        }
+
+
+class _Stream:
+    """One registered sample stream: spec + leases + the ready buffer."""
+
+    def __init__(self, sid: int, spec: StreamSpec, num_batches: int,
+                 lease_timeout_s: float, lock: threading.RLock):
+        self.sid = sid
+        self.spec = spec
+        self.num_batches = int(num_batches)
+        self.table = LeaseTable(lease_timeout_s)
+        # decoded-but-undelivered batches: strong refs from lease acceptance
+        # until every client cursor passed them, so cache eviction can never
+        # lose a batch a client is about to request
+        self.ready: dict[int, dict[str, np.ndarray]] = {}
+        self.cursors: dict[int, int] = {}  # client conn id -> next wanted batch
+        self.refs = 0
+        self.cond = threading.Condition(lock)
+        self.failed: dict[int, str] = {}  # poisoned batches -> error
+        self.served = 0
+
+    def low_water(self) -> int:
+        return min(self.cursors.values(), default=self.spec.start_batch)
+
+    def gc_ready(self) -> None:
+        low = self.low_water()
+        for b in [b for b in self.ready if b < low]:
+            del self.ready[b]
+
+
+class Dispatcher:
+    """The dataplane control+data broker (threaded TCP, framed protocol)."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        cache_bytes: int = 256 << 20,
+        lease_timeout_s: float = 30.0,
+        window: int = 8,
+        journal_event: Callable[..., None] | None = None,
+        dataset_opener: Callable[[str], Any] | None = None,
+    ):
+        self._lock = threading.RLock()
+        self.cache = BatchCache(cache_bytes)
+        self.lease_timeout_s = float(lease_timeout_s)
+        self.window = max(1, int(window))
+        self._event = journal_event or (lambda *a, **k: None)
+        self._streams: dict[tuple, _Stream] = {}  # spec key -> stream
+        self._by_sid: dict[int, _Stream] = {}
+        self._next_sid = 0
+        self._next_conn = 0
+        self._totals: dict[str, int] = {}  # dataset root -> len(dataset)
+        self._closed = False
+        if dataset_opener is None:
+            from distribuuuu_tpu.data.dataset import open_image_dataset
+
+            dataset_opener = open_image_dataset
+        self._open_dataset = dataset_opener
+
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:  # noqa: N805 - socketserver API
+                outer._serve_connection(self.request)
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, int(port)), _Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="dtpu-dataplane-disp"
+        )
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            for stream in self._by_sid.values():
+                stream.cond.notify_all()
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except OSError:  # pragma: no cover - defensive
+            pass
+
+    # -- dataset geometry ----------------------------------------------------
+
+    def _total(self, root: str) -> int:
+        """len(dataset) for a root, scanned OUTSIDE the dispatcher lock: an
+        ImageNet-scale index build takes minutes, and holding the lock for
+        it would freeze every running stream's `next` replies and lease
+        RPCs just because a new job registered a new root. Handlers call
+        this before taking the lock (a racing duplicate scan is harmless);
+        locked callers hit the cached value."""
+        with self._lock:
+            total = self._totals.get(root)
+        if total is None:
+            total = len(self._open_dataset(root))
+            with self._lock:
+                total = self._totals.setdefault(root, total)
+        return total
+
+    def num_batches(self, spec: StreamSpec) -> int:
+        """`HostDataLoader`'s epoch geometry, verbatim (drop_last on train)."""
+        total = self._total(spec.root)
+        shard_size = (total + spec.process_count - 1) // spec.process_count
+        if spec.train:
+            return shard_size // spec.host_batch
+        return (shard_size + spec.host_batch - 1) // spec.host_batch
+
+    # -- stream registry -----------------------------------------------------
+
+    def _get_stream(self, spec: StreamSpec, conn: int) -> _Stream:
+        key = spec.cache_key(-1)  # spec identity minus the batch index
+        stream = self._streams.get(key)
+        if stream is None:
+            self._next_sid += 1
+            stream = _Stream(
+                self._next_sid, spec, self.num_batches(spec),
+                self.lease_timeout_s, self._lock,
+            )
+            self._streams[key] = stream
+            self._by_sid[stream.sid] = stream
+            self._event(
+                "dataplane_stream",
+                stream=stream.sid,
+                root=spec.root,
+                train=bool(spec.train),
+                epoch=int(spec.epoch),
+                num_batches=stream.num_batches,
+                start_batch=int(spec.start_batch),
+            )
+        stream.refs += 1
+        stream.cursors[conn] = max(
+            int(spec.start_batch), stream.cursors.get(conn, 0)
+        )
+        return stream
+
+    def _drop_client(self, stream: _Stream, conn: int) -> None:
+        stream.cursors.pop(conn, None)
+        stream.refs -= 1
+        if stream.refs <= 0:
+            # decoded payloads stay in the LRU cache (that is the multi-job
+            # decode-once story); only the lease/ready bookkeeping goes
+            self._streams.pop(stream.spec.cache_key(-1), None)
+            self._by_sid.pop(stream.sid, None)
+            self._event("dataplane_cache", stream=stream.sid, **self.cache.stats())
+        stream.cond.notify_all()
+
+    # -- worker side ---------------------------------------------------------
+
+    def _claim_for(self, worker: str) -> tuple[_Stream, int] | None:
+        """The next (stream, batch) a worker should decode: round-robin over
+        streams, window-bounded ahead of the slowest client cursor, cache
+        consulted first so a cached batch never burns a decode."""
+        for stream in list(self._by_sid.values()):
+            low = stream.low_water()
+            high = min(stream.num_batches, low + self.window)
+            candidates = []
+            for b in range(low, high):
+                if b in stream.ready or b in stream.failed:
+                    continue
+                cached = self.cache.get(stream.spec.cache_key(b))
+                if cached is not None:
+                    # decode-once: another job / epoch replay already paid
+                    # for these pixels
+                    self.cache.hits += 1
+                    stream.ready[b] = cached
+                    stream.table.complete("<cache>", b)
+                    stream.cond.notify_all()
+                    continue
+                if stream.table.done(b):
+                    # decoded once, but the payload was delivered and then
+                    # evicted before THIS consumer got it: decode again
+                    stream.table.reopen(b)
+                candidates.append(b)
+            before = stream.table.reissues
+            got = stream.table.claim(candidates, worker)
+            if got is not None:
+                if stream.table.reissues > before:
+                    # a lease-TIMEOUT re-issue (stalled worker, not a
+                    # disconnect): journal it like _fail_worker does — the
+                    # TROUBLESHOOTING playbook reads these to tune
+                    # DATA.LEASE_TIMEOUT_S against real decode time
+                    self._event(
+                        "dataplane_lease",
+                        stream=stream.sid,
+                        batch=int(got),
+                        event="reissue",
+                        worker=worker,
+                    )
+                return stream, got
+        return None
+
+    def _accept(self, stream: _Stream, worker: str, batch: int,
+                arrays: dict[str, np.ndarray]) -> bool:
+        if not stream.table.complete(worker, batch):
+            return False  # duplicate completion (re-issued lease): dropped
+        self.cache.misses += 1  # a decode happened
+        stream.ready[batch] = arrays
+        self.cache.put(stream.spec.cache_key(batch), arrays)
+        stream.cond.notify_all()
+        return True
+
+    def _fail_batch(self, stream: _Stream, worker: str, batch: int, error: str) -> None:
+        if not stream.table.fail(worker, batch):
+            stream.failed[batch] = error
+            stream.cond.notify_all()
+
+    def _fail_worker(self, worker: str) -> None:
+        with self._lock:
+            for stream in self._by_sid.values():
+                lost = stream.table.fail_worker(worker)
+                for b in lost:
+                    self._event(
+                        "dataplane_lease",
+                        stream=stream.sid,
+                        batch=int(b),
+                        event="reissue",
+                        worker=worker,
+                    )
+                if lost:
+                    logger.warning(
+                        f"dataplane: worker {worker} dropped; re-queued "
+                        f"batches {lost} of stream {stream.sid}"
+                    )
+
+    # -- connection loop -----------------------------------------------------
+
+    def _serve_connection(self, sock) -> None:
+        with self._lock:  # handler threads race here; a shared conn id
+            self._next_conn += 1  # would cross-wire two clients' cursors
+            conn = self._next_conn
+        f = sock.makefile("rwb")
+        stream: _Stream | None = None
+        worker: str | None = None
+        try:
+            while True:
+                try:
+                    msg, arrays = protocol.recv_msg(f)
+                except (EOFError, protocol.ProtocolError, OSError):
+                    break
+                op = msg.get("op")
+                if op == "register_stream":
+                    spec = StreamSpec.from_dict(msg.get("spec") or {})
+                    self._total(spec.root)  # warm the scan OUTSIDE the lock
+                    with self._lock:
+                        if stream is not None:
+                            self._drop_client(stream, conn)
+                        stream = self._get_stream(spec, conn)
+                        reply = {
+                            "ok": True,
+                            "stream": stream.sid,
+                            "num_batches": stream.num_batches,
+                            "total": self._total(spec.root),
+                        }
+                    protocol.send_msg(f, reply)
+                elif op == "next" and stream is not None:
+                    self._handle_next(f, stream, conn, int(msg.get("batch", -1)))
+                elif op == "info":
+                    spec = StreamSpec.from_dict(msg.get("spec") or {})
+                    self._total(spec.root)  # warm the scan OUTSIDE the lock
+                    with self._lock:
+                        reply = {
+                            "ok": True,
+                            "num_batches": self.num_batches(spec),
+                            "total": self._total(spec.root),
+                        }
+                    protocol.send_msg(f, reply)
+                elif op == "register_worker":
+                    # uniquify server-side: leases key on the worker name,
+                    # and two remote VMs both registering the default "w0"
+                    # would revoke each other's in-flight leases on every
+                    # disconnect (duplicate decodes + spurious reissue
+                    # records) — the conn id makes the name unambiguous
+                    worker = f"{msg.get('worker', 'w')}#{conn}"
+                    protocol.send_msg(f, {"ok": True, "worker": worker})
+                elif op == "lease" and worker is not None:
+                    with self._lock:
+                        got = self._claim_for(worker)
+                        reply = (
+                            {"ok": True, "idle": True}
+                            if got is None
+                            else {
+                                "ok": True,
+                                "stream": got[0].sid,
+                                "batch": got[1],
+                                "spec": got[0].spec.to_dict(),
+                            }
+                        )
+                    protocol.send_msg(f, reply)
+                elif op == "done" and worker is not None:
+                    sid = int(msg.get("stream", -1))
+                    b = int(msg.get("batch", -1))
+                    with self._lock:
+                        target = self._by_sid.get(sid)
+                        accepted = False
+                        if target is not None and msg.get("error"):
+                            self._fail_batch(target, worker, b, str(msg["error"]))
+                        elif target is not None and arrays:
+                            accepted = self._accept(target, worker, b, arrays)
+                    protocol.send_msg(f, {"ok": True, "accepted": accepted})
+                elif op == "end" and stream is not None:
+                    with self._lock:
+                        self._drop_client(stream, conn)
+                        stream = None
+                    protocol.send_msg(f, {"ok": True})
+                elif op == "ping":
+                    with self._lock:
+                        protocol.send_msg(
+                            f, {"ok": True, "streams": len(self._by_sid),
+                                **self.cache.stats()}
+                        )
+                else:
+                    protocol.send_msg(f, {"ok": False, "error": f"bad op {op!r}"})
+        except (OSError, ValueError):  # peer vanished mid-reply
+            pass
+        finally:
+            with self._lock:
+                if stream is not None:
+                    self._drop_client(stream, conn)
+            if worker is not None:
+                self._fail_worker(worker)
+            try:
+                f.close()
+            except OSError:
+                pass
+
+    def _handle_next(self, f, stream: _Stream, conn: int, batch: int) -> None:
+        """Serve one batch to a client, blocking until a worker (or the
+        cache) produces it. The reply leaves the dispatcher lock before the
+        bytes hit the socket — a slow client link must not stall decode
+        accounting for every other consumer."""
+        with self._lock:
+            stream.cursors[conn] = batch
+            arrays = None
+            while True:
+                if self._closed or batch >= stream.num_batches:
+                    protocol.send_msg(f, {"ok": False, "error": "closed"
+                                          if self._closed else "past_end"})
+                    return
+                if batch in stream.failed:
+                    protocol.send_msg(
+                        f, {"ok": False, "error": f"decode_failed: "
+                            f"{stream.failed[batch]}"})
+                    return
+                arrays = stream.ready.get(batch)
+                if arrays is None:
+                    cached = self.cache.get(stream.spec.cache_key(batch))
+                    if cached is not None:
+                        self.cache.hits += 1
+                        stream.table.complete("<cache>", batch)
+                        stream.ready[batch] = cached
+                        arrays = cached
+                if arrays is not None:
+                    stream.served += 1
+                    stream.cursors[conn] = batch + 1
+                    stream.gc_ready()
+                    break
+                stream.cond.wait(0.2)
+        protocol.send_msg(f, {"ok": True, "batch": batch}, arrays=arrays)
+
+    # -- introspection (tests / service telemetry) ---------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "streams": len(self._by_sid),
+                "reissues": sum(
+                    s.table.reissues for s in self._by_sid.values()
+                ),
+                **self.cache.stats(),
+            }

@@ -1,0 +1,168 @@
+"""Dataplane wire protocol: JSON-line control + length-prefixed batch frames.
+
+Control messages ride the same JSON-line-TCP pattern as the fleet's
+`RendezvousServer` (one ``json.dumps(obj) + "\\n"`` per message), but unlike
+the rendezvous the dataplane moves *pixels*: a decoded host batch is tens of
+MB, and JSON-encoding arrays would triple the bytes and burn CPU the decode
+tier exists to save. So a message may carry a binary **frame**: the control
+line declares each array's ``{key, dtype, shape}`` under ``"arrays"``, and
+the raw C-order bytes follow the newline back-to-back, lengths derived from
+dtype×shape. The receiver reads exactly that many bytes — no escaping, no
+base64, no per-element parsing.
+
+Stream identity is the `StreamSpec`: everything that determines the sample
+stream (root, train/eval, seed, epoch, topology slot, batch geometry,
+transform fingerprint). Two clients with equal specs ARE the same stream —
+that equality is what lets the dispatcher's cache serve many jobs one
+decode.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import socket
+from dataclasses import asdict, dataclass, fields
+
+import numpy as np
+
+#: sane ceiling for one control line (a batch's bytes ride the frame, never
+#: the line); a longer line is a corrupt/hostile peer, not a big message
+MAX_LINE = 1 << 20
+
+
+class ProtocolError(OSError):
+    """Malformed traffic from a peer (short read, bad JSON, bad header).
+
+    An ``OSError`` subclass deliberately: every dataplane socket path treats
+    transport failure and protocol corruption identically — drop the
+    connection and let the retry/fallback policy decide."""
+
+
+@dataclass(frozen=True)
+class StreamSpec:
+    """Everything that determines one host's sample stream for one epoch."""
+
+    root: str  # dataset root (tar shards / ImageFolder split)
+    train: bool
+    seed: int
+    epoch: int
+    im_size: int
+    crop_size: int
+    host_batch: int
+    process_index: int
+    process_count: int
+    start_batch: int  # mid-epoch resume: lease/serve from this batch on
+    fingerprint: str  # transform identity (data.loader.transform_fingerprint)
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "StreamSpec":
+        kw = {}
+        for f in fields(cls):
+            if f.name not in d:
+                raise ProtocolError(f"stream spec missing field {f.name!r}")
+            v = d[f.name]
+            kw[f.name] = (
+                bool(v) if f.type == "bool"
+                else str(v) if f.type == "str"
+                else int(v)
+            )
+        return cls(**kw)
+
+    def cache_key(self, batch: int) -> tuple:
+        """The decoded-batch cache identity: (shard set, index range,
+        transform fingerprint, epoch seed) — `start_batch` is deliberately
+        NOT part of it (a resumed stream re-reads the same batches a full
+        stream produced), and neither is anything about which client asked."""
+        return (
+            self.root,
+            self.fingerprint,
+            self.train,
+            self.seed,
+            self.epoch,
+            self.host_batch,
+            self.process_index,
+            self.process_count,
+            batch,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Framed I/O over a socket makefile("rwb")
+# ---------------------------------------------------------------------------
+
+def send_msg(f: io.BufferedIOBase, msg: dict, arrays: dict | None = None) -> None:
+    """One control line (+ the binary frame when ``arrays`` is given)."""
+    payload = dict(msg)
+    blobs: list = []
+    if arrays:
+        headers = []
+        for key, arr in arrays.items():
+            arr = np.ascontiguousarray(arr)
+            headers.append(
+                {"key": str(key), "dtype": arr.dtype.str, "shape": list(arr.shape)}
+            )
+            # zero-copy: the array is C-contiguous (above), so its buffer
+            # writes directly — .tobytes() would memcpy every batch twice
+            # per hop at the pod design point (~GB/s of avoidable copies)
+            blobs.append(arr.data)
+        payload["arrays"] = headers
+    f.write(json.dumps(payload).encode("utf-8") + b"\n")
+    for blob in blobs:
+        f.write(blob)
+    f.flush()
+
+
+def _read_exact(f: io.BufferedIOBase, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = f.read(n - len(buf))
+        if not chunk:
+            raise ProtocolError(
+                f"peer closed mid-frame ({len(buf)}/{n} payload bytes)"
+            )
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_msg(f: io.BufferedIOBase) -> tuple[dict, dict[str, np.ndarray]]:
+    """One control line and its frame. Returns ``(msg, arrays)``; raises
+    ``EOFError`` on a clean close between messages, ``ProtocolError`` on
+    anything torn or undecodable."""
+    line = f.readline(MAX_LINE)
+    if not line:
+        raise EOFError("peer closed")
+    if not line.endswith(b"\n"):
+        raise ProtocolError(f"unterminated control line ({len(line)} bytes)")
+    try:
+        msg = json.loads(line)
+        if not isinstance(msg, dict):
+            raise ValueError("not an object")
+    except ValueError as exc:
+        raise ProtocolError(f"bad control line: {exc}") from exc
+    arrays: dict[str, np.ndarray] = {}
+    for header in msg.pop("arrays", []) or []:
+        try:
+            dtype = np.dtype(str(header["dtype"]))
+            shape = tuple(int(s) for s in header["shape"])
+            key = str(header["key"])
+        except (KeyError, TypeError, ValueError) as exc:
+            raise ProtocolError(f"bad array header {header!r}: {exc}") from exc
+        nbytes = int(dtype.itemsize * int(np.prod(shape, dtype=np.int64)))
+        arrays[key] = np.frombuffer(_read_exact(f, nbytes), dtype=dtype).reshape(shape)
+    return msg, arrays
+
+
+def connect(address: str, *, timeout_s: float = 30.0) -> tuple[socket.socket, io.BufferedRWPair]:
+    """Open a framed connection to ``host:port``; returns (socket, rwfile).
+
+    TCP_NODELAY: the protocol interleaves small control lines with large
+    frames, and Nagle would add a round trip of latency to every lease/next
+    exchange for no win (the frames already fill segments)."""
+    host, _, port = address.rpartition(":")
+    sock = socket.create_connection((host or "127.0.0.1", int(port)), timeout=timeout_s)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock, sock.makefile("rwb")

@@ -1,0 +1,254 @@
+"""`DataPlaneService`: dispatcher + a supervised decode-worker pool.
+
+One service = one `Dispatcher` plus N decode workers. Workers default to
+child *processes* (the deployment shape: SIGKILLing one is the chaos tier's
+whole-worker failure, and the service restarts it under a small internal
+backoff — the lease table already re-issued its in-flight batches the moment
+the connection dropped). ``in_process=True`` runs them as threads instead —
+the zero-subprocess mode the unit tests and the bench drive.
+
+Journaling rides a `ValidatedJournal` into the pool journal's
+``.part3500`` continuation (`DATAPLANE_PART` — the same single-writer-
+per-part discipline every supervisor uses): ``dataplane_start`` /
+``dataplane_stream`` / ``dataplane_lease`` (re-issues) /
+``dataplane_worker_exit`` / ``dataplane_cache``. With ``OBS.METRICS_PORT``
+set, an embedded `ObsPlane` tails the journal and serves the
+``dtpu_dataplane_*`` gauges on ``/metrics`` — the tier the data-wait alarm
+playbook points at (docs/DATA.md, docs/TROUBLESHOOTING.md).
+
+CLI (the ``dtpu-dataplane`` console script)::
+
+    dtpu-dataplane --cfg config/resnet50.yaml [KEY VALUE ...]
+
+Supervised deployment: ``dtpu-agent`` with ``AGENT.DATAPLANE True`` keeps
+the whole service alive under the agent's restart budget; a fleet run with
+``DATA.SERVICE fleet`` co-schedules one next to the gangs (fleet.py).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+from distribuuuu_tpu.dataplane.dispatcher import Dispatcher
+from distribuuuu_tpu.logging import logger
+
+#: the dataplane service's supervisory journal part (see obs/journal.py
+#: `_journal_parts`: serve replicas 1000+R, host agents 2000+H, fleet
+#: controller 3000, sidecar 4000, agent exporter 4001)
+DATAPLANE_PART = 3500
+
+
+def _journal_event(out_dir: str):
+    """A ValidatedJournal .event bound to the .part3500 continuation (a
+    no-op callable when the journal cannot be opened — the service must
+    never die of observability)."""
+    try:
+        from distribuuuu_tpu.obs.journal import ValidatedJournal
+        from distribuuuu_tpu.obs.telemetry import journal_path
+
+        journal = ValidatedJournal(
+            f"{journal_path(out_dir)}.part{DATAPLANE_PART}",
+            label="dataplane journal",
+        )
+        return journal.event, journal.close
+    except Exception as exc:  # pragma: no cover - defensive
+        logger.warning(f"dataplane journal unavailable: {exc!r}")
+        return (lambda *a, **k: None), (lambda: None)
+
+
+class DataPlaneService:
+    """Dispatcher + decode-worker pool + journal + optional /metrics."""
+
+    def __init__(
+        self,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        workers: int = 2,
+        worker_threads: int = 4,
+        in_process: bool = False,
+        cache_bytes: int = 256 << 20,
+        lease_timeout_s: float = 30.0,
+        window: int = 8,
+        journal_event=None,
+        journal_close=None,
+        worker_argv: list[str] | None = None,
+        injector=None,
+    ):
+        self.n_workers = max(1, int(workers))
+        self.worker_threads = max(1, int(worker_threads))
+        self.in_process = bool(in_process)
+        self._worker_argv = list(worker_argv or [])
+        self._injector = injector
+        self._event = journal_event or (lambda *a, **k: None)
+        self._journal_close = journal_close or (lambda: None)
+        self._stop = threading.Event()
+        self._procs: dict[int, subprocess.Popen] = {}
+        self._threads: list[threading.Thread] = []
+        self._monitor: threading.Thread | None = None
+        self._restarts = 0
+        self.dispatcher = Dispatcher(
+            host,
+            int(port),
+            cache_bytes=int(cache_bytes),
+            lease_timeout_s=float(lease_timeout_s),
+            window=int(window),
+            journal_event=self._event,
+        )
+        self.obs_plane = None
+
+    @classmethod
+    def from_cfg(cls, *, in_process: bool = False, worker_argv=None,
+                 port: int | None = None) -> "DataPlaneService":
+        from distribuuuu_tpu.config import cfg
+
+        d = cfg.DATA
+        event, close = _journal_event(str(cfg.OUT_DIR))
+        if port is None:
+            port = int(d.PORT)
+            if port == 0:
+                # derive from OUT_DIR so trainer hosts can compute the same
+                # address without parsing service output (runtime/dist.py)
+                from distribuuuu_tpu.runtime.dist import derive_dataplane_port
+
+                port = derive_dataplane_port(os.path.abspath(str(cfg.OUT_DIR)))
+        return cls(
+            host=str(d.HOST),
+            port=port,
+            workers=int(d.WORKERS),
+            worker_threads=int(d.WORKER_THREADS) or max(
+                1, (os.cpu_count() or 4) // max(1, int(d.WORKERS))
+            ),
+            in_process=in_process,
+            cache_bytes=int(d.CACHE_MB) << 20,
+            lease_timeout_s=float(d.LEASE_TIMEOUT_S),
+            window=int(d.WINDOW),
+            journal_event=event,
+            journal_close=close,
+            worker_argv=worker_argv,
+        )
+
+    @property
+    def address(self) -> str:
+        return self.dispatcher.address
+
+    def worker_pids(self) -> list[int]:
+        return [p.pid for p in self._procs.values() if p.poll() is None]
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "DataPlaneService":
+        self._event(
+            "dataplane_start",
+            address=self.address,
+            workers=self.n_workers,
+            worker_threads=self.worker_threads,
+            cache_bytes=int(self.dispatcher.cache.max_bytes),
+            in_process=self.in_process,
+        )
+        for i in range(self.n_workers):
+            self._spawn(i)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True, name="dtpu-dataplane-mon"
+        )
+        self._monitor.start()
+        logger.info(
+            f"dataplane: dispatcher at {self.address}, {self.n_workers} "
+            f"decode worker(s) x {self.worker_threads} thread(s)"
+        )
+        return self
+
+    def _spawn(self, slot: int) -> None:
+        if self.in_process:
+            from distribuuuu_tpu.dataplane.worker import run_worker
+
+            t = threading.Thread(
+                target=run_worker,
+                args=(self.address, f"w{slot}"),
+                kwargs=dict(
+                    threads=self.worker_threads,
+                    stop=self._stop,
+                    injector=self._injector,
+                ),
+                daemon=True,
+                name=f"dtpu-dataplane-w{slot}",
+            )
+            t.start()
+            self._threads.append(t)
+            return
+        cmd = [
+            sys.executable, "-m", "distribuuuu_tpu.dataplane",
+            "--worker", "--address", self.address, "--id", f"w{slot}",
+            "--threads", str(self.worker_threads),
+            *self._worker_argv,
+        ]
+        self._procs[slot] = subprocess.Popen(cmd)
+
+    def _monitor_loop(self) -> None:
+        """Restart dead worker processes (small fixed backoff — the decode
+        tier is stateless, and the lease table already re-queued anything
+        the dead worker held when its connection dropped)."""
+        while not self._stop.wait(0.2):
+            for slot, proc in list(self._procs.items()):
+                code = proc.poll()
+                if code is None:
+                    continue
+                self._restarts += 1
+                self._event(
+                    "dataplane_worker_exit",
+                    worker=f"w{slot}",
+                    code=int(code),
+                    restarts=self._restarts,
+                )
+                logger.warning(
+                    f"dataplane: worker w{slot} exited {code}; restarting"
+                )
+                time.sleep(0.2)
+                if not self._stop.is_set():
+                    self._spawn(slot)
+
+    def journal_stats(self) -> None:
+        self._event("dataplane_cache", **self.dispatcher.stats())
+
+    def start_obs_plane(self) -> None:
+        """Embedded /metrics exporter over the pool journal (OBS.METRICS_PORT
+        > 0); the dataplane's own records fold into ``dtpu_dataplane_*``."""
+        from distribuuuu_tpu.config import cfg
+
+        if int(cfg.OBS.METRICS_PORT) <= 0:
+            return
+        try:
+            from distribuuuu_tpu.obs.exporter import ObsPlane
+            from distribuuuu_tpu.obs.telemetry import journal_path
+
+            self.obs_plane = ObsPlane(
+                journal_path(str(cfg.OUT_DIR)),
+                port=int(cfg.OBS.METRICS_PORT),
+                host=str(cfg.OBS.METRICS_HOST),
+                interval_s=float(cfg.OBS.TAIL_INTERVAL_S),
+            ).start()
+        except Exception as exc:
+            logger.warning(f"dataplane: obs plane unavailable: {exc!r}")
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.journal_stats()
+        if self.obs_plane is not None:
+            self.obs_plane.stop()
+        for proc in self._procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        deadline = time.monotonic() + 5.0
+        for proc in self._procs.values():
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self.dispatcher.close()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+        self._journal_close()

@@ -1,0 +1,217 @@
+"""Dataplane decode worker: lease → decode → push, forever.
+
+A worker is a plain CPU process that asks the dispatcher for a (stream,
+batch) lease, decodes that batch with the *exact* local decode path
+(`HostDataLoader.decode_batch` over the same `shard_indices`/`aug_seed_base`
+stream — bitwise fidelity is inherited, not re-implemented), and ships the
+arrays back as a binary frame. It holds no authority: if it dies mid-lease
+the dispatcher re-issues the lease, and if it completes a lease that was
+already re-issued the completion is dropped — either way the sample stream
+is unaffected.
+
+Scaling out the tier = running more of these, anywhere that can reach the
+shards and the dispatcher. Intra-batch parallelism rides the loader's own
+thread pool (PIL/native decode release the GIL).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
+
+from distribuuuu_tpu import resilience
+from distribuuuu_tpu.dataplane import protocol
+from distribuuuu_tpu.dataplane.protocol import StreamSpec
+from distribuuuu_tpu.logging import logger
+
+
+class _SpecLoaders:
+    """Per-spec `HostDataLoader` instances (dataset indexes are reused
+    across leases; a new epoch/spec builds its own shard-index stream)."""
+
+    #: live specs to keep warm: a pod is one spec per (host, epoch) pair in
+    #: flight, so 64 covers a 16-host pod with epoch-boundary overlap + eval
+    MAX_SPECS = 64
+
+    #: dataset indexes to keep resident (a root's full samples list is the
+    #: expensive part — ~1.3M entries at ImageNet scale); LRU so a worker
+    #: pool serving many jobs' roots over weeks doesn't grow without bound
+    MAX_ROOTS = 8
+
+    def __init__(self, injector=None):
+        self._loaders: "OrderedDict[tuple, object]" = OrderedDict()
+        self._datasets: "OrderedDict[str, object]" = OrderedDict()
+        self._injector = injector
+
+    def loader_for(self, spec: StreamSpec):
+        """(loader, indices, base) for a spec — the shard-index permutation
+        and augmentation-seed base are computed once per spec, not per lease
+        (a 1.3M-sample permutation per batch would eat the decode win)."""
+        from distribuuuu_tpu.data.dataset import open_image_dataset
+        from distribuuuu_tpu.data.loader import (
+            HostDataLoader,
+            aug_seed_base,
+            transform_fingerprint,
+        )
+
+        expected = transform_fingerprint(
+            train=spec.train, im_size=spec.im_size, crop_size=spec.crop_size
+        )
+        if spec.fingerprint != expected:
+            # bitwise fidelity is the subsystem's core contract, and the
+            # native and PIL decoders are not bitwise aliases — a worker
+            # whose backend differs from the client's must refuse the lease
+            # (the dispatcher re-queues, then poisons → the client fails
+            # LOUDLY) rather than silently serve divergent pixels under the
+            # client's cache key
+            raise RuntimeError(
+                f"transform fingerprint mismatch: client expects "
+                f"{spec.fingerprint!r}, this worker produces {expected!r} "
+                f"(native decoder built on one side only?)"
+            )
+        key = spec.cache_key(-1)
+        entry = self._loaders.get(key)
+        if entry is not None:
+            self._loaders.move_to_end(key)  # LRU: hot specs stay warm
+        if entry is None:
+            dataset = self._datasets.get(spec.root)
+            if dataset is not None:
+                self._datasets.move_to_end(spec.root)
+            else:
+                dataset = open_image_dataset(spec.root)
+                self._datasets[spec.root] = dataset
+                while len(self._datasets) > self.MAX_ROOTS:
+                    # live loaders keep their own reference; eviction only
+                    # drops this registry's pin
+                    self._datasets.popitem(last=False)
+            loader = HostDataLoader(
+                dataset,
+                host_batch=spec.host_batch,
+                train=spec.train,
+                im_size=spec.im_size,
+                process_index=spec.process_index,
+                process_count=spec.process_count,
+                workers=1,  # intra-batch parallelism rides run_worker's pool
+                seed=spec.seed,
+                crop_size=spec.crop_size,
+                injector=self._injector,
+            )
+            loader.set_epoch(spec.epoch)
+            entry = (
+                loader,
+                loader._shard_indices(),
+                aug_seed_base(spec.seed, spec.epoch, spec.process_index),
+            )
+            self._loaders[key] = entry
+            while len(self._loaders) > self.MAX_SPECS:
+                self._loaders.popitem(last=False)  # LRU: stale epochs age out
+        return entry
+
+
+def run_worker(
+    address: str,
+    worker_id: str,
+    *,
+    threads: int = 4,
+    injector: "resilience.FaultInjector | None" = None,
+    stop: threading.Event | None = None,
+    idle_sleep_s: float = 0.02,
+) -> None:
+    """The worker main loop; returns only when ``stop`` is set (or raises
+    after the connect retry budget — the supervising service restarts us).
+
+    Every socket exchange rides `resilience.retry` (FAULT.RETRY_* knobs):
+    a dispatcher restart or transient network blip re-connects and
+    re-registers instead of killing the worker; leases lost across the gap
+    are the dispatcher's to re-issue.
+    """
+    stop = stop or threading.Event()
+    loaders = _SpecLoaders(injector)
+    pool = ThreadPoolExecutor(max(1, int(threads)))
+    sock = f = None
+
+    def _connect():
+        nonlocal sock, f
+        _close()
+        sock, f = protocol.connect(address)
+        protocol.send_msg(f, {"op": "register_worker", "worker": worker_id})
+        protocol.recv_msg(f)
+
+    def _close():
+        nonlocal sock, f
+        for closeable in (f, sock):
+            if closeable is not None:
+                try:
+                    closeable.close()
+                except OSError:
+                    pass
+        sock = f = None
+
+    try:
+        try:
+            resilience.retry(_connect, retry_on=(OSError, EOFError),
+                             desc=f"dataplane worker {worker_id} connect")
+        except (OSError, EOFError) as exc:
+            # never unwind a thread/process with a traceback over a dead
+            # dispatcher: the supervising service restarts us (subprocess
+            # mode) or is itself shutting down (in-process mode)
+            logger.error(
+                f"dataplane worker {worker_id}: dispatcher at {address} "
+                f"unreachable, giving up: {exc!r}"
+            )
+            return
+        idle = idle_sleep_s
+        while not stop.is_set():
+            try:
+                protocol.send_msg(f, {"op": "lease"})
+                reply, _ = protocol.recv_msg(f)
+                if reply.get("idle") or not reply.get("ok"):
+                    # idle backoff (cap 0.5s): a 16-worker pool with no
+                    # registered streams must not hammer the dispatcher
+                    # lock with hundreds of lease RPCs per second
+                    time.sleep(idle)
+                    idle = min(idle * 1.5, 0.5)
+                    continue
+                idle = idle_sleep_s  # work exists: poll eagerly again
+                spec = StreamSpec.from_dict(reply["spec"])
+                batch = int(reply["batch"])
+                done = {"op": "done", "stream": int(reply["stream"]), "batch": batch}
+                try:
+                    loader, indices, base = loaders.loader_for(spec)
+                    arrays = loader.decode_batch(
+                        batch, indices=indices, base=base, pool=pool
+                    )
+                except Exception as exc:  # decode failure: the DISPATCHER
+                    # decides whether to retry elsewhere or poison the batch
+                    logger.warning(
+                        f"dataplane worker {worker_id}: decode failed for "
+                        f"stream batch {batch}: {exc!r}"
+                    )
+                    protocol.send_msg(f, {**done, "error": repr(exc)})
+                    protocol.recv_msg(f)
+                    continue
+                protocol.send_msg(f, done, arrays=arrays)
+                protocol.recv_msg(f)  # ack (accepted may be False: dropped dup)
+            except (OSError, EOFError) as exc:
+                if stop.is_set():
+                    break
+                logger.warning(
+                    f"dataplane worker {worker_id}: dispatcher link lost "
+                    f"({exc!r}); reconnecting"
+                )
+                try:
+                    resilience.retry(
+                        _connect, retry_on=(OSError, EOFError),
+                        desc=f"dataplane worker {worker_id} reconnect",
+                    )
+                except (OSError, EOFError) as exc2:
+                    logger.error(
+                        f"dataplane worker {worker_id}: dispatcher gone "
+                        f"({exc2!r}); exiting"
+                    )
+                    return
+    finally:
+        _close()
+        pool.shutdown(wait=False)

@@ -290,6 +290,137 @@ class FleetSignals:
 
 
 # ---------------------------------------------------------------------------
+# Co-scheduled dataplane (DATA.SERVICE == "fleet"; docs/DATA.md)
+# ---------------------------------------------------------------------------
+
+class DataplaneSidecar:
+    """One dtpu-dataplane service the controller runs beside its gangs.
+
+    ``DATA.SERVICE fleet`` means "the pool owns the input tier": the
+    controller spawns the service once, exports its address as
+    ``DTPU_DATA_SERVICE`` (inherited by every host agent and worker — the
+    client-side override for ``DATA.SERVICE``), and restarts it if it dies.
+    Every job sharing the pool then shares one decode cache — the
+    many-concurrent-consumers scenario the cache exists for. The address is
+    *derived* (OUT_DIR-hashed port, `runtime/dist.derive_dataplane_port`
+    via the service's own ``DATA.PORT 0`` path), so controller and service
+    agree without parsing each other's output."""
+
+    def __init__(self, journal: FleetJournal, argv: list[str]):
+        from distribuuuu_tpu.runtime.dist import derive_dataplane_port
+
+        self._journal = journal
+        self._argv = list(argv)
+        port = int(cfg.DATA.PORT) or derive_dataplane_port(
+            os.path.abspath(str(cfg.OUT_DIR))
+        )
+        # advertise the CONNECT address, which diverges from the bind host
+        # the moment the pool spans machines (a 0.0.0.0 bind must advertise
+        # a routable IP, never the wildcard or loopback)
+        advertise = str(cfg.DATA.ADVERTISE_HOST).strip() or str(cfg.DATA.HOST)
+        if advertise in ("0.0.0.0", "::"):
+            # a wildcard "connect address" resolves to every remote host's
+            # OWN loopback — every trainer would silently ride the local-
+            # decode fallback; best-effort this host's routable name instead
+            import socket as _socket
+
+            try:
+                advertise = _socket.gethostbyname(_socket.gethostname())
+            except OSError:
+                advertise = "127.0.0.1"
+            logger.warning(
+                f"fleet: DATA.HOST is a bind wildcard; advertising "
+                f"{advertise} to workers — set DATA.ADVERTISE_HOST to the "
+                f"dispatcher's routable address for multi-machine pools"
+            )
+        self.address = f"{advertise}:{port}"
+        self._port = port
+        self._worker: Worker | None = None
+        self._restarts = 0
+        # same sliding-window budget + full-jitter backoff every other
+        # supervised child rides: a persistently-failing service (e.g. a
+        # stale process squatting the derived port) must degrade to "the
+        # trainers decode locally", never a 5 Hz spawn/journal crash-loop
+        self._budget = RestartBudget(
+            int(cfg.FLEET.MAX_GANG_RESTARTS), float(cfg.FLEET.RESTART_WINDOW_S)
+        )
+        self._next_spawn = 0.0
+        self._gave_up = False
+
+    def _spawn(self) -> None:
+        cmd = [
+            sys.executable, "-m", "distribuuuu_tpu.dataplane",
+            *self._argv,
+            "OUT_DIR", str(cfg.OUT_DIR),
+            "DATA.PORT", str(self._port),
+        ]
+        env = dict(os.environ)
+        env.pop("DTPU_DATA_SERVICE", None)  # the service is not a client
+        self._worker = Worker(
+            0, cmd, env,
+            os.path.join(str(cfg.OUT_DIR), "fleet", "dataplane.log"),
+            label="dataplane", new_session=True,
+        )
+
+    def start(self) -> None:
+        self._spawn()
+        os.environ["DTPU_DATA_SERVICE"] = self.address  # gangs inherit this
+        logger.info(f"fleet: co-scheduled dataplane at {self.address}")
+
+    def poll(self) -> None:
+        """Restart a dead service under the budget (the trainers rode
+        DATA.FALLBACK local decode across the gap; the restarted service
+        picks new streams up at their next epoch registration)."""
+        if self._gave_up:
+            return
+        w = self._worker
+        if w is not None:
+            if w.returncode is None:
+                return
+            code = w.returncode
+            w.finish()
+            self._worker = None
+            self._restarts += 1
+            self._journal.event(
+                "dataplane_worker_exit", worker="service", code=int(code),
+                restarts=self._restarts,
+            )
+            if not self._budget.try_spend():
+                self._gave_up = True
+                logger.error(
+                    "fleet: dataplane service keeps dying with the restart "
+                    "budget exhausted; gangs continue on local decode"
+                )
+                os.environ.pop("DTPU_DATA_SERVICE", None)
+                return
+            delay = backoff_delay(
+                self._budget.in_window(),
+                float(cfg.FLEET.BACKOFF_BASE_S), float(cfg.FLEET.BACKOFF_MAX_S),
+            )
+            self._next_spawn = time.monotonic() + delay
+            logger.warning(
+                f"fleet: dataplane service exited {code}; restarting in "
+                f"{delay:.1f}s"
+            )
+            return
+        if time.monotonic() >= self._next_spawn:
+            self._spawn()
+
+    def stop(self) -> None:
+        os.environ.pop("DTPU_DATA_SERVICE", None)
+        w = self._worker
+        if w is None:
+            return
+        w.signal(signal.SIGTERM)
+        deadline = time.monotonic() + 10.0
+        while w.returncode is None and time.monotonic() < deadline:
+            time.sleep(0.1)
+        if w.returncode is None:
+            w.signal_group(signal.SIGKILL)
+        w.finish()
+
+
+# ---------------------------------------------------------------------------
 # Jobs and the host pool
 # ---------------------------------------------------------------------------
 
@@ -1010,10 +1141,16 @@ class FleetQueue:
             f"{len(self.jobs)} job(s) queued"
         )
         obs_plane = self._start_obs_plane()
+        dataplane: DataplaneSidecar | None = None
+        if "DATA" in cfg and str(cfg.DATA.SERVICE).strip().lower() == "fleet":
+            dataplane = DataplaneSidecar(self.journal, self._argv)
+            dataplane.start()
         rc = 0
         try:
             while self.jobs and not self._stop.is_set():
                 self._poll_queue()
+                if dataplane is not None:
+                    dataplane.poll()
                 if not self.jobs:
                     break
                 job = min(self.jobs, key=lambda j: j.sort_key)
@@ -1037,6 +1174,8 @@ class FleetQueue:
                 thread.start()
                 while thread.is_alive():
                     self._poll_queue()
+                    if dataplane is not None:
+                        dataplane.poll()
                     waiting = [j for j in self.jobs if j.priority > job.priority]
                     if waiting and not controller._preempt.is_set():
                         by = min(waiting, key=lambda j: j.sort_key)
@@ -1064,6 +1203,8 @@ class FleetQueue:
                 elif verdict != "clean":
                     rc = 1
         finally:
+            if dataplane is not None:
+                dataplane.stop()
             if obs_plane is not None:
                 obs_plane.stop()
             self.rdzv.close()

@@ -259,6 +259,44 @@ SCHEMA: dict[str, tuple[dict[str, tuple], dict[str, tuple]]] = {
         {"gang_restarts": _INT, "resizes": _INT, "rollbacks": _INT,
          "reason": _STR, "wall_s": _NUM},
     ),
+    # dataplane (dtpu-dataplane, docs/DATA.md); service records land in the
+    # .part<3500> continuation, dataplane_fallback in the CLIENT's journal --
+    # the service came up: dispatcher address + worker pool shape
+    "dataplane_start": (
+        {"address": _STR, "workers": _INT},
+        {"worker_threads": _INT, "cache_bytes": _INT, "in_process": _BOOL},
+    ),
+    # a sample stream was registered (one per (spec, epoch) — NOT per client:
+    # equal specs share one stream, which is the decode-once story)
+    "dataplane_stream": (
+        {"stream": _INT, "root": _STR, "train": _BOOL, "epoch": _INT,
+         "num_batches": _INT},
+        {"start_batch": _INT},
+    ),
+    # a lease recovery event: a worker died/stalled and its batch re-issued
+    # (the typed record the chaos tier's zero-lost-samples proof greps for)
+    "dataplane_lease": (
+        {"stream": _INT, "batch": _INT, "event": _STR},
+        {"worker": _STR},
+    ),
+    # cache/lease rollup (periodic + at stream close): hits/misses count
+    # decodes saved/paid, evictions the LRU pressure
+    "dataplane_cache": (
+        {"hits": _INT, "misses": _INT, "evictions": _INT, "bytes": _INT},
+        {"entries": _INT, "stream": _INT, "streams": _INT, "reissues": _INT},
+    ),
+    # a decode worker process exited (the service restarts it internally)
+    "dataplane_worker_exit": (
+        {"worker": _STR, "code": _INT},
+        {"restarts": _INT},
+    ),
+    # a CLIENT degraded to local decode (dispatcher unreachable): the stream
+    # continues bitwise-identically from `batch`; written by the trainer's
+    # telemetry, so it lands in the main journal next to the run it slowed
+    "dataplane_fallback": (
+        {"reason": _STR, "epoch": _INT, "batch": _INT},
+        {"error": _STR},
+    ),
     # serving (dtpu-serve, docs/SERVING.md) -------------------------------
     # a serve replica came up: hosted models, compiled batch ladder, bind
     "serve_start": (

@@ -294,6 +294,27 @@ class LiveAggregator:
         elif kind == "memory":
             self._gauge("live_bytes", r.get("live_bytes", 0))
             self._gauge("live_arrays", r.get("live_arrays", 0))
+        elif kind == "dataplane_start":
+            self._count("dataplane_starts_total")
+            self._gauge("dataplane_workers", r.get("workers", 0))
+        elif kind == "dataplane_stream":
+            self._count("dataplane_streams_total")
+        elif kind == "dataplane_lease":
+            self._count("dataplane_lease_reissues_total")
+        elif kind == "dataplane_cache":
+            # the record carries CUMULATIVE totals from the service process;
+            # folded as gauges so a tailing restart can't double-count
+            for key in ("hits", "misses", "evictions", "bytes", "entries",
+                        "streams", "reissues"):
+                if isinstance(r.get(key), (int, float)):
+                    self._gauge(f"dataplane_cache_{key}"
+                                if key in ("hits", "misses", "evictions",
+                                           "bytes", "entries")
+                                else f"dataplane_{key}", r[key])
+        elif kind == "dataplane_worker_exit":
+            self._count("dataplane_worker_exits_total")
+        elif kind == "dataplane_fallback":
+            self._count("dataplane_fallbacks_total")
         elif kind == "alarm":
             self._count("alarms_fired_total")
             self.active_alarms.add(self._alarm_key(r))

@@ -216,6 +216,44 @@ def render(records: Iterable[dict]) -> str:
                 + (f" — {r['reason']}" if r.get("reason") else "")
             )
 
+    # -- dataplane (dtpu-dataplane) -----------------------------------------
+    # only present when a run used the disaggregated input service; omitted
+    # otherwise so ordinary reports (and the golden test) are unchanged
+    if by_kind["dataplane_start"] or by_kind["dataplane_fallback"]:
+        out("")
+        if by_kind["dataplane_start"]:
+            s = by_kind["dataplane_start"][-1]
+            out(
+                f"dataplane: {s.get('workers', '?')} decode worker(s) x "
+                f"{s.get('worker_threads', '?')} thread(s) at "
+                f"{s.get('address', '?')}"
+            )
+        else:
+            out("dataplane:")
+        caches = by_kind["dataplane_cache"]
+        if caches:
+            c = caches[-1]
+            hits, misses = c.get("hits", 0), c.get("misses", 0)
+            rate = hits / max(1, hits + misses)
+            out(
+                f"  cache: {hits} hit(s) / {misses} decode(s) "
+                f"({100.0 * rate:.1f}% saved), {c.get('evictions', 0)} "
+                f"eviction(s), {c.get('bytes', 0) / 2**20:.1f} MB held"
+            )
+        n_streams = len(by_kind["dataplane_stream"])
+        n_reissues = len(by_kind["dataplane_lease"])
+        n_worker_exits = len(by_kind["dataplane_worker_exit"])
+        out(
+            f"  streams={n_streams}  lease_reissues={n_reissues}  "
+            f"worker_exits={n_worker_exits}  "
+            f"fallbacks={len(by_kind['dataplane_fallback'])}"
+        )
+        for r in by_kind["dataplane_fallback"]:
+            out(
+                f"  FALLBACK to local decode at epoch {r.get('epoch', '?')} "
+                f"batch {r.get('batch', '?')} ({r.get('reason', '?')})"
+            )
+
     # -- goodput timeline (per-attempt startup / productive / downtime) ------
     # attributes every second of a supervised or fleet-managed run: for each
     # launch, how long until the first step landed (startup: restore + the

@@ -142,14 +142,41 @@ def derive_rendezvous_port(
     return pick_rendezvous_port(exclude=excluded)
 
 
+def derive_dataplane_port(job_id: str, *, exclude: "Iterable[int]" = ()) -> int:
+    """A dataplane dispatcher port derived deterministically from a job id.
+
+    Same no-coordination property as `derive_rendezvous_port` — the service
+    and every trainer host hash the same OUT_DIR-derived id to the same
+    port, so ``DATA.PORT 0`` needs no address exchange — but in a disjoint
+    hash namespace: a fleet job and its co-scheduled dataplane derive from
+    the same id and must never land on each other's port.
+    """
+    return derive_rendezvous_port(f"dataplane:{job_id}", exclude=exclude)
+
+
+def dataplane_port_in_play() -> int | None:
+    """The co-scheduled dataplane's port, when a supervisor exported its
+    address (``DTPU_DATA_SERVICE=host:port``) — part of the exclusion set
+    below, for the same reason serve frontend ports are."""
+    addr = os.environ.get("DTPU_DATA_SERVICE", "")
+    _, _, port = addr.rpartition(":")
+    return int(port) if port.isdigit() else None
+
+
 def rendezvous_ports_in_play() -> set[int]:
     """Ports the rendezvous machinery may bind on this host — the exclusion
     set a port-0 serve frontend pick must avoid (the other half of the
-    serve-vs-rendezvous collision fix; see `pick_rendezvous_port`)."""
+    serve-vs-rendezvous collision fix; see `pick_rendezvous_port`). The
+    co-scheduled dataplane's dispatcher port rides along: a host running a
+    fleet gang, serve replicas and a dataplane sidecar has three subsystems
+    choosing ports independently."""
     ports = {_DEFAULT_PORT}
     mp = os.environ.get("MASTER_PORT", "")
     if mp.isdigit():
         ports.add(int(mp))
+    dp = dataplane_port_in_play()
+    if dp is not None:
+        ports.add(dp)
     return ports
 
 

@@ -8,59 +8,214 @@ distribuuuu_tpu/data/dataset.py::TarImageFolder). Member names keep the
 tree's full class list, so labels match the unpacked tree exactly — even for
 classes that end up with zero samples in the shards.
 
+Packing is **resumable**: each committed shard gets a ``<shard>.done``
+marker (written after the tar closes, recording its member count), and a
+rerun skips marked shards and repacks unmarked ones — a packing run killed
+mid-shard (the v5e session timeout, a preempted VM) leaves a truncated
+``.tar`` without a marker, which used to poison the dataset until its first
+read; now it just repacks. Shard contents are a pure function of the sorted
+source listing, so a resumed run produces the same shards a clean run would.
+
+``--verify`` re-scans every shard's tar headers and cross-checks: member
+counts against the ``.done`` markers, every member's class against
+``classes.txt``, and the shard set against the expected count — the offline
+integrity gate to run before pointing a pod at the directory.
+
     python scripts/make_tar_shards.py --src /data/ILSVRC/train \
         --dst /data/ILSVRC-shards/train --shard-size 512
+    python scripts/make_tar_shards.py --dst /data/ILSVRC-shards/train --verify
 """
 
 from __future__ import annotations
 
 import argparse
+import hashlib
+import json
 import os
+import re
 import sys
 import tarfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from distribuuuu_tpu.data.dataset import ImageFolder  # noqa: E402
+from distribuuuu_tpu.data.dataset import IMG_EXTENSIONS, ImageFolder  # noqa: E402
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--src", required=True, help="ImageFolder split directory")
-    ap.add_argument("--dst", required=True, help="output directory for *.tar")
-    ap.add_argument("--shard-size", type=int, default=512, help="images per shard")
-    args = ap.parse_args()
+def _shard_name(i: int) -> str:
+    return f"shard-{i:05d}.tar"
 
-    ds = ImageFolder(args.src)
-    os.makedirs(args.dst, exist_ok=True)
-    stale = [f for f in os.listdir(args.dst) if f.endswith(".tar")]
-    if stale:
-        # TarImageFolder indexes every .tar in the directory: mixing
-        # generations silently duplicates samples. Refuse rather than append.
-        raise SystemExit(
-            f"{args.dst} already holds {len(stale)} .tar shard(s); "
-            f"remove them (or pick a fresh --dst) before re-packing"
-        )
-    # label-parity manifest: TarImageFolder prefers this over the member
-    # union, so class ids survive even if a class has no packed samples
-    with open(os.path.join(args.dst, "classes.txt"), "w") as f:
-        f.write("\n".join(ds.classes) + "\n")
-    n_shards = 0
-    tf = None
-    for i, (path, label) in enumerate(ds.samples):
-        if i % args.shard_size == 0:
-            if tf is not None:
-                tf.close()
-            tf = tarfile.open(
-                os.path.join(args.dst, f"shard-{n_shards:05d}.tar"), "w"
+
+def _read_marker(done_path: str) -> dict | None:
+    """The .done marker's JSON, or None when absent/torn. A kill can land
+    mid-marker-write; a garbage marker must read as 'not committed' (pack
+    repacks that shard), never as a crash or a silent skip."""
+    try:
+        with open(done_path) as f:
+            data = json.load(f)
+        return data if isinstance(data, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def pack(src: str, dst: str, shard_size: int) -> int:
+    """(Re)pack; returns the number of shards written this run."""
+    ds = ImageFolder(src)
+    os.makedirs(dst, exist_ok=True)
+    manifest = os.path.join(dst, "classes.txt")
+    if os.path.isfile(manifest):
+        with open(manifest) as f:
+            existing = [ln.strip() for ln in f if ln.strip()]
+        if existing != ds.classes:
+            # a different source tree packed here: resuming would interleave
+            # two generations with shifted class ids — refuse loudly
+            raise SystemExit(
+                f"{manifest} was written from a different class list "
+                f"({len(existing)} vs {len(ds.classes)} classes); pick a "
+                f"fresh --dst or remove the old shards"
             )
-            n_shards += 1
-        member = f"{ds.classes[label]}/{os.path.basename(path)}"
-        tf.add(path, arcname=member, recursive=False)
-    if tf is not None:
-        tf.close()
-    print(f"wrote {n_shards} shard(s), {len(ds.samples)} images → {args.dst}")
+    else:
+        with open(manifest, "w") as f:
+            f.write("\n".join(ds.classes) + "\n")
+
+    n_shards = (len(ds.samples) + shard_size - 1) // shard_size
+    stale = sorted(
+        f for f in os.listdir(dst)
+        if f.endswith(".tar") and f not in {_shard_name(i) for i in range(n_shards)}
+    )
+    if stale:
+        raise SystemExit(
+            f"{dst} holds {len(stale)} shard(s) outside this run's plan of "
+            f"{n_shards} (e.g. {stale[0]}); mixing generations silently "
+            f"duplicates samples — remove them or pick a fresh --dst"
+        )
+
+    written = skipped = 0
+    for si in range(n_shards):
+        tar_path = os.path.join(dst, _shard_name(si))
+        done_path = tar_path + ".done"
+        chunk = ds.samples[si * shard_size : (si + 1) * shard_size]
+        members = [
+            f"{ds.classes[label]}/{os.path.basename(path)}" for path, label in chunk
+        ]
+        # content identity, not just count: a source tree that GAINED files
+        # between runs shifts every later chunk even at the same shard_size,
+        # and a count-only marker would silently mix the two generations
+        digest = hashlib.sha256("\n".join(members).encode()).hexdigest()[:16]
+        marker = _read_marker(done_path)
+        if marker is not None and os.path.isfile(tar_path):
+            # committed by an earlier (possibly killed) run — but only a
+            # marker matching THIS plan's exact member list may skip
+            if marker.get("members_sha") == digest:
+                skipped += 1
+                continue
+            raise SystemExit(
+                f"{_shard_name(si)} was committed from a different plan "
+                f"(marker {marker.get('shard_size')}x"
+                f"{marker.get('images')} sha {marker.get('members_sha')}, "
+                f"this run {shard_size}x{len(chunk)} sha {digest}) — the "
+                f"source listing or --shard-size changed, and resuming "
+                f"would duplicate samples across the shard boundary; pick "
+                f"a fresh --dst or repack from the original source"
+            )
+        # write-then-mark: the .done lands only after the tar is closed, so
+        # a kill mid-shard leaves an unmarked (repacked-next-run) tar
+        with tarfile.open(tar_path, "w") as tf:
+            for (path, _), member in zip(chunk, members):
+                tf.add(path, arcname=member, recursive=False)
+        with open(done_path, "w") as f:
+            json.dump({"images": len(chunk), "shard": _shard_name(si),
+                       "shard_size": shard_size, "members_sha": digest}, f)
+        written += 1
+    print(
+        f"wrote {written} shard(s) ({skipped} already committed), "
+        f"{len(ds.samples)} images total → {dst}"
+    )
+    return written
+
+
+def verify(dst: str) -> int:
+    """Cross-check shards against markers + classes.txt; returns error count."""
+    errors: list[str] = []
+    manifest = os.path.join(dst, "classes.txt")
+    classes: set[str] = set()
+    if os.path.isfile(manifest):
+        with open(manifest) as f:
+            classes = {ln.strip() for ln in f if ln.strip()}
+    else:
+        errors.append(f"missing {manifest}")
+    shards = sorted(f for f in os.listdir(dst) if f.endswith(".tar"))
+    if not shards:
+        errors.append(f"no .tar shards under {dst}")
+    # completeness: the packer numbers shards contiguously from 0, so a gap
+    # (or a missing shard-00000) means shards were deleted/lost after
+    # packing — a dataset silently short by a shard's worth of samples
+    idxs = []
+    for name in shards:
+        m = re.fullmatch(r"shard-(\d+)\.tar", name)
+        if m:
+            idxs.append(int(m.group(1)))
+    missing = sorted(set(range(max(idxs) + 1)) - set(idxs)) if idxs else []
+    if missing:
+        errors.append(
+            f"shard numbering has gaps — missing {missing[:5]}"
+            f"{'...' if len(missing) > 5 else ''} of 0..{max(idxs)}"
+        )
+    total = 0
+    for name in shards:
+        tar_path = os.path.join(dst, name)
+        done_path = tar_path + ".done"
+        marker = _read_marker(done_path)
+        if marker is None:
+            errors.append(
+                f"{name}: missing/unreadable .done marker (truncated "
+                f"packing run?)"
+            )
+            continue
+        expected = int(marker.get("images", -1))
+        try:
+            with tarfile.open(tar_path, "r:") as tf:
+                members = [
+                    m.name for m in tf
+                    if m.isfile() and m.name.lower().endswith(IMG_EXTENSIONS)
+                ]
+        except (tarfile.TarError, OSError) as exc:
+            errors.append(f"{name}: unreadable ({exc!r})")
+            continue
+        if len(members) != expected:
+            errors.append(
+                f"{name}: {len(members)} member(s) but marker says {expected}"
+            )
+        for m in members:
+            cls = m.lstrip("./").split("/", 1)[0]
+            if classes and cls not in classes:
+                errors.append(f"{name}: member class {cls!r} not in classes.txt")
+                break
+        total += len(members)
+    for e in errors:
+        print(f"VERIFY FAIL: {e}")
+    print(
+        f"verify: {len(shards)} shard(s), {total} member(s), "
+        f"{len(errors)} error(s)"
+    )
+    return len(errors)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--src", help="ImageFolder split directory (packing mode)")
+    ap.add_argument("--dst", required=True, help="shard directory")
+    ap.add_argument("--shard-size", type=int, default=512, help="images per shard")
+    ap.add_argument("--verify", action="store_true",
+                    help="check shards against markers + classes.txt and exit")
+    args = ap.parse_args(argv)
+
+    if args.verify:
+        return 1 if verify(args.dst) else 0
+    if not args.src:
+        ap.error("--src is required unless --verify")
+    pack(args.src, args.dst, args.shard_size)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -89,6 +89,36 @@ def test_eval_pads_with_zero_weight(image_root):
     assert batches[-1]["image"].shape == (4, 16, 16, 3)  # static shape
 
 
+def test_set_epoch_fast_forward_skips_at_index_level(image_root, monkeypatch):
+    """Regression: a mid-epoch resume (`set_epoch(start_batch=N)`) must skip
+    at the INDEX level — bitwise-equal remaining stream, zero decode calls
+    for the skipped batches (a decode-and-discard fast-forward would burn
+    minutes re-decoding on every pod-scale resume)."""
+    full = _mk_loader(image_root, 0, 1, host_batch=4)
+    full.set_epoch(2)
+    reference = list(full)
+
+    resumed = _mk_loader(image_root, 0, 1, host_batch=4)
+    decoded: list[int] = []
+    orig = HostDataLoader._load_one_raw
+
+    def spy(self, idx, slot_seed):
+        decoded.append(int(idx))
+        return orig(self, idx, slot_seed)
+
+    monkeypatch.setattr(HostDataLoader, "_load_one_raw", spy)
+    resumed.set_epoch(2, start_batch=3)
+    got = list(resumed)
+    assert len(got) == len(reference) - 3
+    for a, b in zip(reference[3:], got):
+        for key in ("image", "label", "weight"):
+            assert np.array_equal(a[key], b[key]), key
+    # exactly the resumed batches' samples were decoded — none before N
+    assert len(decoded) == (len(reference) - 3) * 4
+    skipped = set(full._shard_indices()[: 3 * 4].tolist())
+    assert not (set(decoded) & skipped)
+
+
 def test_eval_covers_every_sample_exactly_once(image_root):
     loaders = [_mk_loader(image_root, p, 2, train=False) for p in range(2)]
     seen = []

@@ -1,0 +1,848 @@
+"""dtpu-dataplane: the disaggregated input service (docs/DATA.md).
+
+Tiers:
+
+- lease/cache/protocol units — the visit-once and decode-once invariants as
+  pure interleavings, no sockets needed;
+- in-process service integration — the **bitwise oracle** (service-fed
+  stream == local decode over 2 epochs, the contract every other dataplane
+  property reduces to), decode-once across consumers, lease-level
+  mid-epoch resume, client retry over injected socket faults, and the
+  dispatcher-death → local-fallback transition with its typed journal
+  record;
+- chaos (slow): SIGKILL a subprocess decode worker mid-epoch — zero lost /
+  zero double-seen samples — and the service-fed `train_model` smoke
+  (bitwise-identical final params vs local decode, zero steady-state
+  compiles after epoch 0, schema-valid journal).
+"""
+
+import os
+import signal
+import socket
+import time
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from distribuuuu_tpu import resilience
+from distribuuuu_tpu.data.dataset import open_image_dataset
+from distribuuuu_tpu.data.loader import (
+    HostDataLoader,
+    aug_seed_base,
+    shard_indices,
+    transform_fingerprint,
+)
+from distribuuuu_tpu.dataplane import protocol
+from distribuuuu_tpu.dataplane.client import ServiceLoader
+from distribuuuu_tpu.dataplane.dispatcher import BatchCache, Dispatcher, LeaseTable
+from distribuuuu_tpu.dataplane.protocol import StreamSpec
+from distribuuuu_tpu.dataplane.service import DataPlaneService
+from distribuuuu_tpu.obs.journal import validate_record
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LOADER_KW = dict(
+    host_batch=4, train=True, im_size=32,
+    process_index=0, process_count=1, seed=3,
+)
+
+
+@pytest.fixture(scope="module")
+def image_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("dp_images")
+    rng = np.random.default_rng(0)
+    for c in range(2):
+        d = root / f"class_{c}"
+        d.mkdir()
+        for i in range(16):
+            arr = rng.integers(0, 255, (40, 50, 3), np.uint8)
+            Image.fromarray(arr).save(str(d / f"i{i:02d}.jpg"), quality=85)
+    return str(root)
+
+
+def _recorder():
+    events = []
+
+    def event(kind, **fields):
+        events.append({"ts": time.time(), "kind": kind, **fields})
+
+    return events, event
+
+
+def _assert_schema_valid(events):
+    # every event the dataplane emits must be schema-valid — pinned here so
+    # a drifting field name can't hide behind the ValidatedJournal's
+    # drop-invalid-loudly behavior
+    for record in events:
+        assert validate_record(record) == [], record
+
+
+@pytest.fixture()
+def service(image_root):
+    events, event = _recorder()
+    svc = DataPlaneService(
+        workers=2, worker_threads=2, in_process=True, journal_event=event
+    ).start()
+    try:
+        yield svc, events
+    finally:
+        svc.stop()
+        _assert_schema_valid(events)
+
+
+def _local(root, **over):
+    kw = {**LOADER_KW, "crop_size": 32, **over}
+    return HostDataLoader(open_image_dataset(root), workers=2, **kw)
+
+
+def _remote(address, root, **over):
+    kw = {**LOADER_KW, **over}
+    return ServiceLoader(address, root=root, crop_size=32, workers=2, **kw)
+
+
+def _assert_streams_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        for key in ("image", "label", "weight"):
+            assert x[key].dtype == y[key].dtype
+            assert np.array_equal(x[key], y[key]), key
+
+
+# ---------------------------------------------------------------------------
+# Lease accounting units (visit-once)
+# ---------------------------------------------------------------------------
+
+def test_lease_claim_order_and_visit_once():
+    t = LeaseTable(lease_timeout_s=100.0)
+    assert t.claim(range(4), "w1", now=0.0) == 0
+    assert t.claim(range(4), "w2", now=0.0) == 1  # 0 is held by w1
+    assert t.complete("w1", 0) is True
+    assert t.complete("w1", 0) is False  # duplicate: dropped, not re-served
+    assert t.done(0) and not t.done(1)
+    assert t.claim(range(4), "w1", now=0.0) == 2  # 0 done, 1 held
+
+
+def test_lease_expiry_reissues():
+    t = LeaseTable(lease_timeout_s=10.0)
+    assert t.claim(range(2), "w1", now=0.0) == 0
+    # before the deadline the lease holds; after it, re-issue and count
+    assert t.claim([0], "w2", now=5.0) is None
+    assert t.claim([0], "w2", now=11.0) == 0
+    assert t.reissues == 1
+    # the ORIGINAL worker's late completion lands first here — accepted —
+    # and the re-issued worker's duplicate is dropped: exactly one copy
+    assert t.complete("w1", 0) is True
+    assert t.complete("w2", 0) is False
+
+
+def test_lease_fail_worker_requeues_immediately():
+    t = LeaseTable(lease_timeout_s=1000.0)
+    assert t.claim(range(4), "w1", now=0.0) == 0
+    assert t.claim(range(4), "w1", now=0.0) == 1
+    assert t.fail_worker("w1") == [0, 1]
+    assert t.reissues == 2
+    # both batches are claimable again without waiting out the timeout
+    assert t.claim(range(4), "w2", now=0.0) == 0
+
+
+def test_lease_reopen_after_payload_loss():
+    t = LeaseTable(lease_timeout_s=1000.0)
+    assert t.claim(range(2), "w1", now=0.0) == 0
+    assert t.complete("w1", 0) is True
+    # the payload was delivered and evicted before a lagging consumer got
+    # it: reopen makes the batch decodable again (done == bytes available)
+    t.reopen(0)
+    assert not t.done(0)
+    assert t.claim(range(2), "w2", now=0.0) == 0
+    assert t.complete("w2", 0) is True
+
+
+def test_lease_decode_failure_poisons_after_retries():
+    t = LeaseTable(lease_timeout_s=1000.0)
+    for _ in range(2):
+        b = t.claim(range(4), "w1", now=0.0)
+        assert b == 0
+        assert t.fail("w1", b) is True  # re-queued
+    assert t.claim(range(4), "w1", now=0.0) == 0
+    assert t.fail("w1", 0) is False  # third strike: poisoned
+
+
+# ---------------------------------------------------------------------------
+# Cache units (decode-once)
+# ---------------------------------------------------------------------------
+
+def _arrays(nbytes: int) -> dict:
+    return {"image": np.zeros(nbytes, np.uint8)}
+
+
+def test_cache_lru_hit_and_evict():
+    c = BatchCache(max_bytes=300)
+    c.put(("a",), _arrays(100))
+    c.put(("b",), _arrays(100))
+    c.put(("c",), _arrays(100))
+    assert c.get(("a",)) is not None  # refreshes a's recency
+    c.put(("d",), _arrays(100))  # evicts b (LRU), not a
+    assert c.get(("b",)) is None
+    assert c.get(("a",)) is not None
+    assert c.evictions == 1
+    assert c.bytes <= 300
+
+
+def test_streamspec_cache_key_semantics(image_root):
+    base = dict(
+        root=image_root, train=True, seed=3, epoch=1, im_size=32, crop_size=32,
+        host_batch=4, process_index=0, process_count=1, start_batch=0,
+        fingerprint="pil:train32",
+    )
+    spec = StreamSpec(**base)
+    # a resumed stream re-reads the same decoded batches -> start_batch is
+    # NOT identity; a different transform / epoch / seed is a different batch
+    assert spec.cache_key(2) == StreamSpec(**{**base, "start_batch": 2}).cache_key(2)
+    assert spec.cache_key(2) != StreamSpec(**{**base, "epoch": 2}).cache_key(2)
+    assert spec.cache_key(2) != StreamSpec(
+        **{**base, "fingerprint": "pil:eval32c32"}
+    ).cache_key(2)
+    assert StreamSpec.from_dict(spec.to_dict()) == spec
+
+
+def test_transform_fingerprint_distinguishes_pipelines():
+    t = transform_fingerprint(train=True, im_size=224, crop_size=224)
+    e = transform_fingerprint(train=False, im_size=256, crop_size=224)
+    assert t != e
+
+
+# ---------------------------------------------------------------------------
+# Protocol
+# ---------------------------------------------------------------------------
+
+def test_protocol_frame_roundtrip():
+    a, b = socket.socketpair()
+    fa, fb = a.makefile("rwb"), b.makefile("rwb")
+    arrays = {
+        "image": np.arange(24, dtype=np.uint8).reshape(2, 3, 4),
+        "weight": np.array([0.5, 1.0], np.float32),
+    }
+    protocol.send_msg(fa, {"op": "done", "batch": 7}, arrays=arrays)
+    msg, got = protocol.recv_msg(fb)
+    assert msg == {"op": "done", "batch": 7}
+    for key in arrays:
+        assert got[key].dtype == arrays[key].dtype
+        assert np.array_equal(got[key], arrays[key])
+    fa.close()  # the fd lives until every makefile() handle is closed
+    a.close()
+    with pytest.raises(EOFError):
+        protocol.recv_msg(fb)
+    fb.close()
+    b.close()
+
+
+# ---------------------------------------------------------------------------
+# Service integration (in-process workers)
+# ---------------------------------------------------------------------------
+
+def test_service_stream_bitwise_equals_local_two_epochs(service, image_root):
+    """THE oracle: a service-fed sample stream is bitwise what local decode
+    produces, across an epoch reshuffle."""
+    svc, _ = service
+    local = _local(image_root)
+    remote = _remote(svc.address, image_root, fallback=False)
+    assert len(local) == len(remote)
+    for epoch in range(2):
+        local.set_epoch(epoch)
+        remote.set_epoch(epoch)
+        _assert_streams_equal(list(local), list(remote))
+
+
+def test_eval_stream_bitwise_with_padding(service, image_root):
+    """Eval geometry (no drop_last, weight-0 pad tail) through the service."""
+    svc, _ = service
+    over = dict(train=False, host_batch=5, im_size=40)
+    local = _local(image_root, **over)
+    remote = _remote(svc.address, image_root, fallback=False, **over)
+    _assert_streams_equal(list(local), list(remote))
+
+
+def test_cache_serves_second_consumer_without_redecode(service, image_root):
+    """Decode-once: a second job with the same spec costs zero decodes."""
+    svc, _ = service
+    first = _remote(svc.address, image_root, fallback=False)
+    first.set_epoch(0)
+    ref = list(first)
+    misses = svc.dispatcher.stats()["misses"]
+    second = _remote(svc.address, image_root, fallback=False)
+    second.set_epoch(0)
+    _assert_streams_equal(ref, list(second))
+    stats = svc.dispatcher.stats()
+    assert stats["misses"] == misses  # no new decode
+    assert stats["hits"] >= len(ref)
+
+
+def test_service_resume_skips_at_lease_level(service, image_root):
+    """Mid-epoch resume (`set_epoch(start_batch=N)`): skipped batches are
+    never decoded service-side — the lease window starts at N."""
+    svc, _ = service
+    local = _local(image_root)
+    local.set_epoch(1)
+    full = list(local)
+    remote = _remote(svc.address, image_root, fallback=False)
+    remote.set_epoch(1, start_batch=3)
+    resumed = list(remote)
+    _assert_streams_equal(full[3:], resumed)
+    assert svc.dispatcher.stats()["misses"] == len(full) - 3
+
+
+def test_client_retries_injected_socket_faults(service, image_root):
+    """FAULT injection on the client socket path: a transient failure on one
+    batch request tears the connection, the client reconnects and re-streams
+    from the exact next undelivered batch — nothing lost or double-seen."""
+    svc, _ = service
+    local = _local(image_root)
+    local.set_epoch(0)
+    injector = resilience.FaultInjector(
+        io_indices=[1], io_failures=1, nan_steps=[], preempt_step=-1,
+        hang_step=-1, kill_step=-1,
+    )
+    remote = _remote(svc.address, image_root, fallback=False, injector=injector)
+    remote.set_epoch(0)
+    _assert_streams_equal(list(local), list(remote))
+    assert injector._io_counts.get(1) == 1  # the fault actually fired
+    assert remote._local is None  # absorbed by reconnect, not by fallback
+
+
+def test_worker_disconnect_reissues_lease(image_root):
+    """Protocol-level kill against a bare dispatcher (no competing pool): a
+    worker that takes a lease and vanishes has it re-issued (typed
+    dataplane_lease record) to the next worker, and the batch is accepted
+    exactly once."""
+    events, event = _recorder()
+    disp = Dispatcher(journal_event=event)
+    spec = StreamSpec(
+        root=image_root, train=True, seed=99, epoch=0, im_size=32, crop_size=32,
+        host_batch=4, process_index=0, process_count=1, start_batch=0,
+        fingerprint=transform_fingerprint(train=True, im_size=32, crop_size=32),
+    )
+    try:
+        # a raw client registration makes the stream leasable
+        csock, cf = protocol.connect(disp.address)
+        protocol.send_msg(cf, {"op": "register_stream", "spec": spec.to_dict()})
+        reply, _ = protocol.recv_msg(cf)
+        assert reply["ok"]
+
+        def worker_conn(name):
+            sock, f = protocol.connect(disp.address)
+            protocol.send_msg(f, {"op": "register_worker", "worker": name})
+            protocol.recv_msg(f)
+            return sock, f
+
+        def lease(f):
+            protocol.send_msg(f, {"op": "lease"})
+            got, _ = protocol.recv_msg(f)
+            assert not got.get("idle"), got
+            return got
+
+        s1, f1 = worker_conn("victim")
+        got1 = lease(f1)
+        assert got1["batch"] == 0
+        f1.close()  # SIGKILL-shaped: connection drops with the lease held
+        s1.close()  # (both handles — the fd outlives the socket object)
+
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if any(e["kind"] == "dataplane_lease" and e["event"] == "reissue"
+                   and e["batch"] == 0 for e in events):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("dropped lease never re-issued")
+
+        # the survivor gets the SAME batch and its completion is accepted
+        s2, f2 = worker_conn("survivor")
+        got2 = lease(f2)
+        assert got2["batch"] == 0
+        arrays = {
+            "image": np.zeros((4, 32, 32, 3), np.uint8),
+            "label": np.zeros((4,), np.int32),
+            "weight": np.ones((4,), np.float32),
+        }
+        protocol.send_msg(
+            f2, {"op": "done", "stream": got2["stream"], "batch": 0},
+            arrays=arrays,
+        )
+        ack, _ = protocol.recv_msg(f2)
+        assert ack["accepted"] is True
+        s2.close()
+        csock.close()
+        _assert_schema_valid(events)
+    finally:
+        disp.close()
+
+
+def test_lagging_consumer_redecodes_evicted_batches(image_root):
+    """A second equal-spec client arriving after the cache evicted the
+    early batches must trigger re-decode (lease reopen), not hang — and
+    still see the bitwise stream."""
+    events, event = _recorder()
+    # cache_bytes=1: every put evicts down to a single entry, so by the
+    # time the first client finishes, batch 0's payload is long gone
+    svc = DataPlaneService(
+        workers=2, worker_threads=2, in_process=True, journal_event=event,
+        cache_bytes=1,
+    ).start()
+    try:
+        # client A consumes all but the last batch and STAYS REGISTERED
+        # (its lease table survives), so every batch it passed is table-done,
+        # ready-gc'd, and cache-evicted by the time B asks for batch 0
+        first = _remote(svc.address, image_root, fallback=False)
+        first.set_epoch(0)
+        it_a = iter(first)
+        got_a = [next(it_a) for _ in range(len(first) - 1)]
+        second = _remote(svc.address, image_root, fallback=False)
+        second.set_epoch(0)
+        got_b = list(second)
+        got_a.extend(it_a)  # A finishes after B
+        _assert_streams_equal(got_a, got_b)
+        local = _local(image_root)
+        local.set_epoch(0)
+        _assert_streams_equal(list(local), got_b)
+    finally:
+        svc.stop()
+        _assert_schema_valid(events)
+
+
+def test_client_returns_to_service_at_next_epoch(image_root):
+    """Fallback is per-epoch: when a dead dispatcher comes back (the fleet
+    sidecar's restart story), the next set_epoch returns the stream to
+    service feed instead of decoding locally for the rest of the run."""
+    svc = DataPlaneService(workers=1, worker_threads=2, in_process=True).start()
+    remote = _remote(svc.address, image_root, fallback=True)
+    local = _local(image_root)
+    port = svc.dispatcher.port
+    try:
+        remote.set_epoch(0)
+        it = iter(remote)
+        next(it)
+        svc.stop()  # dies mid-epoch -> rest of epoch 0 decodes locally
+        list(it)
+        assert remote._local is not None
+        svc2 = DataPlaneService(
+            workers=1, worker_threads=2, in_process=True, port=port,
+        ).start()
+        try:
+            remote.set_epoch(1)
+            assert remote._local is None  # back on the service
+            local.set_epoch(1)
+            _assert_streams_equal(list(local), list(remote))
+        finally:
+            svc2.stop()
+    finally:
+        svc.stop()
+
+
+def test_worker_refuses_fingerprint_mismatch(image_root):
+    """A worker whose decode backend differs from the client's must refuse
+    the lease loudly — never silently serve divergent pixels."""
+    from distribuuuu_tpu.dataplane.worker import _SpecLoaders
+
+    spec = StreamSpec(
+        root=image_root, train=True, seed=1, epoch=0, im_size=32, crop_size=32,
+        host_batch=4, process_index=0, process_count=1, start_batch=0,
+        fingerprint="native-from-some-other-box:train32",
+    )
+    with pytest.raises(RuntimeError, match="fingerprint mismatch"):
+        _SpecLoaders().loader_for(spec)
+
+
+def test_poisoned_batch_fails_loudly_not_fallback(image_root):
+    """A batch no worker can decode (corrupt shard region) must fail the
+    client loudly — local decode would fail identically, so neither the
+    reconnect loop nor the local fallback may mask it."""
+    disp = Dispatcher(journal_event=lambda *a, **k: None)
+    try:
+        remote = ServiceLoader(
+            disp.address, root=image_root, crop_size=32, workers=2,
+            fallback=True, **LOADER_KW,
+        )
+        spec = remote._spec(0)
+        # fake worker burns batch 0's three decode attempts -> poisoned
+        csock, cf = protocol.connect(disp.address)
+        protocol.send_msg(cf, {"op": "register_stream", "spec": spec.to_dict()})
+        protocol.recv_msg(cf)
+        wsock, wf = protocol.connect(disp.address)
+        protocol.send_msg(wf, {"op": "register_worker", "worker": "sad"})
+        protocol.recv_msg(wf)
+        for _ in range(3):
+            protocol.send_msg(wf, {"op": "lease"})
+            got, _ = protocol.recv_msg(wf)
+            assert got.get("batch") == 0
+            protocol.send_msg(wf, {"op": "done", "stream": got["stream"],
+                                   "batch": 0, "error": "torn jpeg"})
+            protocol.recv_msg(wf)
+        with pytest.raises(RuntimeError, match="undecodable"):
+            list(remote)
+        for h in (cf, csock, wf, wsock):
+            h.close()
+    finally:
+        disp.close()
+
+
+def test_dispatcher_death_falls_back_to_local(service, image_root, tmp_path,
+                                              fresh_cfg):
+    """Dispatcher dies mid-epoch: the client finishes the epoch with local
+    decode, bitwise-identically, and journals a typed dataplane_fallback."""
+    from distribuuuu_tpu.obs import telemetry as obs_telemetry
+    from distribuuuu_tpu.obs.journal import read_journal
+
+    svc, _ = service
+    local = _local(image_root)
+    local.set_epoch(0)
+    expected = list(local)
+
+    tel = obs_telemetry.Telemetry(str(tmp_path))
+    obs_telemetry.set_current(tel)
+    try:
+        remote = _remote(svc.address, image_root, fallback=True)
+        remote.set_epoch(0)
+        got = []
+        for n, batch in enumerate(remote):
+            got.append(batch)
+            if n == 1:
+                svc.stop()
+        _assert_streams_equal(expected, got)
+    finally:
+        obs_telemetry.set_current(None)
+        tel.close()
+    records = [r for r in read_journal(str(tel.journal_path))
+               if r["kind"] == "dataplane_fallback"]
+    assert records, "fallback must leave a typed journal record"
+    assert validate_record(records[0]) == []
+    assert records[0]["reason"] == "dispatcher_lost"
+    # the resume point is the next batch the CLIENT had not yielded when it
+    # noticed the death — at least the 2 consumed before the kill, and the
+    # pipelined requests may have landed a couple more before the socket died
+    assert 2 <= records[0]["batch"] < len(expected)
+
+
+def test_fallback_off_raises(image_root, fresh_cfg):
+    """DATA.FALLBACK off + no service = a loud failure, never silent local."""
+    svc = DataPlaneService(workers=1, in_process=True).start()
+    address = svc.address
+    svc.stop()
+    fresh_cfg.FAULT.RETRY_ATTEMPTS = 2
+    fresh_cfg.FAULT.RETRY_BASE_DELAY = 0.01
+    fresh_cfg.FAULT.RETRY_MAX_DELAY = 0.02
+    with pytest.raises((OSError, RuntimeError)):
+        _remote(address, image_root, fallback=False)
+
+
+def test_shard_indices_matches_loader(image_root):
+    """The pure function and the loader method are the same stream (the
+    dispatcher/worker derive from the function; the oracle needs both)."""
+    loader = _local(image_root, process_count=2, process_index=1)
+    loader.set_epoch(4)
+    pure = shard_indices(
+        len(loader.dataset), train=True, seed=LOADER_KW["seed"], epoch=4,
+        process_index=1, process_count=2,
+    )
+    assert np.array_equal(loader._shard_indices(), pure)
+    assert aug_seed_base(3, 4, 1) == aug_seed_base(3, 4, 1)
+
+
+def test_aggregator_and_exporter_fold_dataplane_records():
+    from distribuuuu_tpu.obs.exporter import render_prometheus
+    from distribuuuu_tpu.obs.stream import LiveAggregator
+
+    agg = LiveAggregator()
+    agg.ingest_all([
+        {"ts": 1.0, "kind": "dataplane_start", "address": "x:1", "workers": 4},
+        {"ts": 2.0, "kind": "dataplane_stream", "stream": 1, "root": "r",
+         "train": True, "epoch": 0, "num_batches": 8},
+        {"ts": 3.0, "kind": "dataplane_lease", "stream": 1, "batch": 2,
+         "event": "reissue"},
+        {"ts": 4.0, "kind": "dataplane_cache", "hits": 5, "misses": 7,
+         "evictions": 1, "bytes": 1024},
+        {"ts": 5.0, "kind": "dataplane_worker_exit", "worker": "w0", "code": -9},
+        {"ts": 6.0, "kind": "dataplane_fallback", "reason": "dispatcher_lost",
+         "epoch": 0, "batch": 3},
+    ])
+    snap = agg.snapshot()
+    assert snap["gauges"]["dataplane_workers"] == 4
+    assert snap["gauges"]["dataplane_cache_hits"] == 5
+    assert snap["counters"]["dataplane_lease_reissues_total"] == 1
+    assert snap["counters"]["dataplane_worker_exits_total"] == 1
+    assert snap["counters"]["dataplane_fallbacks_total"] == 1
+    text = render_prometheus(snap)
+    assert "dtpu_dataplane_workers 4" in text
+    assert "dtpu_dataplane_cache_hits 5" in text
+
+
+def test_summarize_renders_dataplane_section():
+    from distribuuuu_tpu.obs.summarize import render
+
+    text = render([
+        {"ts": 1.0, "kind": "dataplane_start", "address": "127.0.0.1:9",
+         "workers": 2, "worker_threads": 4},
+        {"ts": 2.0, "kind": "dataplane_cache", "hits": 6, "misses": 2,
+         "evictions": 0, "bytes": 2 << 20},
+        {"ts": 3.0, "kind": "dataplane_fallback", "reason": "dispatcher_lost",
+         "epoch": 1, "batch": 4},
+    ])
+    assert "dataplane: 2 decode worker(s)" in text
+    assert "75.0% saved" in text
+    assert "FALLBACK to local decode at epoch 1 batch 4" in text
+
+
+def test_derived_dataplane_port_is_stable_and_disjoint():
+    from distribuuuu_tpu.runtime.dist import (
+        derive_dataplane_port,
+        derive_rendezvous_port,
+    )
+
+    a = derive_dataplane_port("job-x")
+    assert a == derive_dataplane_port("job-x")  # no coordination needed
+    assert 20000 <= a < 29500
+    assert a != derive_rendezvous_port("job-x")  # disjoint namespaces
+
+
+# ---------------------------------------------------------------------------
+# make_tar_shards: resumable packing + --verify (satellite)
+# ---------------------------------------------------------------------------
+
+def _mts():
+    """scripts/make_tar_shards imported in-process (a subprocess per
+    invocation would cost this tier ~40s of interpreter restarts)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "make_tar_shards", os.path.join(REPO, "scripts", "make_tar_shards.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _run_shards(capsys, *args):
+    """main(argv) in-process; returns (rc, stdout, error-message)."""
+    try:
+        rc = _mts().main(list(args))
+        err = ""
+    except SystemExit as exc:  # the refusal paths raise SystemExit(message)
+        rc, err = 1, str(exc.code)
+    out = capsys.readouterr().out
+    return rc, out, err
+
+
+def test_make_tar_shards_resumable_and_verify(tmp_path, capsys):
+    src = tmp_path / "src"
+    rng = np.random.default_rng(0)
+    for c in ("a", "b"):
+        (src / c).mkdir(parents=True)
+        for i in range(9):
+            Image.fromarray(rng.integers(0, 255, (20, 20, 3), np.uint8)).save(
+                str(src / c / f"i{i}.jpg")
+            )
+    dst = tmp_path / "dst"
+    rc, out, _ = _run_shards(capsys, "--src", str(src), "--dst", str(dst),
+                             "--shard-size", "5")
+    assert rc == 0
+    assert "wrote 4 shard(s) (0 already committed)" in out
+    assert sorted(f for f in os.listdir(dst) if f.endswith(".done")) == [
+        f"shard-{i:05d}.tar.done" for i in range(4)
+    ]
+    assert _run_shards(capsys, "--dst", str(dst), "--verify")[0] == 0
+
+    # simulate a killed packing run: a truncated tar with no .done marker
+    (dst / "shard-00001.tar").write_bytes(b"torn")
+    (dst / "shard-00001.tar.done").unlink()
+    rc, out, _ = _run_shards(capsys, "--dst", str(dst), "--verify")
+    assert rc == 1
+    assert "unreadable .done marker" in out
+
+    # resume: only the torn shard repacks, and the result verifies + reads
+    rc, out, _ = _run_shards(capsys, "--src", str(src), "--dst", str(dst),
+                             "--shard-size", "5")
+    assert rc == 0
+    assert "wrote 1 shard(s) (3 already committed)" in out
+    assert _run_shards(capsys, "--dst", str(dst), "--verify")[0] == 0
+    from distribuuuu_tpu.data.dataset import TarImageFolder
+
+    assert len(TarImageFolder(str(dst))) == 18
+
+    # a corrupt (torn) marker reads as "not committed", never a crash:
+    # verify reports it, resume repacks that shard
+    (dst / "shard-00002.tar.done").write_text("{torn")
+    rc, out, _ = _run_shards(capsys, "--dst", str(dst), "--verify")
+    assert rc == 1 and "unreadable .done" in out
+    assert _run_shards(capsys, "--src", str(src), "--dst", str(dst),
+                       "--shard-size", "5")[0] == 0
+    assert _run_shards(capsys, "--dst", str(dst), "--verify")[0] == 0
+
+    # a rerun with a different --shard-size would re-chunk every index and
+    # duplicate the committed shards' samples — refused, not resumed
+    rc, _, err = _run_shards(capsys, "--src", str(src), "--dst", str(dst),
+                             "--shard-size", "3")
+    assert rc != 0
+    assert "duplicate samples" in err
+
+    # completeness: a shard deleted AFTER packing (marker and all) is a gap
+    # in the numbering — verify must flag the silently-short dataset
+    (dst / "shard-00001.tar").unlink()
+    (dst / "shard-00001.tar.done").unlink()
+    rc, out, _ = _run_shards(capsys, "--dst", str(dst), "--verify")
+    assert rc == 1 and "shard numbering has gaps" in out
+
+
+def test_make_tar_shards_refuses_mixed_generations(tmp_path, capsys):
+    src = tmp_path / "src"
+    (src / "a").mkdir(parents=True)
+    Image.new("RGB", (8, 8)).save(str(src / "a" / "x.jpg"))
+    dst = tmp_path / "dst"
+    assert _run_shards(capsys, "--src", str(src), "--dst", str(dst))[0] == 0
+    (dst / "shard-99999.tar").write_bytes(b"stale generation")
+    rc, _, err = _run_shards(capsys, "--src", str(src), "--dst", str(dst))
+    assert rc != 0
+    assert "mixing generations" in err
+
+
+# ---------------------------------------------------------------------------
+# Chaos tier (subprocess decode workers) + the train smoke
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_worker_sigkill_zero_lost_samples(image_root):
+    """SIGKILL a real decode-worker process mid-epoch: its leases re-issue,
+    the service restarts it, and the client stream is bitwise-complete —
+    zero lost, zero double-seen."""
+    events, event = _recorder()
+    svc = DataPlaneService(
+        workers=2, worker_threads=2, in_process=False, journal_event=event
+    ).start()
+    try:
+        deadline = time.monotonic() + 120.0
+        while len(svc.worker_pids()) < 2 and time.monotonic() < deadline:
+            time.sleep(0.2)
+        assert len(svc.worker_pids()) == 2
+        local = _local(image_root)
+        local.set_epoch(0)
+        expected = list(local)
+        remote = _remote(svc.address, image_root, fallback=False)
+        remote.set_epoch(0)
+        got = []
+        for n, batch in enumerate(remote):
+            got.append(batch)
+            if n == 0:
+                os.kill(svc.worker_pids()[0], signal.SIGKILL)
+        _assert_streams_equal(expected, got)
+        # the kill is journaled by the monitor once it reaps the process
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if any(e["kind"] == "dataplane_worker_exit" for e in events):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("worker exit never journaled")
+    finally:
+        svc.stop()
+
+
+@pytest.mark.slow
+def test_train_model_service_fed_bitwise(image_root, tmp_path, fresh_cfg,
+                                         monkeypatch):
+    """Acceptance: service-fed training == local-decode training, bitwise,
+    over 2 epochs — and zero backend compiles after epoch 0 (the journaled
+    CompileGuard equivalent: identical shapes through `prefetch_to_device`)."""
+    import jax
+
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.models import list_models, register_model
+    from distribuuuu_tpu.obs.journal import read_journal, validate_journal
+    from distribuuuu_tpu.obs.monitors import BACKEND_COMPILE_EVENT
+
+    if "dp_tiny" not in list_models():
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        class _DpTiny(nn.Module):
+            num_classes: int = 2
+
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                x = nn.Conv(4, (3, 3), use_bias=False, dtype=jnp.float32)(x)
+                x = nn.BatchNorm(use_running_average=not train)(x)
+                return nn.Dense(self.num_classes)(nn.relu(x).mean(axis=(1, 2)))
+
+        @register_model("dp_tiny")
+        def dp_tiny(num_classes, dtype, bn_axis_name=None, remat=False):
+            return _DpTiny(num_classes=num_classes)
+
+    # dataset root with train/ + val/ splits (val reuses the same images)
+    import shutil
+
+    root = tmp_path / "data"
+    for split in ("train", "val"):
+        for cls in os.listdir(image_root):
+            shutil.copytree(
+                os.path.join(image_root, cls), str(root / split / cls),
+                dirs_exist_ok=True,
+            )
+
+    def _cfg(out_dir, service_addr):
+        from distribuuuu_tpu import config
+
+        config.reset_cfg()
+        c = config.cfg
+        c.MODEL.ARCH = "dp_tiny"
+        c.MODEL.NUM_CLASSES = 2
+        c.MODEL.DTYPE = "float32"
+        c.TRAIN.BATCH_SIZE = 1
+        c.TRAIN.IM_SIZE = 16
+        c.TEST.IM_SIZE = 16
+        c.TEST.CROP_SIZE = 16
+        c.TEST.BATCH_SIZE = 1
+        c.TRAIN.DATASET = str(root)
+        c.TEST.DATASET = str(root)
+        c.TRAIN.WORKERS = 2
+        c.TRAIN.PRINT_FREQ = 1
+        c.OPTIM.MAX_EPOCH = 3
+        c.OPTIM.WARMUP_EPOCHS = 0
+        c.RNG_SEED = 7
+        c.FAULT.HANDLE_SIGNALS = False
+        c.OUT_DIR = str(out_dir)
+        c.DATA.SERVICE = service_addr
+        return c
+
+    svc = DataPlaneService(workers=2, worker_threads=2, in_process=True).start()
+    try:
+        _cfg(tmp_path / "svc_run", svc.address)
+        state_service, _ = trainer.train_model()
+        service_leaves = [
+            np.array(x) for x in jax.tree.leaves(jax.device_get(state_service.params))
+        ]
+        del state_service
+    finally:
+        svc.stop()
+
+    journal = tmp_path / "svc_run" / "telemetry.jsonl"
+    assert validate_journal(str(journal)) == []
+    # epoch 2's counter delta covers epoch-2 train + epoch-1 eval — both
+    # steady state (epoch 1's delta still carries epoch-0's EVAL compile:
+    # epoch_end fires inside train_epoch, before that epoch's validate)
+    counters = [r for r in read_journal(str(journal))
+                if r["kind"] == "counters" and r.get("scope") == "epoch"
+                and r.get("epoch", 0) >= 2]
+    assert counters, "expected epoch>=2 counters records"
+    for rec in counters:
+        compiles = rec["durations"].get(BACKEND_COMPILE_EVENT, {})
+        assert not compiles.get("count"), (
+            f"steady-state compile with ServiceLoader: {compiles}"
+        )
+
+    _cfg(tmp_path / "local_run", "")
+    state_local, _ = trainer.train_model()
+    local_leaves = [
+        np.array(x) for x in jax.tree.leaves(jax.device_get(state_local.params))
+    ]
+    assert len(service_leaves) == len(local_leaves)
+    for a, b in zip(service_leaves, local_leaves):
+        np.testing.assert_array_equal(a, b)
