@@ -49,6 +49,11 @@ def _variant_tags() -> str:
         tags += " +s2d" if stem_s2d else " +nos2d"
     if os.environ.get("DTPU_FUSED_ATTN", "0") == "1":
         tags += " +fused-attn"
+    if os.environ.get("DTPU_FUSED_EPILOGUE", "0") == "1":
+        # the fused conv-epilogue A/B arm (ops/epilogue.py): the env var is
+        # read by the model's bn_epilogue routing at trace time, so setting
+        # it is the whole experiment — this tag just labels the JSON line
+        tags += " +fused-epi"
     if bn_f32:
         tags += " +bnf32"
     return tags

@@ -48,6 +48,15 @@ _C.MODEL.REMAT = False
 # Space-to-depth stem (resnet/botnet families): exact same math, MXU-shaped
 # compute for the 7x7/2 3-channel stem conv. Checkpoint-compatible both ways.
 _C.MODEL.STEM_S2D = False
+# Fused conv-epilogue kernels (ops/epilogue.py, docs/PERFORMANCE.md
+# "Epilogue fusion"): route each resnet-family conv→BN(→residual)→ReLU
+# boundary through one VMEM-resident Pallas pass instead of XLA's separate
+# fusions. Bitwise-identical output/grads to the unfused path (oracle-
+# equality pinned in tests/test_epilogue.py; SyncBN/BN_DTYPE semantics
+# unchanged — stats stay in flax code). Off by default pending a >1×
+# on-chip verdict (`scripts/soak_fused_attn.py --epilogue`); the
+# DTPU_FUSED_EPILOGUE env var overrides this knob (the bench A/B arm).
+_C.MODEL.FUSED_EPILOGUE = False
 # BatchNorm boundary dtype: what dtype BN *emits* between conv stages.
 # Statistics are always computed in float32 and running stats/affine params
 # always stored float32; "bfloat16" halves inter-stage HBM traffic (the
@@ -448,6 +457,24 @@ _C.QUANT.GATE_N = 16
 _C.QUANT.GATE_SEED = 0
 _C.QUANT.MIN_TOP1_AGREE = 0.99
 _C.QUANT.MAX_LOGIT_RMSE = 0.25
+# Quantization-aware fine-tuning (quant/qat.py; docs/PERFORMANCE.md
+# "Quantized training"). QAT True routes every train/eval forward through
+# the fake-quant straight-through-estimator interception: activations
+# fake-quantized per-tensor on scales from the same calibration pass PTQ
+# uses (CALIB_* knobs above), weights per-output-channel on their live
+# amax. The rescue path for a model that fails the PTQ serve gate —
+# fine-tune with QAT on, re-serve `:int8`, the gate/fixtures/refuse-to-
+# serve plumbing transfer unchanged.
+_C.QUANT.QAT = False
+# Fake-quant grid: "int8" (the serving grid, ±127 symmetric) or "fp8"
+# (float8_e4m3fn — the Micikevicius 2022 training format, ±448).
+_C.QUANT.QAT_MODE = "int8"
+# Self-distillation weight: adds QAT_DISTILL · mean((fp_logits −
+# qat_logits)²) to the loss, regressing the fake-quant forward onto the
+# model's own (stop-gradient) fp logits — the serve gate's logit-RMSE
+# metric optimized directly. 0 = pure task-loss QAT; ~1.0 is the
+# documented rescue recipe.
+_C.QUANT.QAT_DISTILL = 0.0
 
 # Fleet orchestration (TPU addition; docs/FAULT_TOLERANCE.md "Fleet runs").
 # `dtpu-fleet --cfg ...` promotes supervision from host scope (dtpu-agent)

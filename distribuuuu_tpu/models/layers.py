@@ -16,10 +16,13 @@ Conventions (TPU-first):
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, ClassVar
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
+
+from distribuuuu_tpu.ops.epilogue import fused_conv_epilogue, switch_epilogue
 
 # torch nn.init.kaiming_normal_(mode="fan_out", nonlinearity="relu"):
 # N(0, sqrt(2 / fan_out)) — variance_scaling(2.0, fan_out, normal).
@@ -117,6 +120,129 @@ def batch_norm(
         scale_init=nn.initializers.zeros if zero_scale else nn.initializers.ones,
         name=name,
     )
+
+
+class EpilogueBatchNorm(nn.BatchNorm):
+    """`nn.BatchNorm` whose *apply* is the fused conv-epilogue kernel.
+
+    The fused route of :func:`bn_epilogue`. Statistics stay exactly flax's
+    code — the same `_compute_stats` (f32 reductions, fast variance, the
+    SyncBN ``pmean`` over ``axis_name``) and the same running-EMA update —
+    so SyncBN and ``MODEL.BN_DTYPE`` semantics are untouched; only the
+    per-element normalize → (+residual) → ReLU tail runs through
+    `ops.epilogue.fused_conv_epilogue` (which folds the stats to the same
+    ``mean``/``rsqrt(var+eps)·scale``/``bias`` affine ``_normalize`` applies,
+    in the same operation order — bitwise-equal output, pinned in
+    tests/test_epilogue.py).
+
+    A subclass rather than a sibling so variable paths (``scale``/``bias``
+    params, ``mean``/``var`` batch_stats under the same module name) are
+    identical — checkpoints trained fused load unfused and vice versa.
+    """
+
+    relu: bool = True
+    # PTQ fold detection (quant/ptq.py) must NOT treat this module as a
+    # plain BN: its call also applies the residual add and the ReLU, so
+    # substituting the BN-fold affine/identity for it would drop both —
+    # the site stays a live op (exactly what fused routing executes)
+    fused_epilogue: ClassVar[bool] = True
+
+    @nn.compact
+    def __call__(self, x, identity=None, use_running_average=None):  # noqa: D102
+        # private flax helpers, imported HERE so a flax release moving them
+        # breaks only this opt-in fused path, not `import models.layers`
+        from flax.linen import dtypes as _flax_dtypes
+        from flax.linen.normalization import _compute_stats
+
+        if self.axis != -1 or not (self.use_scale and self.use_bias):
+            raise NotImplementedError(
+                "EpilogueBatchNorm supports the zoo's BN shape only "
+                "(axis=-1, affine scale+bias)"
+            )
+        use_running_average = nn.merge_param(
+            "use_running_average", self.use_running_average, use_running_average
+        )
+        feature_shape = [x.shape[-1]]
+        reduction_axes = tuple(range(x.ndim - 1))
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda s: jnp.zeros(s, jnp.float32), feature_shape
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda s: jnp.ones(s, jnp.float32), feature_shape
+        )
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            mean, var = _compute_stats(
+                x,
+                reduction_axes,
+                dtype=self.dtype,
+                axis_name=self.axis_name if not self.is_initializing() else None,
+                axis_index_groups=self.axis_index_groups,
+                use_fast_variance=self.use_fast_variance,
+                force_float32_reductions=self.force_float32_reductions,
+            )
+            if not self.is_initializing():
+                ra_mean.value = (
+                    self.momentum * ra_mean.value + (1 - self.momentum) * mean
+                )
+                ra_var.value = self.momentum * ra_var.value + (1 - self.momentum) * var
+        scale = self.param("scale", self.scale_init, feature_shape, self.param_dtype)
+        bias = self.param("bias", self.bias_init, feature_shape, self.param_dtype)
+        # the affine _normalize folds to, in its operation order: rsqrt
+        # first, then the scale multiply (association changes bits)
+        mul = lax.rsqrt(var + self.epsilon) * scale
+        bn_dtype = _flax_dtypes.canonicalize_dtype(x, scale, bias, dtype=self.dtype)
+        return fused_conv_epilogue(
+            x, mean, mul, bias, identity, relu=self.relu, bn_dtype=bn_dtype
+        )
+
+
+def bn_epilogue(
+    x: jnp.ndarray,
+    *,
+    train: bool,
+    axis_name=None,
+    zero_scale: bool = False,
+    identity: jnp.ndarray | None = None,
+    relu: bool = True,
+    name: str,
+    momentum: float = 0.9,
+    epsilon: float = 1e-5,
+) -> jnp.ndarray:
+    """The conv-epilogue: BN → (+``identity``) → ReLU, routed fused/unfused.
+
+    The unfused default is *literally* the pre-existing block code
+    (`batch_norm` + add + `nn.relu`) — zero semantic change when
+    `ops.epilogue.switch_epilogue` says off (the shipping default). Fused
+    (``DTPU_FUSED_EPILOGUE=1`` / ``MODEL.FUSED_EPILOGUE``) swaps in
+    :class:`EpilogueBatchNorm` under the same module ``name``, so the
+    variable tree — and therefore checkpoints, the torch converter, and
+    pretrained loading — is identical either way.
+    """
+    if not switch_epilogue():
+        y = batch_norm(
+            train=train,
+            axis_name=axis_name,
+            zero_scale=zero_scale,
+            name=name,
+            momentum=momentum,
+            epsilon=epsilon,
+        )(x)
+        if identity is not None:
+            y = y + identity
+        return nn.relu(y) if relu else y
+    return EpilogueBatchNorm(
+        use_running_average=not train,
+        momentum=momentum,
+        epsilon=epsilon,
+        dtype=_BN_COMPUTE_DTYPE,
+        param_dtype=jnp.float32,
+        axis_name=axis_name,
+        scale_init=nn.initializers.zeros if zero_scale else nn.initializers.ones,
+        relu=relu,
+        name=name,
+    )(x, identity)
 
 
 def classifier_head(x: jnp.ndarray, num_classes: int, *, name: str = "fc") -> jnp.ndarray:

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from distribuuuu_tpu.models.layers import (
     batch_norm,
+    bn_epilogue,
     classifier_head,
     conv,
     kaiming_normal_out,
@@ -48,21 +49,24 @@ class BasicBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        # each conv→BN(→residual)→ReLU boundary routes through bn_epilogue:
+        # the unfused default is the literal BN + add + relu sequence; the
+        # opt-in fused arm runs the Pallas conv-epilogue kernel (ops/epilogue.py)
         identity = x
         out = conv(self.planes, 3, self.stride, dtype=self.dtype, name="conv1")(x)
-        out = batch_norm(train=train, axis_name=self.bn_axis_name, name="bn1")(out)
-        out = nn.relu(out)
+        out = bn_epilogue(out, train=train, axis_name=self.bn_axis_name, name="bn1")
         out = conv(self.planes, 3, dtype=self.dtype, name="conv2")(out)
-        out = batch_norm(
-            train=train,
-            axis_name=self.bn_axis_name,
-            zero_scale=self.zero_init_residual,
-            name="bn2",
-        )(out)
         if self.downsample:
             identity = conv(self.planes, 1, self.stride, dtype=self.dtype, name="ds_conv")(x)
             identity = batch_norm(train=train, axis_name=self.bn_axis_name, name="ds_bn")(identity)
-        return nn.relu(out + identity)
+        return bn_epilogue(
+            out,
+            train=train,
+            axis_name=self.bn_axis_name,
+            zero_scale=self.zero_init_residual,
+            identity=identity,
+            name="bn2",
+        )
 
 
 class Bottleneck(nn.Module):
@@ -85,24 +89,23 @@ class Bottleneck(nn.Module):
         width = int(self.planes * (self.base_width / 64.0)) * self.groups
         identity = x
         out = conv(width, 1, dtype=self.dtype, name="conv1")(x)
-        out = batch_norm(train=train, axis_name=self.bn_axis_name, name="bn1")(out)
-        out = nn.relu(out)
+        out = bn_epilogue(out, train=train, axis_name=self.bn_axis_name, name="bn1")
         out = conv(width, 3, self.stride, groups=self.groups, dtype=self.dtype, name="conv2")(out)
-        out = batch_norm(train=train, axis_name=self.bn_axis_name, name="bn2")(out)
-        out = nn.relu(out)
+        out = bn_epilogue(out, train=train, axis_name=self.bn_axis_name, name="bn2")
         out = conv(self.planes * self.expansion, 1, dtype=self.dtype, name="conv3")(out)
-        out = batch_norm(
-            train=train,
-            axis_name=self.bn_axis_name,
-            zero_scale=self.zero_init_residual,
-            name="bn3",
-        )(out)
         if self.downsample:
             identity = conv(
                 self.planes * self.expansion, 1, self.stride, dtype=self.dtype, name="ds_conv"
             )(x)
             identity = batch_norm(train=train, axis_name=self.bn_axis_name, name="ds_bn")(identity)
-        return nn.relu(out + identity)
+        return bn_epilogue(
+            out,
+            train=train,
+            axis_name=self.bn_axis_name,
+            zero_scale=self.zero_init_residual,
+            identity=identity,
+            name="bn3",
+        )
 
 
 class S2DStemConv(nn.Module):
@@ -158,8 +161,7 @@ def resnet_stem(x, train, *, dtype, bn_axis_name, stem_s2d=False):
         x = S2DStemConv(dtype=dtype, name="conv1")(x)
     else:
         x = conv(64, 7, 2, padding=3, dtype=dtype, name="conv1")(x)
-    x = batch_norm(train=train, axis_name=bn_axis_name, name="bn1")(x)
-    x = nn.relu(x)
+    x = bn_epilogue(x, train=train, axis_name=bn_axis_name, name="bn1")
     return nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
 
 

@@ -369,6 +369,13 @@ SCHEMA: dict[str, tuple[dict[str, tuple], dict[str, tuple]]] = {
             "wall_s": _NUM,
         },
     ),
+    # quantization-aware fine-tune (quant/qat.py, QUANT.QAT): the trainer
+    # calibrated the fake-quant sites and every subsequent train/eval
+    # forward runs the straight-through-estimator interception
+    "qat": (
+        {"mode": _STR, "layers": _INT, "calib_batches": _INT},
+        {"distill": _NUM, "wall_s": _NUM, "im_size": _INT},
+    ),
     # tracing (dtpu-obs v2, obs/trace.py) ---------------------------------
     # one timed phase of a traced request or train window, keyed by the
     # trace id that ties the phases together: serve requests carry the

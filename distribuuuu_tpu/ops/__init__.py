@@ -5,6 +5,11 @@ from distribuuuu_tpu.ops.attention import (
     fused_attention_abs,
     xla_attention,
 )
+from distribuuuu_tpu.ops.epilogue import (
+    fused_conv_epilogue,
+    oracle_epilogue,
+    switch_epilogue,
+)
 from distribuuuu_tpu.ops.moe_kernel import (
     fused_moe_combine,
     fused_moe_dispatch,
@@ -14,6 +19,9 @@ __all__ = [
     "fused_attention",
     "fused_attention_abs",
     "xla_attention",
+    "fused_conv_epilogue",
     "fused_moe_combine",
     "fused_moe_dispatch",
+    "oracle_epilogue",
+    "switch_epilogue",
 ]

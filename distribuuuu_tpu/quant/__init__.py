@@ -1,12 +1,18 @@
-"""dtpu-quant: post-training int8 quantization for the serving path.
+"""dtpu-quant: low-precision serving and training for the zoo.
 
-Per-channel symmetric int8 weights (BatchNorm folded where possible),
-per-tensor activation scales from a calibration pass, and an
-int8×int8→int32 interception forward that jit-traces through the serving
+Serving (`quant.ptq`): per-channel symmetric int8 weights (BatchNorm folded
+where possible), per-tensor activation scales from a calibration pass, and
+an int8×int8→int32 interception forward that jit-traces through the serving
 engine's AOT ``lower().compile()`` ladder unchanged. Quality is gated, not
 assumed: `quant.gate` measures top-1 agreement and logit RMSE against the
 fp32 engine and a failing model refuses to serve (docs/SERVING.md,
 docs/PERFORMANCE.md).
+
+Training (`quant.qat`): int8/fp8 quantization-aware fine-tuning — the same
+calibration machinery driving a straight-through-estimator fake-quant
+forward in the trainer (``QUANT.QAT``), so a model that fails the PTQ serve
+gate can be rescued into a passing ``quant_quality`` verdict
+(docs/PERFORMANCE.md "Quantized training").
 """
 
 from distribuuuu_tpu.quant.gate import GateResult, compare_logits
@@ -18,13 +24,23 @@ from distribuuuu_tpu.quant.ptq import (
     quantize,
     quantize_weight,
 )
+from distribuuuu_tpu.quant.qat import (
+    QATModel,
+    calibrate_qat,
+    fake_quant_act,
+    fake_quant_weight,
+)
 
 __all__ = [
     "CalibrationSite",
     "GateResult",
     "Int8Model",
+    "QATModel",
     "calibrate",
+    "calibrate_qat",
     "compare_logits",
+    "fake_quant_act",
+    "fake_quant_weight",
     "prune_variables",
     "quantize",
     "quantize_weight",

@@ -72,6 +72,11 @@ class CalibrationSite:
     path: tuple
     amax: float
     out_dtype: Any = jnp.float32
+    # the site's OWN output dtype, never overwritten by a foldable BN's
+    # boundary dtype the way `out_dtype` is: the QAT fake-quant forward
+    # (quant/qat.py) keeps BNs as live ops, so it must emit what the conv
+    # emitted, not what the folded conv+BN pair would have
+    raw_out_dtype: Any = jnp.float32
     # conv statics (normalized for lax.conv_general_dilated)
     strides: tuple | None = None
     padding: Any = None
@@ -181,12 +186,18 @@ def calibrate(
                 out = next_fun(*args, **kwargs)
                 if site is not None and first:
                     site.out_dtype = out.dtype
+                    site.raw_out_dtype = out.dtype
                     produced[id(out)] = key
                     hold.append(out)
                 return out
             if (
                 first
                 and isinstance(mdl, nn.BatchNorm)
+                # an EpilogueBatchNorm (fused conv-epilogue routing,
+                # models/layers.py) is not a plain BN — its call also
+                # applies the residual/ReLU, so the fold substitution
+                # would drop them; the site stays a live op instead
+                and not getattr(mdl, "fused_epilogue", False)
                 and mdl.use_running_average
             ):
                 src = produced.get(id(args[0]))
@@ -273,6 +284,9 @@ def _verify_folds(variables, batch, sites, apply_fn) -> None:
         )
         for site in folded.values():
             site.bn = None
+            # the BN stays a live op, so the quantized conv must emit what
+            # the conv itself emitted, not the folded pair's boundary dtype
+            site.out_dtype = site.raw_out_dtype
 
 
 def quantize_weight(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:

@@ -1,0 +1,202 @@
+"""Quantization-aware training: int8/fp8 fake-quant with a straight-through
+estimator over the flax zoo.
+
+`ptq.py` quantizes a *finished* model; some models come out the other side
+of its quality gate and some do not — pre-activation families (densenet:
+BN→ReLU→conv, so almost nothing folds and every boundary carries full
+quantization noise) can fail the serve gate that resnet clears with 10×
+headroom. This module is the rescue path the refuse-to-serve error points
+at: a short fine-tune whose forward *simulates* the int8 (or fp8) grid so
+the weights move to quantization-robust minima, after which the unchanged
+PTQ path — calibrate → quantize → gate → AOT ladder — hosts the model.
+
+Mechanics, following the low-precision-training line (Micikevicius et al.
+2018 mixed precision; Micikevicius et al. 2022 FP8 formats):
+
+- **Fake-quant values** round onto the serving grid and come straight back
+  to fp: activations per-tensor on the scale PTQ calibration recorded
+  (``amax/127`` int8, ``amax/448`` fp8-e4m3), weights per-output-channel on
+  their live amax (re-derived every step — weights move during training;
+  the serve-time `quantize_weight` does the same fold at export).
+- **Straight-through estimator**: ``x + stop_gradient(q(x) − x)`` — the
+  forward sees the quantized value, the backward sees identity, so SGD
+  optimizes *through* the rounding (Bengio et al. 2013).
+- **Interception forward**: the same `flax.linen.intercept_methods` hook
+  PTQ uses — zero model-code changes — substituting each calibrated
+  conv/dense site with fake-quant-act × fake-quant-weight in f32
+  (``preferred_element_type`` pinned). BatchNorm, activations, pooling run
+  exactly as before; BNs stay live (training updates their stats), which
+  is function-equal to the fold PTQ applies at serve time because the BN
+  affine commutes with the fp dequant exactly.
+
+The trainer's ``QUANT.QAT`` mode (docs/PERFORMANCE.md "Quantized training")
+routes every train/eval forward through :meth:`QATModel.apply`, optionally
+adding a self-distillation term (``QUANT.QAT_DISTILL``) that regresses the
+fake-quant logits onto the model's own stop-gradient fp logits — the gate's
+logit-RMSE metric, optimized directly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax import lax
+
+from distribuuuu_tpu.quant.ptq import CalibrationSite, _key, calibrate
+
+QAT_MODES = ("int8", "fp8")
+
+# symmetric grid maxima: int8 uses ±127 (the PTQ grid — zero-point-free, so
+# conv zero padding stays exact); fp8 uses float8_e4m3fn's ±448 finite range
+_GRID_MAX = {"int8": 127.0, "fp8": 448.0}
+
+
+def _check_mode(mode: str) -> str:
+    if mode not in QAT_MODES:
+        raise ValueError(f"QAT mode must be one of {QAT_MODES}, got {mode!r}")
+    return mode
+
+
+def quantize_values(x32: jnp.ndarray, scale, mode: str) -> jnp.ndarray:
+    """Round ``x32/scale`` onto the mode's grid and return to fp32.
+
+    int8: round-to-nearest onto the integer lattice, clipped symmetric
+    (exactly `ptq.quantize_weight`'s grid). fp8: a cast round-trip through
+    ``float8_e4m3fn`` — the hardware rounding, not a model of it — with an
+    explicit clip at ±448 (e4m3fn has no inf; overflow must saturate, not
+    wrap through NaN).
+    """
+    if mode == "int8":
+        return jnp.clip(jnp.round(x32 / scale), -127.0, 127.0) * scale
+    q = jnp.clip(x32 / scale, -_GRID_MAX["fp8"], _GRID_MAX["fp8"])
+    return q.astype(jnp.float8_e4m3fn).astype(jnp.float32) * scale
+
+
+def _ste(x32: jnp.ndarray, q32: jnp.ndarray) -> jnp.ndarray:
+    """Straight-through estimator: forward ``q``, backward identity."""
+    return x32 + lax.stop_gradient(q32 - x32)
+
+
+def fake_quant_act(x: jnp.ndarray, act_scale: float, mode: str) -> jnp.ndarray:
+    """Per-tensor fake-quant on the calibrated activation scale, STE grad."""
+    x32 = x.astype(jnp.float32)
+    return _ste(x32, quantize_values(x32, act_scale, mode))
+
+
+def fake_quant_weight(w: jnp.ndarray, mode: str) -> jnp.ndarray:
+    """Per-output-channel fake-quant on the weight's live amax, STE grad.
+
+    The output channel is the trailing axis (flax HWIO conv / IO dense —
+    the `ptq.quantize_weight` convention). The scale is re-derived from the
+    current weights each call and stop-gradiented: the STE differentiates
+    through the rounding, not through the grid placement. All-zero channels
+    get scale 1 (finite; their quantized values are zero regardless).
+    """
+    w32 = w.astype(jnp.float32)
+    axes = tuple(range(w32.ndim - 1))
+    amax = jnp.max(jnp.abs(w32), axis=axes, keepdims=True)
+    scale = jnp.where(amax > 0.0, amax / _GRID_MAX[mode], 1.0)
+    scale = lax.stop_gradient(scale)
+    return _ste(w32, quantize_values(w32, scale, mode))
+
+
+@dataclass
+class QATModel:
+    """The static half of a fake-quantized model: site table + mode.
+
+    Built by :func:`calibrate_qat` from the same `ptq.calibrate` site table
+    the serving path uses, so the training-time grid and the serve-time
+    grid agree layer for layer. Closes over only static facts — the apply
+    is jit-traceable and reusable across steps.
+    """
+
+    sites: dict[str, CalibrationSite]
+    mode: str = "int8"
+
+    @property
+    def n_sites(self) -> int:
+        return len(self.sites)
+
+    def act_scale(self, site: CalibrationSite) -> float:
+        return max(site.amax, 1e-8) / _GRID_MAX[self.mode]
+
+    def _interceptor(self):
+        def interceptor(next_fun, args, kwargs, context):
+            mdl = context.module
+            if context.method_name != "__call__" or not mdl.path or not args:
+                return next_fun(*args, **kwargs)
+            site = self.sites.get(_key(mdl.path))
+            if site is None:
+                return next_fun(*args, **kwargs)
+            params = mdl.variables["params"]
+            w = fake_quant_weight(jnp.asarray(params["kernel"]), self.mode)
+            xq = fake_quant_act(args[0], self.act_scale(site), self.mode)
+            if site.kind == "conv":
+                acc = lax.conv_general_dilated(
+                    xq,
+                    w,
+                    window_strides=site.strides,
+                    padding=site.padding,
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    feature_group_count=site.groups,
+                    preferred_element_type=jnp.float32,
+                )
+            else:
+                acc = lax.dot_general(
+                    xq,
+                    w,
+                    (((xq.ndim - 1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            if "bias" in params:
+                acc = acc + jnp.asarray(params["bias"], jnp.float32)
+            return acc.astype(site.raw_out_dtype)
+
+        return interceptor
+
+    def apply(
+        self,
+        model: nn.Module,
+        variables: dict,
+        x: jnp.ndarray,
+        *,
+        train: bool = False,
+        mutable=False,
+        rngs=None,
+    ):
+        """The fake-quant forward: jit-traceable interception apply.
+
+        Mirrors ``model.apply`` — pass ``mutable=["batch_stats"]`` in train
+        mode and the BN stats update over the *fake-quant* activations, the
+        distribution the fine-tuned model will see at serve time.
+        """
+        kw: dict[str, Any] = {}
+        if rngs is not None:
+            kw["rngs"] = rngs
+        if mutable:
+            kw["mutable"] = mutable
+        with nn.intercept_methods(self._interceptor()):
+            return model.apply(variables, x, train=train, **kw)
+
+
+def calibrate_qat(
+    model: nn.Module,
+    variables: dict,
+    batches: Iterable[jnp.ndarray],
+    *,
+    mode: str = "int8",
+    apply_fn: Callable | None = None,
+) -> QATModel:
+    """PTQ calibration → a :class:`QATModel` on the same site table.
+
+    ``batches`` must be eager arrays (`ptq.calibrate`'s identity-adjacency
+    contract); the BN-fold facts it also discovers are simply unused here —
+    QAT keeps every BN live. The mode is validated before the calibration
+    forwards run — a typo'd grid fails in milliseconds, not after the pass.
+    """
+    mode = _check_mode(mode)
+    sites = calibrate(model, variables, batches, apply_fn=apply_fn)
+    return QATModel(sites=dict(sites), mode=mode)

@@ -391,7 +391,11 @@ class InferenceEngine:
                 f"(top-1 agree {result.top1_agree:.4f} < "
                 f"{qc['min_top1_agree']} or logit RMSE "
                 f"{result.logit_rmse:.4f} > {qc['max_logit_rmse']} vs the "
-                f"fp32 engine on {result.n} fixture inputs)"
+                f"fp32 engine on {result.n} fixture inputs). Remedy: a "
+                f"QUANT.QAT fine-tune (straight-through-estimator fake-quant "
+                f"training, docs/PERFORMANCE.md 'Quantized training') moves "
+                f"the weights to a quantization-robust minimum; re-serve the "
+                f"fine-tuned checkpoint with the same ':int8' spec"
             )
             if qc["gate"]:
                 raise RuntimeError(msg)

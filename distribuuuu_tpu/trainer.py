@@ -70,30 +70,43 @@ class TrainState:
 # Step functions (per-device views under shard_map)
 # ---------------------------------------------------------------------------
 
-def _forward_loss(model, params, batch_stats, batch, train: bool, rng):
+def _forward_loss(model, params, batch_stats, batch, train: bool, rng, qat=None):
     variables = {"params": params, "batch_stats": batch_stats}
     # u8 batches are normalized here on-device (fused into the first conv);
     # float inputs pass through for pre-normalized callers
     images = device_normalize(batch["image"])
+    rngs = {"dropout": rng} if rng is not None else None
+    # QUANT.QAT fine-tune (quant/qat.py): the forward runs the fake-quant
+    # straight-through-estimator interception instead of the plain apply —
+    # same variables, same BN/stats machinery, quantized-grid values
+    apply = model.apply if qat is None else functools.partial(qat.apply, model)
     if train:
-        logits, mutated = model.apply(
-            variables,
-            images,
-            train=True,
-            mutable=["batch_stats"],
-            rngs={"dropout": rng} if rng is not None else None,
+        logits, mutated = apply(
+            variables, images, train=True, mutable=["batch_stats"], rngs=rngs
         )
         new_stats = mutated["batch_stats"]
     else:
-        logits = model.apply(variables, images, train=False)
+        logits = apply(variables, images, train=False)
         new_stats = batch_stats
     loss = cross_entropy_loss(logits, batch["label"], cfg.TRAIN.LABEL_SMOOTH)
+    if qat is not None and train and cfg.QUANT.QAT_DISTILL > 0.0:
+        # self-distillation toward the model's own fp logits: the serve
+        # gate's logit-RMSE metric, optimized directly (the rescue knob —
+        # docs/PERFORMANCE.md "Quantized training"). stop_gradient on the
+        # target: the fp twin is the reference, not a second student.
+        fp_logits, _ = model.apply(
+            variables, images, train=True, mutable=["batch_stats"], rngs=rngs
+        )
+        drift = logits.astype(jnp.float32) - jax.lax.stop_gradient(
+            fp_logits.astype(jnp.float32)
+        )
+        loss = loss + cfg.QUANT.QAT_DISTILL * jnp.mean(drift**2)
     return loss, (logits, new_stats)
 
 
 def make_train_step(
     model, tx, mesh: Mesh, topk: int, accum_steps: int = 1,
-    nonfinite_guard: bool | None = None, state_specs=None,
+    nonfinite_guard: bool | None = None, state_specs=None, qat=None,
 ):
     """Build the jitted SPMD train step.
 
@@ -124,6 +137,12 @@ def make_train_step(
     docs/FAULT_TOLERANCE.md). The check pieces ride the pmean'd values, so
     every device takes the same branch, and a finite step's selected values
     are bit-identical to an unguarded step's.
+
+    ``qat`` (a `quant.QATModel`, default None): route the forward through
+    the fake-quant straight-through-estimator interception — the
+    ``QUANT.QAT`` fine-tune mode (quant/qat.py). The step's SPMD structure
+    (collectives, guard, donation) is identical; only the traced forward
+    changes.
     """
     if nonfinite_guard is None:
         nonfinite_guard = cfg.FAULT.NONFINITE_GUARD
@@ -150,7 +169,7 @@ def make_train_step(
                 # the tiled all-gather is a psum_scatter, so the grads this
                 # returns are already 1/N shards (summed over the fsdp axis)
                 p = fsdp.all_gather_params(p, param_specs)
-            return _forward_loss(model, p, batch_stats, micro, True, rng)
+            return _forward_loss(model, p, batch_stats, micro, True, rng, qat=qat)
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params
@@ -276,13 +295,16 @@ def make_train_step(
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def make_eval_step(model, mesh: Mesh, topk: int, state_specs=None):
+def make_eval_step(model, mesh: Mesh, topk: int, state_specs=None, qat=None):
     """Jitted SPMD eval step with weight-masked exact metrics (SURVEY §3.3).
 
     Takes and returns the running metric totals so accumulation happens
     *inside* the compiled step (one dispatch per batch). ``zero_metrics()``
     builds the initial totals. ``state_specs`` mirrors `make_train_step`:
     fsdp-sharded params are all-gathered per batch for the forward pass.
+    ``qat`` mirrors `make_train_step` too: under ``QUANT.QAT`` the eval
+    forward is fake-quantized, so validation accuracy measures what the
+    quantized serve path will deliver.
     """
     if fsdp.fsdp_size(mesh) > 1 and state_specs is None:
         raise ValueError(
@@ -296,7 +318,8 @@ def make_eval_step(model, mesh: Mesh, topk: int, state_specs=None):
         params = state.params
         if use_fsdp:
             params = fsdp.all_gather_params(params, state_specs.params)
-        logits = model.apply(
+        apply = model.apply if qat is None else functools.partial(qat.apply, model)
+        logits = apply(
             {"params": params, "batch_stats": state.batch_stats},
             device_normalize(batch["image"]),
             train=False,
@@ -445,6 +468,12 @@ def _build_cfg_model():
     if bn_dtype == "auto":
         bn_dtype = cfg.MODEL.DTYPE
     set_bn_compute_dtype(jnp.bfloat16 if bn_dtype == "bfloat16" else jnp.float32)
+    # fused conv-epilogue routing default (ops/epilogue.py): like the BN
+    # boundary dtype this is a process-global read at trace time, scoped to
+    # the run by _model_globals_scoped; DTPU_FUSED_EPILOGUE env overrides
+    from distribuuuu_tpu.ops.epilogue import set_fused_epilogue_default
+
+    set_fused_epilogue_default(cfg.MODEL.FUSED_EPILOGUE)
     # SYNCBN spans every batch-bearing axis: on a ('data', 'fsdp') mesh the
     # batch shards over both, so stats pmean over the pair — a pure-dp run
     # and an fsdp run of the same device count normalize identically
@@ -777,22 +806,99 @@ def _journal_state_bytes(state, mesh: Mesh) -> None:
         logger.warning(f"state-bytes snapshot failed: {exc!r}")
 
 
-def _bn_dtype_scoped(fn):
-    """Restore the process-global BN boundary dtype on return: a run with
-    MODEL.BN_DTYPE=bfloat16 must not silently change what a later *direct*
-    build_model() call in the same process traces with."""
+def _build_qat(model, state, mesh: Mesh):
+    """Calibrate the ``QUANT.QAT`` fake-quant sites on the run's weights.
+
+    Runs `quant.calibrate_qat` (the PTQ calibration pass) eagerly over
+    ``QUANT.CALIB_BATCHES`` seeded standard-normal batches — the
+    `convert.golden_inputs` family, i.e. post-normalization scale, matching
+    what `device_normalize`'d training batches look like — and journals a
+    typed ``qat`` record so the fine-tune's provenance (mode, site count,
+    distill weight) rides the run's telemetry.
+    """
+    import numpy as np
+
+    from distribuuuu_tpu import quant
+
+    try:
+        # the canonical validator (one source for the valid-grid rule);
+        # re-raised with the cfg knob named so the fix is obvious
+        quant.qat._check_mode(cfg.QUANT.QAT_MODE)
+    except ValueError as exc:
+        raise ValueError(f"QUANT.QAT_MODE: {exc}") from None
+    if fsdp.fsdp_size(mesh) > 1:
+        # calibration runs eager forwards on the committed params; fsdp
+        # shards would need a host-side all-gather first. QAT is a
+        # fine-tune mode — run it on a data-parallel mesh.
+        raise ValueError(
+            "QUANT.QAT requires MESH.FSDP 1: the calibration pass runs on "
+            "the unsharded weights (fine-tune the model data-parallel)"
+        )
+    tic = time.time()
+    rng = np.random.default_rng(cfg.QUANT.CALIB_SEED)
+    shape = (cfg.QUANT.CALIB_BATCH_SIZE, cfg.TRAIN.IM_SIZE, cfg.TRAIN.IM_SIZE, 3)
+    batches = [
+        jnp.asarray(rng.standard_normal(shape), jnp.float32)
+        for _ in range(cfg.QUANT.CALIB_BATCHES)
+    ]
+    def _host_local(a):
+        # eager calibration forwards refuse pod-global arrays (committed to
+        # a multi-host mesh they are not fully addressable per process);
+        # pure DP replicates params on every device, so the first
+        # addressable shard IS the full value — the fsdp refusal above
+        # guarantees no leaf is actually sharded
+        if hasattr(a, "addressable_data"):
+            return np.asarray(a.addressable_data(0))
+        return np.asarray(a)
+
+    variables = jax.tree.map(
+        _host_local, {"params": state.params, "batch_stats": state.batch_stats}
+    )
+    qat_model = quant.calibrate_qat(
+        model, variables, batches, mode=cfg.QUANT.QAT_MODE
+    )
+    wall = time.time() - tic
+    obs.current().event(
+        "qat",
+        mode=cfg.QUANT.QAT_MODE,
+        layers=qat_model.n_sites,
+        calib_batches=cfg.QUANT.CALIB_BATCHES,
+        distill=float(cfg.QUANT.QAT_DISTILL),
+        wall_s=round(wall, 3),
+        im_size=cfg.TRAIN.IM_SIZE,
+    )
+    logger.info(
+        f"QUANT.QAT: {cfg.QUANT.QAT_MODE} fake-quant fine-tune over "
+        f"{qat_model.n_sites} conv/dense site(s) (calibrated in {wall:.2f}s, "
+        f"distill weight {cfg.QUANT.QAT_DISTILL})"
+    )
+    return qat_model
+
+
+def _model_globals_scoped(fn):
+    """Restore the process-global model-trace knobs on return: a run with
+    MODEL.BN_DTYPE=bfloat16 or MODEL.FUSED_EPILOGUE=True must not silently
+    change what a later *direct* build_model() call in the same process
+    traces with."""
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
         from distribuuuu_tpu.models import layers
+        from distribuuuu_tpu.ops import epilogue
 
         prev = layers.get_bn_compute_dtype()
+        prev_fused = epilogue.get_fused_epilogue_default()
         try:
             return fn(*args, **kwargs)
         finally:
             layers.set_bn_compute_dtype(prev)
+            epilogue.set_fused_epilogue_default(prev_fused)
 
     return wrapper
+
+
+# back-compat alias (tests decorate helpers with it)
+_bn_dtype_scoped = _model_globals_scoped
 
 
 @functools.lru_cache(maxsize=None)
@@ -834,7 +940,7 @@ def _recommit_state(state: TrainState, mesh: Mesh) -> TrainState:
     return _recommit_fn(mesh, treedef, tuple(leaves))(state)
 
 
-@_bn_dtype_scoped
+@_model_globals_scoped
 def train_model():
     """Full training run (reference `trainer.py:106-173`).
 
@@ -919,11 +1025,6 @@ def train_model():
 
     train_loader = construct_train_loader(mesh)
     val_loader = construct_val_loader(mesh)
-    train_step = make_train_step(
-        model, tx, mesh, cfg.TRAIN.TOPK, accum_steps=cfg.TRAIN.ACCUM_STEPS,
-        state_specs=state_specs,
-    )
-    eval_step = make_eval_step(model, mesh, cfg.TRAIN.TOPK, state_specs=state_specs)
 
     start_epoch, start_step, best_acc1 = 0, 0, 0.0
     resumed = False
@@ -975,6 +1076,19 @@ def train_model():
         logger.info(f"Initialized from pretrained weights ({cfg.MODEL.ARCH})")
     if resumed:
         state = _recommit_state(state, mesh)
+
+    # steps are built AFTER resume/warm-start on purpose: the QAT fine-tune
+    # mode calibrates its fake-quant scales on the weights the run will
+    # actually train (a rescue fine-tune starts from the failing model's
+    # checkpoint, not from a fresh init)
+    qat_model = _build_qat(model, state, mesh) if cfg.QUANT.QAT else None
+    train_step = make_train_step(
+        model, tx, mesh, cfg.TRAIN.TOPK, accum_steps=cfg.TRAIN.ACCUM_STEPS,
+        state_specs=state_specs, qat=qat_model,
+    )
+    eval_step = make_eval_step(
+        model, mesh, cfg.TRAIN.TOPK, state_specs=state_specs, qat=qat_model
+    )
 
     run_tic = time.time()
     # distributed watchdog: armed for the whole epoch loop (train + eval
@@ -1046,7 +1160,7 @@ def train_model():
     return state, best_acc1
 
 
-@_bn_dtype_scoped
+@_model_globals_scoped
 def test_model():
     """Evaluation run (reference `trainer.py:176-209`)."""
     configure_determinism(cfg.CUDNN.DETERMINISTIC)
@@ -1068,5 +1182,11 @@ def test_model():
         state, _, _ = ckpt.load_checkpoint(_pretrained_path(), state, load_opt=False)
         logger.info(f"Loaded pretrained weights ({cfg.MODEL.ARCH})")
     val_loader = construct_val_loader(mesh)
-    eval_step = make_eval_step(model, mesh, cfg.TRAIN.TOPK, state_specs=state_specs)
+    # a QUANT.QAT config evaluates the fake-quant forward here too —
+    # standalone eval must measure what the quantized serve path delivers,
+    # not the fp twin (calibrated on the weights just loaded)
+    qat_model = _build_qat(model, state, mesh) if cfg.QUANT.QAT else None
+    eval_step = make_eval_step(
+        model, mesh, cfg.TRAIN.TOPK, state_specs=state_specs, qat=qat_model
+    )
     return validate(val_loader, mesh, eval_step, state, info.is_primary)

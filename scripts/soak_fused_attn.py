@@ -14,6 +14,13 @@ numerics plus the dispatch/combine microbench that is the flip/keep
 signal for DTPU_FUSED_MOE. Off-TPU the kernels run in the Pallas
 interpreter: numerics still hold (the CI kernels-smoke job asserts this
 runs), timings are meaningless there.
+
+``--epilogue`` soaks the fused conv-epilogue kernels (ops/epilogue.py)
+against the unfused BN→(+residual)→ReLU formulation at resnet50
+hot-block shapes — fwd + grad numerics plus the fwd+bwd microbench that
+is the flip/keep signal for DTPU_FUSED_EPILOGUE / MODEL.FUSED_EPILOGUE.
+Same interpreter caveat off-TPU; the docs/PERFORMANCE.md attention row
+is the reason every kernel measures before any default flips.
 """
 
 import argparse
@@ -227,11 +234,105 @@ def main_moe():
     sys.exit(0 if ok else 1)
 
 
+def main_epilogue():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distribuuuu_tpu.ops.epilogue import fused_conv_epilogue, oracle_epilogue
+
+    print(f"devices: {jax.devices()}", flush=True)
+    interpret = jax.devices()[0].platform != "tpu"
+    if interpret:
+        print("(no TPU: Pallas interpreter — numerics only, ignore timings)", flush=True)
+    rng = np.random.default_rng(0)
+    # resnet50 stage-3 hot-block epilogue at batch 64: the conv output is
+    # bf16, the BN boundary bf16 (the shipped-best recipe), residual in the
+    # boundary dtype — [64·14·14, 1024] rows×channels per pass
+    B, H, C = 64, 14, 1024
+    bn_dtype = jnp.bfloat16
+    x = jnp.asarray(rng.standard_normal((B, H, H, C)) * 0.5, jnp.bfloat16)
+    identity = jnp.asarray(rng.standard_normal((B, H, H, C)), bn_dtype)
+    mean = jnp.asarray(rng.standard_normal(C), jnp.float32)
+    var = jnp.asarray(np.abs(rng.standard_normal(C)) + 0.1, jnp.float32)
+    scale = jnp.asarray(rng.standard_normal(C), jnp.float32)
+    bias = jnp.asarray(rng.standard_normal(C), jnp.float32)
+    mul = jax.lax.rsqrt(var + 1e-5) * scale
+
+    def fused(x_, me, mu, bi, id_):
+        return fused_conv_epilogue(
+            x_, me, mu, bi, id_, relu=True, bn_dtype=bn_dtype, interpret=interpret
+        )
+
+    def unfused(x_, me, mu, bi, id_):
+        return oracle_epilogue(x_, me, mu, bi, id_, relu=True, bn_dtype=bn_dtype)
+
+    # jitted callables bound ONCE up front (not jit-then-call per use): the
+    # compile cache stays keyed on stable function objects — dtpu-lint DT003
+    jit_fused = jax.jit(fused)
+    jit_unfused = jax.jit(unfused)
+
+    # 1) forward parity (tolerance = XLA's FMA liberty at bf16 output scale)
+    out_f = jax.device_get(jit_fused(x, mean, mul, bias, identity))
+    out_u = jax.device_get(jit_unfused(x, mean, mul, bias, identity))
+    fwd_diff = float(np.max(np.abs(out_f.astype(np.float32) - out_u.astype(np.float32))))
+    print(f"fwd max|diff| = {fwd_diff:.5f} (bf16 boundary tolerance ~0.05)", flush=True)
+
+    # 2) gradient parity through the custom VJP (the oracle recompute)
+    def loss(fn):
+        return lambda *a: jnp.sum(fn(*a).astype(jnp.float32) ** 2)
+
+    grad_fused = jax.jit(jax.grad(loss(fused), argnums=(0, 1, 2, 3, 4)))
+    grad_unfused = jax.jit(jax.grad(loss(unfused), argnums=(0, 1, 2, 3, 4)))
+    gf = jax.device_get(grad_fused(x, mean, mul, bias, identity))
+    gu = jax.device_get(grad_unfused(x, mean, mul, bias, identity))
+    grad_diff = max(
+        float(np.max(np.abs(a.astype(np.float32) - b.astype(np.float32))))
+        for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gu))
+    )
+    print(f"grad max|diff| = {grad_diff:.5f}", flush=True)
+
+    # 3) microbench: the epilogue fwd+bwd both ways — the unfused arm is
+    # what XLA's own fusion emitter does with the BN/add/relu edges today,
+    # so >1x here is the flip signal for the HBM-round-trip argument
+    ms = {}
+    for name, f in [
+        ("fused", jax.jit(jax.grad(loss(fused)))),
+        ("unfused", jax.jit(jax.grad(loss(unfused)))),
+    ]:
+        jax.device_get(f(x, mean, mul, bias, identity))
+        t0 = time.perf_counter()
+        for _ in range(10):
+            jax.device_get(f(x, mean, mul, bias, identity))
+        ms[name] = (time.perf_counter() - t0) / 10 * 1000
+        print(f"{name} epilogue (fwd+bwd): {ms[name]:.2f} ms", flush=True)
+    print(
+        f"epilogue speedup: {ms['unfused'] / ms['fused']:.3f}x (>1 = fused wins"
+        f"{'; interpreter — not meaningful' if interpret else ''})",
+        flush=True,
+    )
+
+    ok = fwd_diff < 0.05 and grad_diff < 1.0
+    print("SOAK", "PASS (numerics hold; see the speedup line for the "
+          "flip/keep verdict)" if ok else "FAIL", flush=True)
+    sys.exit(0 if ok else 1)
+
+
 if __name__ == "__main__":
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument(
+    which = parser.add_mutually_exclusive_group()
+    which.add_argument(
         "--moe", action="store_true",
         help="soak the fused MoE dispatch/combine kernels instead of attention",
     )
+    which.add_argument(
+        "--epilogue", action="store_true",
+        help="soak the fused conv-epilogue kernels instead of attention",
+    )
     args = parser.parse_args()
-    main_moe() if args.moe else main()
+    if args.moe:
+        main_moe()
+    elif args.epilogue:
+        main_epilogue()
+    else:
+        main()

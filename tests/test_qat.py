@@ -1,0 +1,429 @@
+"""quant/qat.py: STE fake-quant training + the PTQ serve-gate rescue.
+
+Tiers:
+
+- **units** — grid roundtrip bounds (int8 lattice, fp8-e4m3 cast
+  round-trip + saturation), STE gradient identity, per-channel weight
+  scale isolation, mode validation.
+- **fidelity** — the QAT fake-quant forward tracks the TRUE int8 serving
+  forward (`ptq.Int8Model`) to float-accumulation noise on the
+  golden-fixture resnet18 — the training-time grid IS the serve-time grid.
+- **trainer wiring** — `make_train_step(qat=...)` runs the STE forward
+  under the full SPMD step (donation, nonfinite guard, metrics) and the
+  ``QUANT.QAT_DISTILL`` term traces.
+- **gate rescue (the acceptance chain)** — a densenet-style pre-activation
+  model fails the PTQ serve gate at seed through `serve/engine.py`'s
+  ``:int8`` path (refusal names the QUANT.QAT remedy); a short STE
+  self-distillation fine-tune measurably improves the gate metrics; the
+  fine-tuned weights re-hosted ``:int8`` pass the gate end-to-end with
+  zero steady-state compiles.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distribuuuu_tpu.models.densenet import DenseNet
+from distribuuuu_tpu.models.registry import register_model
+from distribuuuu_tpu.quant import (
+    QATModel,
+    calibrate,
+    calibrate_qat,
+    compare_logits,
+    fake_quant_act,
+    fake_quant_weight,
+    quantize,
+)
+from distribuuuu_tpu.quant.qat import quantize_values
+
+IM, NC = 24, 8
+RESCUE_SEED = 3
+
+
+# the engine hosts registry archs only: register the rescue model once —
+# a DenseNet-BC small enough for tier-1, i.e. "densenet-style": the
+# pre-activation BN→ReLU→conv ordering whose BNs mostly don't fold, the
+# family that motivates the QAT rescue (docs/PERFORMANCE.md)
+@register_model("qat_tiny_densenet")
+def _qat_tiny_densenet(**kw):
+    return DenseNet(
+        growth_rate=8, block_config=(2, 2), num_init_features=16, **kw
+    )
+
+
+def _rescue_variables():
+    model = DenseNet(
+        growth_rate=8, block_config=(2, 2), num_init_features=16,
+        num_classes=NC, dtype=jnp.float32,
+    )
+    v = model.init(
+        jax.random.PRNGKey(RESCUE_SEED), jnp.zeros((1, IM, IM, 3)), train=False
+    )
+    return model, {"params": v["params"], "batch_stats": v["batch_stats"]}
+
+
+def _calib_batches(n=2, batch=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        jnp.asarray(rng.standard_normal((batch, IM, IM, 3)), jnp.float32)
+        for _ in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# units
+# ---------------------------------------------------------------------------
+
+def test_quantize_values_int8_grid_roundtrip_and_clip():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal(256), jnp.float32)
+    s = 0.05
+    q = np.asarray(quantize_values(x, s, "int8"))
+    assert np.all(np.abs(q - np.asarray(x)) <= s / 2 + 1e-7)  # in-range bound
+    assert np.all(np.isin(np.round(q / s), np.arange(-127, 128)))
+    big = jnp.asarray([100.0, -100.0], jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(quantize_values(big, s, "int8")), [127 * s, -127 * s]
+    )
+
+
+def test_quantize_values_fp8_roundtrip_and_saturation():
+    # exactly-representable e4m3 values survive the round trip untouched
+    exact = jnp.asarray([0.0, 1.0, -1.5, 0.25, 448.0, -448.0], jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(quantize_values(exact, 1.0, "fp8")), np.asarray(exact)
+    )
+    # overflow saturates to ±448·scale (e4m3fn has no inf to wrap through)
+    over = jnp.asarray([1e6, -1e6], jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(quantize_values(over, 0.5, "fp8")), [224.0, -224.0]
+    )
+    # fp8 is a coarser grid than int8 at full range: error bounded by the
+    # e4m3 relative step (2^-3) at the value's scale
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal(512), jnp.float32)
+    q = np.asarray(quantize_values(x, 1.0, "fp8"))
+    err = np.abs(q - np.asarray(x))
+    assert np.all(err <= np.maximum(np.abs(np.asarray(x)) * 2.0**-3, 2.0**-9))
+
+
+def test_ste_gradients_are_identity():
+    """The straight-through estimator: forward quantized, backward 1."""
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal(64), jnp.float32)
+    g = jax.grad(lambda a: jnp.sum(fake_quant_act(a, 0.1, "int8")))(x)
+    np.testing.assert_array_equal(np.asarray(g), np.ones(64, np.float32))
+    w = jnp.asarray(rng.standard_normal((3, 3, 4, 8)), jnp.float32)
+    gw = jax.grad(lambda a: jnp.sum(fake_quant_weight(a, "int8")))(w)
+    np.testing.assert_array_equal(np.asarray(gw), np.ones_like(np.asarray(w)))
+
+
+def test_fake_quant_weight_per_channel_isolation():
+    rng = np.random.default_rng(3)
+    w = rng.standard_normal((3, 3, 4, 8)).astype(np.float32)
+    w[..., 5] *= 50.0  # a wild channel must not coarsen the others' grid
+    q = np.asarray(fake_quant_weight(jnp.asarray(w), "int8"))
+    scales = np.abs(w).reshape(-1, 8).max(axis=0) / 127.0
+    err = np.abs(q - w)
+    for ch in range(8):
+        assert np.all(err[..., ch] <= scales[ch] / 2 + 1e-7)
+
+
+def test_invalid_mode_raises():
+    model, variables = _rescue_variables()
+    with pytest.raises(ValueError, match="int8.*fp8"):
+        calibrate_qat(model, variables, _calib_batches(1), mode="int4")
+
+
+# ---------------------------------------------------------------------------
+# fidelity: fake-quant training forward == int8 serving forward
+# ---------------------------------------------------------------------------
+
+def test_qat_forward_tracks_true_int8_path():
+    """The STE forward simulates the serving grid: on the golden-fixture
+    resnet18 the fake-quant logits match `Int8Model`'s true int8×int8→int32
+    logits to accumulation noise — orders of magnitude under the PTQ error
+    itself, so what QAT optimizes is what serving executes."""
+    from distribuuuu_tpu.convert import golden_inputs, synthetic_variables
+    from distribuuuu_tpu.models import build_model
+
+    model = build_model("resnet18", num_classes=NC, dtype=jnp.float32)
+    v = synthetic_variables("resnet18", 7, 32, NC)
+    variables = {"params": v["params"], "batch_stats": v["batch_stats"]}
+    rng = np.random.default_rng(1234)
+    batches = [
+        jnp.asarray(rng.standard_normal((4, 32, 32, 3)), jnp.float32)
+        for _ in range(2)
+    ]
+    sites = calibrate(model, variables, batches)
+    qat = QATModel(sites=dict(sites), mode="int8")
+    qmodel, qparams = quantize(variables, sites)
+    x = jnp.asarray(golden_inputs(8, 32, 0))
+    q_true = np.asarray(qmodel.apply(model, variables, qparams, x))
+    fake_fwd = jax.jit(lambda v_, x_: qat.apply(model, v_, x_))
+    q_fake = np.asarray(fake_fwd(variables, x))
+    fp = np.asarray(model.apply(variables, x, train=False))
+    fake_vs_true = float(np.sqrt(np.mean((q_fake - q_true) ** 2)))
+    ptq_err = float(np.sqrt(np.mean((q_true - fp) ** 2)))
+    assert fake_vs_true < 1e-4, (fake_vs_true, ptq_err)
+    assert fake_vs_true < ptq_err / 100
+
+
+def test_qat_train_mode_updates_stats_on_fake_quant_activations():
+    model, variables = _rescue_variables()
+    qat = calibrate_qat(model, variables, _calib_batches(1))
+    x = _calib_batches(1, batch=2, seed=9)[0]
+    out, mut = qat.apply(model, variables, x, train=True, mutable=["batch_stats"])
+    assert out.shape == (2, NC)
+    changed = [
+        float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+        for a, b in zip(
+            jax.tree.leaves(mut["batch_stats"]),
+            jax.tree.leaves(variables["batch_stats"]),
+        )
+    ]
+    assert max(changed) > 0.0  # train mode EMA'd the stats
+
+
+# ---------------------------------------------------------------------------
+# trainer wiring
+# ---------------------------------------------------------------------------
+
+def test_make_train_step_runs_qat_forward(fresh_cfg):
+    """The SPMD step with qat=: donation, guard and metrics all intact,
+    and the distill term traces (QUANT.QAT_DISTILL > 0)."""
+    from distribuuuu_tpu import optim, trainer
+    from distribuuuu_tpu.runtime import data_mesh
+
+    fresh_cfg.QUANT.QAT = True
+    fresh_cfg.QUANT.QAT_DISTILL = 1.0
+    fresh_cfg.OPTIM.WEIGHT_DECAY = 0.0
+    model, variables = _rescue_variables()
+    qat = calibrate_qat(model, variables, _calib_batches(1))
+    mesh = data_mesh(2)
+    tx = optim.construct_optimizer()
+    state = jax.device_put(
+        trainer.TrainState(
+            params=variables["params"],
+            batch_stats=variables["batch_stats"],
+            opt_state=tx.init(variables["params"]),
+        )
+    )
+    step = trainer.make_train_step(model, tx, mesh, topk=5, qat=qat)
+    # REAL copies: device_get on XLA:CPU returns zero-copy views, and the
+    # donated step overwrites that very memory with the updated params —
+    # an un-copied "before" would silently equal "after"
+    before = jax.tree.map(np.copy, jax.device_get(variables["params"]))
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": jnp.asarray(rng.standard_normal((4, IM, IM, 3)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, NC, 4), jnp.int32),
+    }
+    state2, metrics = step(state, batch, jnp.float32(0.01), jax.random.PRNGKey(0))
+    metrics = jax.device_get(metrics)
+    assert np.isfinite(metrics["loss_sum"]) and metrics["n"] == 4.0
+    assert metrics["skipped"] == 0.0
+    after = jax.device_get(state2.params)
+    moved = any(
+        float(np.max(np.abs(np.asarray(a) - np.asarray(b)))) > 0
+        for a, b in zip(jax.tree.leaves(after), jax.tree.leaves(before))
+    )
+    assert moved, [float(np.max(np.abs(np.asarray(a) - np.asarray(b)))) for a, b in zip(jax.tree.leaves(after), jax.tree.leaves(before))][:8]
+
+
+def test_build_qat_journals_and_validates(fresh_cfg):
+    from distribuuuu_tpu import obs, trainer
+    from distribuuuu_tpu.obs.journal import validate_record
+    from distribuuuu_tpu.runtime import data_mesh
+
+    fresh_cfg.QUANT.QAT = True
+    fresh_cfg.QUANT.QAT_MODE = "fp8"
+    fresh_cfg.QUANT.CALIB_BATCHES = 1
+    fresh_cfg.QUANT.CALIB_BATCH_SIZE = 2
+    fresh_cfg.TRAIN.IM_SIZE = IM
+    model, variables = _rescue_variables()
+    state = trainer.TrainState(
+        params=variables["params"],
+        batch_stats=variables["batch_stats"],
+        opt_state=(),
+    )
+    events = []
+    tel = obs.current()
+    orig = tel.event
+    tel.event = lambda kind, **f: events.append({"kind": kind, "ts": time.time(), **f})
+    try:
+        qat = trainer._build_qat(model, state, data_mesh(2))
+    finally:
+        tel.event = orig
+    assert qat.mode == "fp8" and qat.n_sites > 0
+    (rec,) = [e for e in events if e["kind"] == "qat"]
+    assert rec["mode"] == "fp8" and rec["layers"] == qat.n_sites
+    assert validate_record(rec) == [], rec
+
+
+def test_build_qat_refuses_fsdp_and_bad_mode(fresh_cfg):
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.runtime import data_mesh
+
+    model, variables = _rescue_variables()
+    state = trainer.TrainState(
+        params=variables["params"], batch_stats=variables["batch_stats"], opt_state=()
+    )
+    fresh_cfg.QUANT.QAT_MODE = "int4"
+    with pytest.raises(ValueError, match="QUANT.QAT_MODE"):
+        trainer._build_qat(model, state, data_mesh(2))
+    fresh_cfg.QUANT.QAT_MODE = "int8"
+    fresh_cfg.MESH.FSDP = 2
+    with pytest.raises(ValueError, match="MESH.FSDP"):
+        trainer._build_qat(model, state, data_mesh(2, 2))
+
+
+# ---------------------------------------------------------------------------
+# the gate rescue, end to end through the serving engine
+# ---------------------------------------------------------------------------
+
+def _save_weights(path, variables):
+    import orbax.checkpoint as ocp
+
+    from distribuuuu_tpu import checkpoint as ckpt
+
+    ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).save(
+        os.path.abspath(str(path)),
+        {"params": variables["params"], "batch_stats": variables["batch_stats"]},
+        force=True,
+    )
+    ckpt.write_manifest(str(path))
+    return str(path)
+
+
+def _engine(journal_events):
+    from distribuuuu_tpu.runtime import data_mesh
+    from distribuuuu_tpu.serve.engine import InferenceEngine
+
+    def sink(kind, **fields):
+        journal_events.append({"kind": kind, "ts": time.time(), **fields})
+
+    return InferenceEngine(
+        data_mesh(-1),
+        batch_sizes=[1, 4],
+        im_size=IM,
+        num_classes=NC,
+        input_dtype="float32",
+        compute_dtype="float32",
+        journal_event=sink,
+        quant_cfg={"calib_batches": 2, "calib_batch_size": 4, "gate_n": 16},
+    )
+
+
+@pytest.fixture(scope="module")
+def rescued(tmp_path_factory):
+    """Seed weights + QAT-fine-tuned weights for the tiny densenet, with
+    the measured gate metrics at each stage."""
+    tmp = tmp_path_factory.mktemp("qat_rescue")
+    model, variables = _rescue_variables()
+    calib = _calib_batches(2, 4)
+
+    def gate_of(vv):
+        sites = calibrate(model, vv, calib)
+        qmodel, qparams = quantize(vv, sites)
+        x = jnp.asarray(
+            np.random.default_rng(42).standard_normal((16, IM, IM, 3)), jnp.float32
+        )
+        fp = np.asarray(model.apply(vv, x, train=False))
+        q = np.asarray(qmodel.apply(model, vv, qparams, x))
+        return compare_logits(fp, q, min_top1_agree=0.99, max_logit_rmse=0.25)
+
+    seed_gate = gate_of(variables)
+
+    # the rescue: a short STE self-distillation fine-tune (the
+    # QUANT.QAT_DISTILL objective — regress the fake-quant logits onto the
+    # model's own stop-gradient fp logits)
+    qat = calibrate_qat(model, variables, calib)
+
+    def loss_fn(p, stats, x):
+        varp = {"params": p, "batch_stats": stats}
+        ql, mut = qat.apply(model, varp, x, train=True, mutable=["batch_stats"])
+        fl, _ = model.apply(varp, x, train=True, mutable=["batch_stats"])
+        drift = ql.astype(jnp.float32) - jax.lax.stop_gradient(fl.astype(jnp.float32))
+        return jnp.mean(drift**2), mut["batch_stats"]
+
+    @jax.jit
+    def step(p, stats, x):
+        (_, new_stats), g = jax.value_and_grad(loss_fn, has_aux=True)(p, stats, x)
+        return jax.tree.map(lambda a, b: a - 0.05 * b, p, g), new_stats
+
+    rng = np.random.default_rng(7)
+    p, stats = variables["params"], variables["batch_stats"]
+    for _ in range(40):
+        x = jnp.asarray(rng.standard_normal((8, IM, IM, 3)), jnp.float32)
+        p, stats = step(p, stats, x)
+    tuned = {"params": p, "batch_stats": stats}
+    tuned_gate = gate_of(tuned)
+
+    return {
+        "seed_weights": _save_weights(tmp / "seed", variables),
+        "tuned_weights": _save_weights(tmp / "tuned", tuned),
+        "seed_gate": seed_gate,
+        "tuned_gate": tuned_gate,
+    }
+
+
+def test_seed_model_fails_gate_and_qat_measurably_improves_it(rescued):
+    """The QAT smoke of the satellite list: the pre-activation model fails
+    the default-threshold gate at seed, and the short STE fine-tune
+    measurably improves BOTH gate metrics."""
+    seed, tuned = rescued["seed_gate"], rescued["tuned_gate"]
+    assert not seed.passed, seed
+    assert tuned.passed, tuned
+    assert tuned.top1_agree > seed.top1_agree
+    assert tuned.logit_rmse < seed.logit_rmse
+
+
+def test_engine_refuses_seed_model_and_names_the_remedy(rescued):
+    from distribuuuu_tpu.serve.engine import parse_model_specs
+
+    events = []
+    engine = _engine(events)
+    spec = parse_model_specs(
+        [f"dn=qat_tiny_densenet@{rescued['seed_weights']}:int8"]
+    )[0]
+    with pytest.raises(RuntimeError, match="QUANT.QAT") as exc:
+        engine.load(spec)
+    assert "refusing to serve" in str(exc.value)
+    (qq,) = [e for e in events if e["kind"] == "quant_quality"]
+    assert qq["passed"] is False  # the failed measurement is still journaled
+
+
+def test_engine_serves_rescued_model_with_zero_recompiles(rescued):
+    """The acceptance chain: the QAT-fine-tuned checkpoint hosts ':int8'
+    through the unchanged gate/fixture/AOT-ladder plumbing — gate passes,
+    quant_quality journaled, zero steady-state compiles."""
+    from distribuuuu_tpu.analysis.guards import CompileGuard
+    from distribuuuu_tpu.obs.journal import validate_record
+    from distribuuuu_tpu.serve.engine import parse_model_specs
+
+    events = []
+    engine = _engine(events)
+    spec = parse_model_specs(
+        [f"dn=qat_tiny_densenet@{rescued['tuned_weights']}:int8"]
+    )[0]
+    engine.load(spec)
+    hosted = engine.models["dn"]
+    assert hosted.gate is not None and hosted.gate.passed
+    (qq,) = [e for e in events if e["kind"] == "quant_quality"]
+    assert qq["passed"] is True and qq["mode"] == "int8"
+    for e in events:
+        assert validate_record(e) == [], e
+    engine.warmup()
+    rng = np.random.default_rng(0)
+    with CompileGuard(exact=0, name="rescued int8 steady state") as guard:
+        for n in (1, 4, 1, 4):
+            x = rng.standard_normal((n, IM, IM, 3)).astype(np.float32)
+            assert engine.forward("dn", x).shape == (n, NC)
+    assert guard.compiles == 0

@@ -162,6 +162,9 @@ def test_fold_rejected_when_pre_bn_value_is_tapped():
     sites = calibrate(model, variables, [batch])
     # adjacency says foldable, the numeric fold check says NO
     assert sites["conv"].bn is None, "unsound fold was not rejected"
+    # rejection restores the conv's OWN output dtype: the BN stays a live
+    # op, so the quantized conv must emit what the conv emitted
+    assert sites["conv"].out_dtype == sites["conv"].raw_out_dtype
     qmodel, qparams = quantize(variables, sites)
     assert qmodel.folded == frozenset()
     # and the quantized model (BN left as an fp op) still tracks fp
@@ -380,11 +383,83 @@ def test_failing_gate_refuses_to_serve(tmp_path):
         },
     )
     spec = parse_model_specs([f"rn8=resnet18@{weights}:int8"])[0]
-    with pytest.raises(RuntimeError, match="refusing to serve"):
+    with pytest.raises(RuntimeError, match="refusing to serve") as exc:
         engine.load(spec)
+    # the refusal names its remedy: the QUANT.QAT fine-tune (quant/qat.py)
+    assert "QUANT.QAT" in str(exc.value)
     assert "rn8" not in engine.models
     (qq,) = [e for e in events if e["kind"] == "quant_quality"]
     assert qq["passed"] is False  # the failed measurement is still journaled
+
+
+def test_densenet_calibration_folds_only_post_conv_bns():
+    """The calibration fact that motivates the QAT rescue, pinned.
+
+    DenseNet-BC is *pre-activation*: every dense-layer input BN (norm1),
+    every transition BN and the final BN consume a concat/relu output, so
+    they can never fold into a conv's dequant — only the stem's norm0 and
+    each layer's mid-layer norm2 (which directly consumes conv1) fold.
+    The unfolded majority leaves full quantization noise at every block
+    boundary; measured on densenet121 @32px synthetic init the PTQ path
+    fails the serve gate outright (logit RMSE ~52 vs the 0.25 threshold)
+    while resnet18 passes with 10× headroom — hence `quant/qat.py`.
+    (Scaled-down config here for tier-1 wall clock; the fold structure is
+    per-layer, so it transfers to the full 121 exactly.)
+    """
+    from distribuuuu_tpu.models.densenet import DenseNet
+
+    model = DenseNet(
+        growth_rate=8, block_config=(2, 2), num_init_features=16,
+        num_classes=NC, dtype=jnp.float32,
+    )
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, IM, IM, 3)), train=False
+    )
+    rng = np.random.default_rng(0)
+    batch = jnp.asarray(rng.standard_normal((2, IM, IM, 3)), jnp.float32)
+    sites = calibrate(model, dict(variables), [batch])
+    qmodel, _ = quantize(dict(variables), sites)
+    assert qmodel.folded == {
+        "norm0",
+        "block1_layer1/norm2", "block1_layer2/norm2",
+        "block2_layer1/norm2", "block2_layer2/norm2",
+    }
+    # the pre-activation BNs — the majority — all stayed live fp ops
+    assert not any("norm1" in f or "trans" in f or "norm5" in f for f in qmodel.folded)
+
+
+def test_fused_epilogue_routing_keeps_epilogue_bns_live_in_calibration():
+    """MODEL.FUSED_EPILOGUE + PTQ calibration interop: an EpilogueBatchNorm
+    passes isinstance(nn.BatchNorm) but its call also applies the residual
+    add and ReLU, so fold detection must never mark it foldable (the fold
+    substitution would drop both, diverge, and reject EVERY fold with a
+    misleading warning). Plain BNs — the downsample ds_bn — still fold."""
+    from distribuuuu_tpu.convert import synthetic_variables
+    from distribuuuu_tpu.models import build_model
+    from distribuuuu_tpu.ops.epilogue import set_fused_epilogue_default
+
+    model = build_model("resnet18", num_classes=8, dtype=jnp.float32)
+    v = synthetic_variables("resnet18", 7, 32, 8)
+    variables = {"params": v["params"], "batch_stats": v["batch_stats"]}
+    rng = np.random.default_rng(0)
+    batch = jnp.asarray(rng.standard_normal((2, 32, 32, 3)), jnp.float32)
+    plain_folds = {
+        k for k, s in calibrate(model, variables, [batch]).items()
+        if s.bn is not None
+    }
+    set_fused_epilogue_default(True)
+    try:
+        fused_folds = {
+            k for k, s in calibrate(model, variables, [batch]).items()
+            if s.bn is not None
+        }
+    finally:
+        set_fused_epilogue_default(False)
+    # unfused: every BN consumes its conv directly and folds; fused: the
+    # epilogue-routed BNs stay live, the plain ds_bns fold AND survive the
+    # verification pass (none rejected — the regression this test pins)
+    assert fused_folds == {k for k in plain_folds if "ds_conv" in k}
+    assert fused_folds, "downsample folds must survive under fused routing"
 
 
 def test_summarize_renders_quant_and_compile_lines(int8_engine):
