@@ -66,6 +66,7 @@ from __future__ import annotations
 
 import argparse
 import collections
+import json
 import os
 import random
 import re
@@ -1255,6 +1256,23 @@ class Agent:
             ports.append(p)
         return ports
 
+    def _replica_ready(self, port: int, timeout_s: float = 1.0) -> bool:
+        """One replica's /healthz readiness: answers AND reports ready=True
+        (version loaded, ladder compiled, no deploy swap in flight). The
+        rolling-restart gate's probe — stdlib urllib, host-local."""
+        import urllib.request
+
+        host = str(cfg.SERVE.HOST) if "SERVE" in cfg else "127.0.0.1"
+        if host in ("", "0.0.0.0"):
+            host = "127.0.0.1"
+        try:
+            with urllib.request.urlopen(
+                f"http://{host}:{int(port)}/healthz", timeout=timeout_s
+            ) as resp:
+                return bool(json.loads(resp.read()).get("ready", True))
+        except Exception:
+            return False
+
     def _launch_replica(self, rank: int, attempt: int, port: int) -> Worker:
         """Spawn ONE serve replica (serve mode restarts individually — the
         healthy replicas keep serving while a dead one relaunches)."""
@@ -1339,6 +1357,43 @@ class Agent:
         # independence is the whole point of serve mode), so backoff is a
         # timestamp gate, not a sleep
         retry_at: dict[int, float] = {}
+        # rolling-restart gate (docs/SERVING.md "Continuous deployment"):
+        # when several replicas need restarting, relaunch ONE at a time and
+        # gate the next on the previous one reporting ready via /healthz —
+        # fleet capacity never takes a second self-inflicted dip while a
+        # relaunched replica is still compiling its ladder or mid-swap.
+        # (rank, port, deadline) of the replica currently being rolled.
+        rolling: list[tuple[int, int, float]] = []
+        rolling_ready_s = float(getattr(a, "ROLLING_READY_S", 0.0))
+        # last /healthz probe time: the gate is consulted every 0.2s loop
+        # pass per blocked rank, and each probe is a blocking HTTP call
+        # (1s timeout) — probe at most once a second, not per pass
+        last_probe = [0.0]
+
+        def rolling_gate_open(candidate_rank: int) -> bool:
+            """May `candidate_rank` relaunch now, per the rolling gate?"""
+            if not rolling:
+                return True
+            rank, port, deadline = rolling[0]
+            if rank == candidate_rank:
+                return True  # re-rolling the same slot never self-blocks
+            if rank not in {w.rank for w in self._workers}:
+                rolling.clear()  # the rolled replica died again; its own
+                return True      # relaunch will re-arm the gate
+            if time.monotonic() >= deadline:
+                logger.warning(
+                    f"agent[serve]: replica {rank} not ready within "
+                    f"{rolling_ready_s:.0f}s — rolling on anyway"
+                )
+                rolling.clear()
+                return True
+            if time.monotonic() - last_probe[0] < 1.0:
+                return False  # recently probed not-ready; don't re-ask yet
+            last_probe[0] = time.monotonic()
+            if self._replica_ready(port):
+                rolling.clear()
+                return True
+            return False
 
         def recover_restart(
             rank: int, attempt_no: int, outcome: str, reason_txt: str = ""
@@ -1388,10 +1443,14 @@ class Agent:
                     or retry_at.get(rank, 0.0) > time.monotonic()
                 ):
                     continue
-                attempt += 1
                 # a slot's first attempt is the free initial launch; every
                 # further attempt for that slot is a restart under budget
                 is_restart = slot_attempts.get(rank, 0) > 0
+                # restarts roll one at a time (initial cold-start launches
+                # all replicas at once — there is no capacity to protect yet)
+                if is_restart and rolling_ready_s > 0 and not rolling_gate_open(rank):
+                    continue
+                attempt += 1
                 slot_attempts[rank] = slot_attempts.get(rank, 0) + 1
                 if is_restart and not self.budget.try_spend():
                     verdict, reason = "gave_up", (
@@ -1430,6 +1489,11 @@ class Agent:
                         self._launch_replica(rank, attempt, ports[rank])
                         launch_tic[rank] = time.time()
                         retry_at.pop(rank, None)
+                        if is_restart and rolling_ready_s > 0 and self.nprocs > 1:
+                            rolling[:] = [(
+                                rank, ports[rank],
+                                time.monotonic() + rolling_ready_s,
+                            )]
                     except LaunchError as exc:
                         failed_how = str(exc)
                         fail_outcome = "launch_failed"

@@ -110,11 +110,10 @@ def get_mid_checkpoint_path(out_dir: str, epoch: int, step: int) -> str:
     return pathio.join(get_checkpoint_dir(out_dir), _MID_FMT.format(epoch=epoch, step=step))
 
 
-def _complete_checkpoints(out_dir: str) -> list[tuple[int, str]]:
+def _scan_epoch_dirs(d: str) -> list[tuple[int, str]]:
     # pathio, not os: OUT_DIR is commonly gs:// on a pod, and auto-resume
     # must scan it the same way Orbax wrote it (reference parity:
     # `utils.py:340` does this through g_pathmgr.ls for the same reason).
-    d = get_checkpoint_dir(out_dir)
     if not pathio.isdir(d):
         return []
     out = []
@@ -125,11 +124,10 @@ def _complete_checkpoints(out_dir: str) -> list[tuple[int, str]]:
     return sorted(out)
 
 
-def _mid_checkpoints(out_dir: str) -> list[tuple[int, int, str]]:
+def _scan_mid_dirs(d: str) -> list[tuple[int, int, str]]:
     """Committed mid-epoch emergency checkpoints as (epoch, step, path),
     sorted ascending. Same exact-name match as the epoch scan, so Orbax
     in-progress temp dirs never count."""
-    d = get_checkpoint_dir(out_dir)
     if not pathio.isdir(d):
         return []
     out = []
@@ -138,6 +136,30 @@ def _mid_checkpoints(out_dir: str) -> list[tuple[int, int, str]]:
         if m:
             out.append((int(m.group(1)), int(m.group(2)), pathio.join(d, f)))
     return sorted(out)
+
+
+def _ranked_candidates(
+    epochs: list[tuple[int, str]], mids: list[tuple[int, int, str]]
+) -> list[tuple[tuple[int, int, int], str, str]]:
+    """The ONE ranking of checkpoint candidates, most-advanced first:
+    position ``(epoch, step, tiebreak)`` with a complete epoch checkpoint
+    outranking an emergency one at the same position. Shared by
+    `resume_candidates` (auto-resume) and `watch_candidates` (the serving
+    deploy watcher) so "newer" can never mean two different things."""
+    candidates: list[tuple[tuple[int, int, int], str, str]] = [
+        ((n, 0, 1), "epoch", p) for n, p in epochs
+    ]
+    candidates += [((e, s, 0), "mid", p) for e, s, p in mids]
+    candidates.sort(key=lambda c: c[0], reverse=True)
+    return candidates
+
+
+def _complete_checkpoints(out_dir: str) -> list[tuple[int, str]]:
+    return _scan_epoch_dirs(get_checkpoint_dir(out_dir))
+
+
+def _mid_checkpoints(out_dir: str) -> list[tuple[int, int, str]]:
+    return _scan_mid_dirs(get_checkpoint_dir(out_dir))
 
 
 def has_checkpoint(out_dir: str) -> bool:
@@ -849,13 +871,42 @@ def resume_candidates(
     dtpu-agent's preflight gate verifies. ``position`` is ``(epoch, step,
     tiebreak)`` with complete epoch checkpoints (``kind == "epoch"``)
     outranking an emergency checkpoint (``"mid"``) at the same position."""
-    candidates: list[tuple[tuple[int, int, int], str, str]] = [
-        ((n, 0, 1), "epoch", p) for n, p in _complete_checkpoints(out_dir)
-    ]
-    if step_granular:
-        candidates += [((e, s, 0), "mid", p) for e, s, p in _mid_checkpoints(out_dir)]
-    candidates.sort(key=lambda c: c[0], reverse=True)
-    return candidates
+    return _ranked_candidates(
+        _complete_checkpoints(out_dir),
+        _mid_checkpoints(out_dir) if step_granular else [],
+    )
+
+
+def manifest_hash(ckpt_path: str) -> str:
+    """Short content hash of a checkpoint's integrity manifest ("" when the
+    manifest is missing/unreadable). Because the manifest lists the sha256 of
+    every serialized file, this single digest identifies the checkpoint's
+    *bytes* — the version fingerprint the serving deploy path reports in
+    ``/healthz`` and its ``deploy_*`` journal records (docs/SERVING.md
+    "Continuous deployment")."""
+    try:
+        return hashlib.sha256(pathio.read_bytes(manifest_path(ckpt_path))).hexdigest()[:16]
+    except Exception:
+        return ""
+
+
+def watch_candidates(watch_dir: str) -> list[tuple[tuple[int, int, int], str, str]]:
+    """Deployable checkpoints under ``watch_dir`` as ``(position, kind,
+    path)``, most-advanced first — the serving deploy watcher's scan
+    (serve/deploy.py), sharing `resume_candidates`' position ranking so "an
+    older-step checkpoint never deploys over a newer one" means exactly what
+    resume means by it.
+
+    ``watch_dir`` may be a training run's OUT_DIR (its ``checkpoints/``
+    child is scanned) or the checkpoints directory itself. The exact-name
+    regexes already exclude Orbax in-progress temp dirs AND quarantined
+    ``corrupt_*`` dirs — both invisible here by construction, no filtering
+    needed. A missing/empty dir returns [] (the watcher just polls again).
+    """
+    d = str(watch_dir)
+    if pathio.isdir(pathio.join(d, _DIR_NAME)):
+        d = pathio.join(d, _DIR_NAME)
+    return _ranked_candidates(_scan_epoch_dirs(d), _scan_mid_dirs(d))
 
 
 def restore_latest(

@@ -366,6 +366,14 @@ _C.AGENT.CPU_DEVICES_PER_WORKER = 0
 # exits never attempt checkpoint rollback here (a serving replica has no
 # checkpoints): they take the backoff/budget path with a typed reason.
 _C.AGENT.SERVE = False
+# Rolling replica restarts (serve mode): relaunch dead replicas ONE AT A
+# TIME, gating the next relaunch on the previous one reporting ready via
+# GET /healthz (version loaded, ladder compiled, no swap in flight) — so a
+# multi-replica fleet never has more than one replica out of service at
+# once. This is how long the agent waits for that readiness before rolling
+# on anyway (a replica wedged at startup must not freeze the whole roll).
+# 0 disables the gate (every dead replica relaunches immediately).
+_C.AGENT.ROLLING_READY_S = 120.0
 # Dataplane mode (docs/DATA.md): supervise one dtpu-dataplane service
 # instead of a training fleet. Rides the exact restart budget / backoff /
 # preflight machinery; the service has no checkpoints, so a poison exit
@@ -430,6 +438,58 @@ _C.SERVE.JOURNAL_REQUESTS = True
 # x-dtpu-trace-id. Same volume class as JOURNAL_REQUESTS — turn off for
 # high-QPS deployments and keep the slo rollup.
 _C.SERVE.TRACE_SPANS = True
+
+# Continuous train->serve deployment (dtpu-deploy, serve/deploy.py;
+# docs/SERVING.md "Continuous deployment"). WATCH_DIR non-empty arms a
+# per-replica checkpoint watcher: new integrity-verified checkpoints in the
+# watched directory (a training run's OUT_DIR or its checkpoints/ dir; via
+# pathio, so gs:// works) are AOT-compiled ALONGSIDE the serving model (the
+# incumbent keeps serving throughout — zero downtime by construction), given
+# a canary fraction of live traffic, and promoted only when the canary's SLO
+# and a quality delta on golden-fixture inputs both pass. A failing canary
+# rolls back automatically (typed deploy_rollback record, per-checkpoint
+# strike count persisted under OUT_DIR/deploy/).
+_C.SERVE.DEPLOY = CN()
+# Directory to poll for new checkpoints ("" disables deployment entirely).
+_C.SERVE.DEPLOY.WATCH_DIR = ""
+# Which hosted model the watcher deploys into ("" = the sole hosted model;
+# required once SERVE.MODELS hosts more than one).
+_C.SERVE.DEPLOY.MODEL = ""
+# Watch poll cadence (seconds). Remote watch dirs pay one LIST per poll.
+_C.SERVE.DEPLOY.POLL_S = 5.0
+# Fraction of live traffic routed to the staged version during the canary
+# window. Routing is by request hash (the client's trace id when present),
+# so a retried request sticks to the version that first served it.
+_C.SERVE.DEPLOY.CANARY_FRACTION = 0.1
+# Canary window: promotion is decided after this many seconds of canary
+# traffic, or as soon as MIN_CANARY_REQUESTS canary requests landed.
+_C.SERVE.DEPLOY.CANARY_S = 30.0
+_C.SERVE.DEPLOY.MIN_CANARY_REQUESTS = 20
+# SLO gate: the canary's p99 must stay within this factor of the
+# incumbent's live p99 (from the in-process aggregator's serve_slo state).
+# No incumbent p99 yet (idle replica) passes vacuously.
+_C.SERVE.DEPLOY.SLO_P99_FACTOR = 2.0
+# Quality gate on GATE_N deterministic golden-fixture inputs (the same
+# input family the quant gate uses): candidate logits must be finite, agree
+# with the incumbent's top-1 on at least MIN_TOP1_AGREE of them, and (when
+# MAX_LOGIT_RMSE > 0) stay within the RMSE bound. Looser than the quant
+# gate by design — a newer training checkpoint legitimately moves logits;
+# the gate exists to catch poisoned/garbage weights, not training progress.
+_C.SERVE.DEPLOY.GATE_N = 16
+_C.SERVE.DEPLOY.GATE_SEED = 0
+_C.SERVE.DEPLOY.MIN_TOP1_AGREE = 0.5
+_C.SERVE.DEPLOY.MAX_LOGIT_RMSE = 0.0
+# Rollback escalation (PR 5's poison-rollback, serving-side): each rollback
+# bumps the checkpoint's persisted strike count; a checkpoint at
+# MAX_STRIKES is never tried again (a poison checkpoint cannot flap the
+# fleet forever). Strikes live in OUT_DIR/deploy/strikes.json and survive
+# replica restarts.
+_C.SERVE.DEPLOY.MAX_STRIKES = 2
+# Rolling-update lease: replicas serialize their rollouts through a lease
+# file under OUT_DIR/deploy/, so one replica stages/canaries at a time and
+# fleet capacity never drops. A holder silent for this long is presumed
+# dead and its lease taken over.
+_C.SERVE.DEPLOY.LOCK_LEASE_S = 600.0
 
 # Post-training int8 quantization (dtpu-quant; docs/PERFORMANCE.md,
 # docs/SERVING.md "Serving int8"). A hosted model opts in per entry:

@@ -320,7 +320,9 @@ SCHEMA: dict[str, tuple[dict[str, tuple], dict[str, tuple]]] = {
             "queue_ms": _NUM,
             "compute_ms": _NUM,
         },
-        {},
+        # version "canary" marks batches the deploy rollout routed to the
+        # staged model (serve/deploy.py); absent = the serving version
+        {"version": _STR},
     ),
     # periodic per-model SLO rollup: latency percentiles, throughput, sheds,
     # and the batch-fill histogram (compiled size -> dispatch count)
@@ -368,6 +370,54 @@ SCHEMA: dict[str, tuple[dict[str, tuple], dict[str, tuple]]] = {
             "folded_bn": _INT,
             "wall_s": _NUM,
         },
+    ),
+    # continuous deployment (dtpu-deploy, serve/deploy.py; docs/SERVING.md
+    # "Continuous deployment") ----------------------------------------------
+    # the watcher judged one checkpoint dir: action is candidate (accepted,
+    # a rollout begins) | held (no integrity manifest yet — a dir appearing
+    # mid-write; retried next poll) | corrupt (manifest verify failed; the
+    # watcher never quarantines someone else's artifacts) | struck_out
+    # (strike count exhausted by earlier rollbacks) | lease_wait (another
+    # replica's rollout holds the rolling lease). Checkpoints at or below
+    # the serving version are steady state — never an event.
+    "deploy_watch": (
+        {"model": _STR, "path": _STR, "action": _STR},
+        {"reason": _STR, "epoch": _INT, "step": _INT, "strikes": _INT,
+         "replica": _INT},
+    ),
+    # the incoming version was loaded and AOT-compiled alongside the
+    # incumbent (which kept serving throughout): wall_s is the whole
+    # load+compile, each ladder entry's compile also landed as its own
+    # serve_compile record
+    "deploy_stage": (
+        {"model": _STR, "path": _STR, "wall_s": _NUM},
+        {"epoch": _INT, "step": _INT, "aot_compiles": _INT,
+         "manifest_hash": _STR, "replica": _INT},
+    ),
+    # the canary verdict: the staged version served `fraction` of live
+    # traffic and its SLO + the golden-fixture quality delta were gated
+    # against the incumbent (passed False -> a deploy_rollback follows)
+    "deploy_canary": (
+        {"model": _STR, "path": _STR, "fraction": _NUM, "passed": _BOOL},
+        {"requests": _INT, "p99_ms": _NUM, "incumbent_p99_ms": _NUM,
+         "top1_agree": _NUM, "logit_rmse": _NUM, "reason": _STR,
+         "wall_s": _NUM, "replica": _INT},
+    ),
+    # the staged version became the serving version; the old version's
+    # executables and weights were dropped (HBM freed). fast_follow means
+    # the canary was skipped because a peer replica already promoted this
+    # exact checkpoint (the fleet-convergence path)
+    "deploy_promote": (
+        {"model": _STR, "path": _STR},
+        {"epoch": _INT, "step": _INT, "wall_s": _NUM, "manifest_hash": _STR,
+         "fast_follow": _BOOL, "replica": _INT},
+    ),
+    # a failing canary was demoted: the incumbent never stopped serving,
+    # the checkpoint's strike count was bumped (and persisted), and at
+    # MAX_STRIKES the watcher never tries the checkpoint again
+    "deploy_rollback": (
+        {"model": _STR, "path": _STR, "reason": _STR},
+        {"strikes": _INT, "epoch": _INT, "step": _INT, "replica": _INT},
     ),
     # quantization-aware fine-tune (quant/qat.py, QUANT.QAT): the trainer
     # calibrated the fake-quant sites and every subsequent train/eval

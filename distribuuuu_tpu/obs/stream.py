@@ -258,6 +258,31 @@ class LiveAggregator:
         elif kind == "serve_start":
             self._gauge("serve_replica", r.get("replica", 0))
             self._gauge("serve_models", len(r.get("models", []) or []))
+        elif kind == "deploy_watch":
+            self._count("deploy_watch_events_total")
+        elif kind == "deploy_stage":
+            # a rollout is in flight from stage until promote/rollback —
+            # the dtpu_deploy_rollout_active gauge an operator's dashboard
+            # (and the fleet controller's alarm rules) can key on
+            self._count("deploy_stages_total")
+            self._model("deploy_rollout_active", r["model"], 1.0)
+        elif kind == "deploy_canary":
+            self._count("deploy_canaries_total")
+            if isinstance(r.get("p99_ms"), (int, float)):
+                self._model("deploy_canary_p99_ms", r["model"], r["p99_ms"])
+        elif kind == "deploy_promote":
+            self._count("deploy_promotes_total")
+            self._model("deploy_rollout_active", r["model"], 0.0)
+            # the serving version as a scrapeable number: checkpoint epoch
+            # (and step for mid-epoch checkpoints)
+            for key in ("epoch", "step"):
+                if isinstance(r.get(key), (int, float)):
+                    self._model(f"deploy_version_{key}", r["model"], r[key])
+        elif kind == "deploy_rollback":
+            self._count("deploy_rollbacks_total")
+            self._model("deploy_rollout_active", r["model"], 0.0)
+            if isinstance(r.get("strikes"), (int, float)):
+                self._model("deploy_strikes", r["model"], r["strikes"])
         elif kind == "span":
             phase = str(r.get("phase", "?"))
             d = self.per_phase.setdefault(phase, {"count": 0.0, "ms_total": 0.0,

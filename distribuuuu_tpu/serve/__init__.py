@@ -20,10 +20,18 @@ path. Three layers, each independently testable:
   script), and a retrying client that makes a supervised replica kill
   invisible (zero dropped requests — chaos-tested).
 
+A fourth layer closes the production loop (`serve.deploy`, docs/SERVING.md
+"Continuous deployment"): a per-replica checkpoint watcher hot-reloads new
+integrity-verified training checkpoints — AOT-staged alongside the serving
+model, canaried on a sticky fraction of live traffic, promoted only past
+SLO + quality gates, rolled back automatically (with persisted strike
+escalation) otherwise.
+
 Every request/batch/SLO window flows typed records (``serve_request``,
-``serve_batch``, ``serve_slo``, ``serve_shed``) through the obs journal;
-``python -m distribuuuu_tpu.obs summarize`` renders p50/p99 latency, QPS
-and the batch-fill histogram.
+``serve_batch``, ``serve_slo``, ``serve_shed``) through the obs journal —
+deployments add ``deploy_watch/stage/canary/promote/rollback`` —
+``python -m distribuuuu_tpu.obs summarize`` renders p50/p99 latency, QPS,
+the batch-fill histogram and the deployment lifecycle.
 """
 
 from distribuuuu_tpu.serve.batcher import (  # noqa: F401
@@ -32,6 +40,12 @@ from distribuuuu_tpu.serve.batcher import (  # noqa: F401
     SLOTracker,
 )
 from distribuuuu_tpu.serve.client import ServeClient  # noqa: F401
+from distribuuuu_tpu.serve.deploy import (  # noqa: F401
+    DeployManager,
+    DeploySettings,
+    RolloutLease,
+    StrikeStore,
+)
 from distribuuuu_tpu.serve.engine import (  # noqa: F401
     HostedModel,
     InferenceEngine,

@@ -23,6 +23,7 @@ from __future__ import annotations
 import math
 import threading
 import time
+import zlib
 from typing import Callable
 
 import numpy as np
@@ -37,9 +38,17 @@ class QueueFullError(RuntimeError):
 class _Pending:
     """One queued request: inputs + a done-event the submitter blocks on."""
 
-    __slots__ = ("inputs", "n", "t_enqueue", "event", "result", "error", "trace_id")
+    __slots__ = (
+        "inputs", "n", "t_enqueue", "event", "result", "error", "trace_id",
+        "version",
+    )
 
-    def __init__(self, inputs: np.ndarray, trace_id: str | None = None):
+    def __init__(
+        self,
+        inputs: np.ndarray,
+        trace_id: str | None = None,
+        version: str = "live",
+    ):
         self.inputs = inputs
         self.n = int(inputs.shape[0])
         self.t_enqueue = time.monotonic()
@@ -47,6 +56,7 @@ class _Pending:
         self.result: np.ndarray | None = None
         self.error: BaseException | None = None
         self.trace_id = trace_id  # obs/trace.py id riding the request
+        self.version = version  # "live" | "canary" (deploy rollouts)
 
 
 class SLOTracker:
@@ -197,6 +207,10 @@ class MicroBatcher:
         self._depth: dict[str, int] = {}
         self._threads: list[threading.Thread] = []
         self._stop = False
+        # canary routing state (serve/deploy.py): model -> traffic fraction
+        # for the staged version, plus the deploy manager's latency hook
+        self._canary: dict[str, float] = {}
+        self._canary_hook: dict[str, Callable[[str, float], None]] = {}
         for model in self._ladders:
             self._cond[model] = threading.Condition()
             self._queue[model] = []
@@ -230,11 +244,71 @@ class MicroBatcher:
         for t in self._threads:
             t.join(timeout=5.0)
 
+    # -- canary routing (serve/deploy.py) ------------------------------------
+
+    def set_canary(
+        self,
+        model: str,
+        fraction: float,
+        hook: Callable[[str, float], None] | None = None,
+    ) -> None:
+        """Route ``fraction`` of ``model``'s traffic to the engine's staged
+        version. ``hook(model, latency_ms)`` is called for every completed
+        canary request — the deploy manager's SLO sample stream."""
+        if model not in self._ladders:
+            raise KeyError(f"unknown model {model!r}")
+        self._canary[model] = min(1.0, max(0.0, float(fraction)))
+        if hook is not None:
+            self._canary_hook[model] = hook
+
+    def clear_canary(self, model: str) -> None:
+        self._canary.pop(model, None)
+        self._canary_hook.pop(model, None)
+
+    def _version_for(
+        self, model: str, inputs: np.ndarray, trace_id: str | None
+    ) -> str:
+        """live/canary routing decision for one request, by STICKY hash:
+        keyed on the trace id when the request carries one (the serve
+        client keeps one id across retries, so a retried request lands on
+        the same version that first served it — a canary-killed replica
+        must not flap its own retries onto the incumbent and back), else
+        on the request bytes (identical resent payloads still stick)."""
+        fraction = self._canary.get(model, 0.0)
+        if fraction <= 0.0:
+            return "live"
+        if fraction >= 1.0:
+            return "canary"
+        if trace_id:
+            key = trace_id.encode()
+        else:
+            # bounded: slice the (contiguous) array BEFORE serializing so a
+            # multi-MB payload never round-trips through host bytes; shape
+            # via repr — bytes(shape) would raise on any dim > 255
+            key = (
+                repr(inputs.shape).encode()
+                + inputs.reshape(-1)[:65536].tobytes()
+            )
+        h = zlib.crc32(key) / 2**32
+        return "canary" if h < fraction else "live"
+
     # -- submission ----------------------------------------------------------
 
     def queue_depth(self, model: str) -> int:
         """Pending examples queued for one model (the SLO depth probe)."""
         return self._depth.get(model, 0)
+
+    def retry_after_s(self, model: str) -> float:
+        """How soon a shed request is worth retrying HERE: the estimated
+        drain time of the current backlog (dispatch rounds at the largest
+        compiled size × the queueing-delay bound). The frontend emits it as
+        the 503 ``Retry-After`` hint; the serve client sleeps it instead of
+        blind full-jitter backoff."""
+        ladder = self._ladders.get(model)
+        if not ladder:
+            return 0.1
+        rounds = max(1, math.ceil(self._depth.get(model, 0) / ladder[-1]))
+        return min(5.0, max(0.05, rounds * self.max_delay_s))
 
     def submit(
         self,
@@ -263,7 +337,11 @@ class MicroBatcher:
                 f"request of {n} examples exceeds {model!r}'s largest compiled "
                 f"batch {ladder[-1]} — split the request client-side"
             )
-        req = _Pending(inputs, trace_id=trace_id)
+        req = _Pending(
+            inputs,
+            trace_id=trace_id,
+            version=self._version_for(model, inputs, trace_id),
+        )
         cond = self._cond[model]
         with cond:
             if self._depth[model] + n > self.max_depth:
@@ -305,12 +383,24 @@ class MicroBatcher:
                 if remaining <= 0:
                     break
                 cond.wait(remaining)
-            # pack whole requests while they fit the largest executable
+            # pack whole requests while they fit the largest executable —
+            # and while they share the HEAD request's version: a dispatched
+            # batch runs one set of executables, so live and canary requests
+            # never share one (non-matching requests keep their queue order
+            # and head the very next take)
             taken: list[_Pending] = []
             total = 0
             queue = self._queue[model]
-            while queue and total + queue[0].n <= max_size:
-                req = queue.pop(0)
+            version = queue[0].version if queue else "live"
+            i = 0
+            while i < len(queue):
+                req = queue[i]
+                if req.version != version:
+                    i += 1
+                    continue
+                if total + req.n > max_size:
+                    break
+                queue.pop(i)
                 total += req.n
                 taken.append(req)
             self._depth[model] -= total
@@ -324,6 +414,7 @@ class MicroBatcher:
                 continue
             n = sum(r.n for r in taken)
             batch_size = next(b for b in ladder if b >= n)
+            version = taken[0].version  # whole batch shares it (see _take_batch)
             t_dispatch = time.monotonic()
             queue_ms = 1000.0 * (t_dispatch - min(r.t_enqueue for r in taken))
             try:
@@ -334,13 +425,25 @@ class MicroBatcher:
                     padded[row : row + req.n] = req.inputs
                     row += req.n
                 pad_ms = 1000.0 * (time.monotonic() - t_dispatch)
+                # the version kwarg is passed only off the live path so the
+                # plain ``(model, batch)`` runner contract (tests, custom
+                # runners) is untouched when no rollout is in flight
                 if self._timed_runner is not None:
-                    logits, execute_ms = self._timed_runner(model, padded)
+                    logits, execute_ms = (
+                        self._timed_runner(model, padded, version=version)
+                        if version != "live"
+                        else self._timed_runner(model, padded)
+                    )
                 else:
                     t_exec = time.monotonic()
-                    logits = self._runner(model, padded)
+                    logits = (
+                        self._runner(model, padded, version=version)
+                        if version != "live"
+                        else self._runner(model, padded)
+                    )
                     execute_ms = 1000.0 * (time.monotonic() - t_exec)
                 compute_ms = 1000.0 * (time.monotonic() - t_dispatch)
+                t_done = time.monotonic()
                 row = 0
                 for req in taken:
                     req.result = logits[row : row + req.n]
@@ -355,7 +458,19 @@ class MicroBatcher:
                     fill=round(n / batch_size, 4),
                     queue_ms=round(queue_ms, 3),
                     compute_ms=round(compute_ms, 3),
+                    **({"version": version} if version != "live" else {}),
                 )
+                if version == "canary":
+                    # the deploy manager's canary SLO sample: per-request
+                    # enqueue→result wall (the latency the caller felt,
+                    # minus frontend overhead — measured, not modeled)
+                    hook = self._canary_hook.get(model)
+                    if hook is not None:
+                        for req in taken:
+                            try:
+                                hook(model, 1000.0 * (t_done - req.t_enqueue))
+                            except Exception:  # must never kill the loop
+                                pass
                 if self._trace_spans:
                     # per-request phase spans under the client-minted id:
                     # queue-wait is the request's own, pad/execute are the

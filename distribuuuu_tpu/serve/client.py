@@ -37,6 +37,17 @@ def _ensure_trace_id(trace_id) -> str:
     return uuid.uuid4().hex[:16]
 
 
+def _parse_retry_after(value) -> float | None:
+    """Decimal-seconds ``Retry-After`` (the dtpu-serve frontend emits it on
+    503 sheds from its queue depth). HTTP-date forms and garbage return
+    None — the caller falls back to jittered backoff."""
+    try:
+        seconds = float(value)
+    except (TypeError, ValueError):
+        return None
+    return seconds if 0.0 <= seconds <= 3600.0 else None
+
+
 class ServeUnavailable(RuntimeError):
     """No replica answered within the retry deadline."""
 
@@ -130,6 +141,7 @@ class ServeClient:
         while time.monotonic() < deadline:
             url = self.urls[self._next % len(self.urls)]
             self._next += 1
+            retry_after: float | None = None
             req = urllib.request.Request(
                 f"{url}/v1/predict",
                 data=body,
@@ -148,13 +160,26 @@ class ServeClient:
                         pass
                     raise ServeRequestError(f"HTTP {exc.code}: {detail}") from exc
                 last_err = exc  # 503 shed / 5xx: retryable
+                retry_after = _parse_retry_after(exc.headers.get("Retry-After"))
             except (urllib.error.URLError, OSError, TimeoutError, json.JSONDecodeError) as exc:
                 last_err = exc  # replica down / mid-kill: retryable
             attempt += 1
             self.retries += 1
-            delay = self._rng.uniform(
-                0.0, min(self.backoff_max_s, self.backoff_base_s * (2.0**attempt))
-            )
+            if retry_after is not None:
+                # a 503 shed carried the server's queue-drain estimate:
+                # sleep ~that (capped) instead of guessing with full-jitter
+                # backoff — the shedding replica knows its own backlog
+                # better than our exponential clock does. Floored (a
+                # Retry-After: 0 from some intermediary must not become a
+                # hot spin loop) and lightly jittered (every client shed in
+                # one window gets the same deterministic hint; unjittered
+                # they would all retry in lockstep and re-shed together).
+                delay = max(0.05, min(retry_after, self.backoff_max_s * 5.0))
+                delay *= self._rng.uniform(0.8, 1.2)
+            else:
+                delay = self._rng.uniform(
+                    0.0, min(self.backoff_max_s, self.backoff_base_s * (2.0**attempt))
+                )
             time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
         raise ServeUnavailable(
             f"no replica served the request within {self.deadline_s:.1f}s "

@@ -35,7 +35,7 @@ warm-vs-cold startup number `obs summarize` renders).
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any, Callable
 
 import jax
@@ -120,6 +120,12 @@ class HostedModel:
     gate: Any = None
     quant_s: float = 0.0
 
+    # version identity (docs/SERVING.md "Continuous deployment"): parsed
+    # from the weights directory name (epoch/step for trained checkpoints)
+    # plus the integrity manifest's content hash — what /healthz reports and
+    # the deploy watcher's older-than-serving check compares against
+    version: dict = field(default_factory=dict)
+
     @property
     def batch_sizes(self) -> list[int]:
         return sorted(self.compiled)
@@ -132,8 +138,48 @@ class HostedModel:
         return None
 
 
+def version_of(weights_path: str) -> dict:
+    """The version fingerprint of a weights directory: checkpoint position
+    (epoch/step parsed from the dir name against checkpoint.py's naming
+    regexes — ONE source of the contract, shared with `watch_candidates`;
+    -1/-1 for non-checkpoint dirs like converted-torch output) and the
+    integrity manifest's content hash ("" when unverified). This is what
+    ``GET /healthz`` reports per model — the operator's "what is actually
+    serving" answer — and what the deploy watcher orders candidates by."""
+    from distribuuuu_tpu.checkpoint import _CKPT_RE, _MID_RE, manifest_hash
+
+    name = str(weights_path).rstrip("/").rsplit("/", 1)[-1]
+    epoch, step = -1, -1
+    m = _CKPT_RE.match(name)
+    if m:
+        epoch, step = int(m.group(1)), 0
+    else:
+        m = _MID_RE.match(name)
+        if m:
+            epoch, step = int(m.group(1)), int(m.group(2))
+    return {
+        "path": str(weights_path),
+        "epoch": epoch,
+        "step": step,
+        "manifest_hash": manifest_hash(weights_path),
+    }
+
+
 class InferenceEngine:
-    """Hosts N models on one mesh behind fixed-shape AOT executables."""
+    """Hosts N models on one mesh behind fixed-shape AOT executables.
+
+    Continuous deployment (serve/deploy.py) adds a second slot per model:
+    ``stage()`` loads + AOT-compiles an INCOMING version alongside the
+    serving one (the incumbent's executables are untouched — it keeps
+    serving, zero downtime by construction), ``forward(..., version=
+    "canary")`` dispatches to the staged executables, and ``promote()`` /
+    ``discard_staged()`` settle the rollout — promote frees the old
+    version's weights and executables (HBM back), discard frees the staged
+    ones. Steady-state serving still never traces or compiles: staging
+    compiles happen once per rollout at stage time (journaled per ladder
+    entry as ``serve_compile`` records, cheap under the persistent cache),
+    never on a request path.
+    """
 
     def __init__(
         self,
@@ -166,6 +212,9 @@ class InferenceEngine:
         )
         self.verify_integrity = verify_integrity
         self.models: dict[str, HostedModel] = {}
+        # incoming versions under canary (serve/deploy.py): one staged
+        # HostedModel per model name, compiled but not yet promoted
+        self.staged: dict[str, HostedModel] = {}
         self._replicated = NamedSharding(mesh, P())
         self.aot_compiles = 0  # ladder entries compiled (cache hits included)
         # typed-record sink (ValidatedJournal.event); None degrades to no-op
@@ -190,6 +239,21 @@ class InferenceEngine:
         """Load one model's weights and AOT-compile its ladder."""
         if spec.name in self.models:
             raise ValueError(f"model {spec.name!r} already hosted")
+        hosted = self._build_hosted(spec)
+        self.models[spec.name] = hosted
+        quant_note = f" [{spec.quant}]" if spec.quant else ""
+        logger.info(
+            f"serve: hosted {spec.name} ({spec.arch}{quant_note}) from "
+            f"{spec.weights}: weights {hosted.load_s:.2f}s, ladder "
+            f"{self.batch_sizes} AOT-compiled in {hosted.compile_s:.2f}s"
+        )
+        return hosted
+
+    def _build_hosted(self, spec: ModelSpec) -> HostedModel:
+        """Load weights + AOT-compile the full ladder into a HostedModel,
+        without registering it anywhere — shared by `load` (startup) and
+        `stage` (deploy rollout, where the result must not replace the
+        serving version until the canary passes)."""
         tic = time.time()
         model = build_model(
             spec.arch, num_classes=self.num_classes, dtype=self.compute_dtype
@@ -220,7 +284,8 @@ class InferenceEngine:
         )
         load_s = time.time() - tic
         hosted = HostedModel(
-            spec=spec, params=params, batch_stats=batch_stats, load_s=load_s
+            spec=spec, params=params, batch_stats=batch_stats, load_s=load_s,
+            version=version_of(spec.weights),
         )
 
         def fwd(p, stats, images):
@@ -264,14 +329,65 @@ class InferenceEngine:
                 quant=spec.quant,
             )
         hosted.compile_s = time.time() - tic
-        self.models[spec.name] = hosted
-        quant_note = f" [{spec.quant}]" if spec.quant else ""
+        return hosted
+
+    # -- continuous deployment (serve/deploy.py) ----------------------------
+
+    def stage(self, name: str, weights: str) -> HostedModel:
+        """Load + AOT-compile an incoming version of a hosted model.
+
+        Same arch/quant spec as the serving version, new weights directory.
+        The incumbent's executables are untouched and keep serving; the
+        staged version becomes reachable only through ``forward(...,
+        version="canary")`` until `promote`/`discard_staged` settles it.
+        Each ladder entry journals its ``serve_compile`` record exactly like
+        a startup compile — near-zero walls under the persistent cache."""
+        incumbent = self.hosted(name)
+        if name in self.staged:
+            raise ValueError(f"model {name!r} already has a staged version")
+        hosted = self._build_hosted(replace(incumbent.spec, weights=str(weights)))
+        # warm every staged ladder entry on zeros before it sees a canary
+        # request: executable load / lazy backend init must not land on (and
+        # distort) the canary's first measured latencies
+        for b, (compiled, sharding) in sorted(hosted.compiled.items()):
+            zeros = np.zeros((b, self.im_size, self.im_size, 3), self.input_dtype)
+            np.asarray(compiled(*hosted.exec_args, jax.device_put(zeros, sharding)))
+        self.staged[name] = hosted
         logger.info(
-            f"serve: hosted {spec.name} ({spec.arch}{quant_note}) from "
-            f"{spec.weights}: weights {load_s:.2f}s, ladder {self.batch_sizes} "
-            f"AOT-compiled in {hosted.compile_s:.2f}s"
+            f"serve: staged {name} <- {weights} (weights {hosted.load_s:.2f}s, "
+            f"ladder {self.batch_sizes} AOT-compiled in {hosted.compile_s:.2f}s; "
+            f"incumbent {incumbent.version.get('path', '?')} still serving)"
         )
         return hosted
+
+    def promote(self, name: str) -> dict:
+        """Swap the staged version in as the serving one; returns the OLD
+        version dict. The engine drops its only reference to the retired
+        HostedModel, so its weights and executables free as soon as any
+        in-flight forward bound to it completes (the PR-10 prune pattern:
+        nothing keeps the retired tree alive alongside the new one).
+        Deliberately NOT an in-place clear: a batcher dispatcher thread may
+        be mid-``forward`` on the old object, and mutating it under that
+        thread would crash the in-flight batch — reference dropping retires
+        it with zero synchronization and zero failed requests."""
+        staged = self.staged.pop(name, None)
+        if staged is None:
+            raise ValueError(f"model {name!r} has no staged version to promote")
+        old = self.models[name]
+        self.models[name] = staged
+        old_version = dict(old.version)
+        logger.info(
+            f"serve: promoted {name} -> {staged.version.get('path', '?')} "
+            f"(retired {old_version.get('path', '?')}, HBM freed)"
+        )
+        return old_version
+
+    def discard_staged(self, name: str) -> None:
+        """Drop a staged version (failed canary): the incumbent never
+        stopped serving, and the staged weights/executables free once any
+        in-flight canary forward completes (same reference-drop retirement
+        as `promote` — never mutated under a dispatcher thread)."""
+        self.staged.pop(name, None)
 
     # -- int8 (dtpu-quant) ---------------------------------------------------
 
@@ -442,15 +558,26 @@ class InferenceEngine:
                 f"unknown model {name!r}; hosting: {', '.join(sorted(self.models))}"
             ) from None
 
-    def forward(self, name: str, batch: np.ndarray) -> np.ndarray:
+    def forward(
+        self, name: str, batch: np.ndarray, version: str = "live"
+    ) -> np.ndarray:
         """Run one *exactly-ladder-sized* batch; returns float32 logits.
 
         The batcher owns padding; this layer refuses non-ladder shapes
         loudly (a silently-retracing fallback would defeat the whole AOT
         design). ``np.asarray`` is the one host sync of a dispatch — the
         result IS the response payload, so the fetch is the point.
+
+        ``version="canary"`` dispatches to the STAGED version's executables
+        (deploy rollout); anything else (or no staged version — e.g. a
+        canary-routed retry arriving after a rollback settled) serves from
+        the incumbent, so a mid-rollout race degrades to the safe side.
         """
         hosted = self.hosted(name)
+        if version == "canary":
+            staged = self.staged.get(name)
+            if staged is not None:
+                hosted = staged
         b = int(batch.shape[0])
         if b not in hosted.compiled:
             raise ValueError(
@@ -466,7 +593,9 @@ class InferenceEngine:
         out = compiled(*hosted.exec_args, jax.device_put(batch, sharding))
         return np.asarray(out)
 
-    def forward_timed(self, name: str, batch: np.ndarray) -> tuple[np.ndarray, float]:
+    def forward_timed(
+        self, name: str, batch: np.ndarray, version: str = "live"
+    ) -> tuple[np.ndarray, float]:
         """`forward` plus its wall in ms — the per-trace ``execute`` span.
 
         Timed around the compiled call *including* the result fetch: the
@@ -475,8 +604,20 @@ class InferenceEngine:
         honest end-to-end device time with zero added syncs.
         """
         tic = time.monotonic()
-        logits = self.forward(name, batch)
+        logits = self.forward(name, batch, version=version)
         return logits, 1000.0 * (time.monotonic() - tic)
+
+    def versions(self) -> dict[str, dict]:
+        """Per-model serving-version report (the /healthz payload), with the
+        staged (canary) version alongside while a rollout is in flight."""
+        out: dict[str, dict] = {}
+        for name, hosted in self.models.items():
+            v = dict(hosted.version)
+            staged = self.staged.get(name)
+            if staged is not None:
+                v["staged"] = dict(staged.version)
+            out[name] = v
+        return out
 
     def runner(self) -> Callable[[str, np.ndarray], np.ndarray]:
         """The batcher-facing dispatch callable."""

@@ -210,8 +210,32 @@ class ServeReplica:
             timed_runner=self.engine.forward_timed,
             trace_spans=self.trace_spans,
         ).start()
+        # continuous deployment (serve/deploy.py): a non-empty WATCH_DIR
+        # arms the per-replica checkpoint watcher — hot reload, canary
+        # gating, automatic rollback (docs/SERVING.md "Continuous
+        # deployment"). The watcher owns readiness: /healthz reports
+        # ready=False while a version swap is in flight.
+        self.deploy = None
+        if str(s.DEPLOY.WATCH_DIR):
+            from distribuuuu_tpu.serve.deploy import DeployManager, DeploySettings
+
+            self.deploy = DeployManager(
+                DeploySettings.from_cfg(s.DEPLOY),
+                engine=self.engine,
+                batcher=self.batcher,
+                aggregator=self.aggregator,
+                journal_event=self.journal_event,
+                out_dir=out_dir,
+                replica=self.replica,
+            ).start()
         self.port = 0  # bound ingress port (http mode fills it in)
         self._warmup_s = warmup_s
+
+    def is_ready(self) -> bool:
+        """False exactly while a deploy version swap is in flight — the
+        rolling-restart gate (the replica still SERVES while not ready;
+        readiness gates rollout/restart orchestration, not traffic)."""
+        return self.deploy is None or self.deploy.ready
 
     def journal_event(self, kind: str, **fields) -> None:
         """Journal one typed record AND fold it into the live aggregator."""
@@ -303,6 +327,8 @@ class ServeReplica:
         }
 
     def shutdown(self) -> None:
+        if self.deploy is not None:
+            self.deploy.stop()
         self.batcher.stop()
         self.slo.flush()
         self.journal.close()
@@ -317,7 +343,11 @@ def _make_handler(replica: ServeReplica):
         protocol_version = "HTTP/1.1"
 
         def _reply(
-            self, code: int, payload: dict, trace_id: str | None = None
+            self,
+            code: int,
+            payload: dict,
+            trace_id: str | None = None,
+            retry_after_s: float | None = None,
         ) -> None:
             data = json.dumps(payload).encode()
             self.send_response(code)
@@ -325,6 +355,11 @@ def _make_handler(replica: ServeReplica):
             self.send_header("Content-Length", str(len(data)))
             if trace_id:  # echo the id so callers can correlate journal spans
                 self.send_header(TRACE_HEADER, trace_id)
+            if retry_after_s is not None:
+                # queue-depth-derived shed hint: when THIS replica expects
+                # to have drained its backlog. Decimal seconds — our client
+                # parses floats; RFC-9110 integer readers round up.
+                self.send_header("Retry-After", f"{retry_after_s:.3f}")
             self.end_headers()
             self.wfile.write(data)
 
@@ -338,11 +373,17 @@ def _make_handler(replica: ServeReplica):
 
         def do_GET(self):  # noqa: N802 (stdlib naming contract)
             if self.path == "/healthz":
+                # per-model version (checkpoint epoch/step + weights
+                # manifest hash — the operator's "what is actually serving"
+                # answer) and the readiness flag the rolling-restart gate
+                # reads: false exactly while a deploy swap is in flight
                 self._reply(
                     200,
                     {
                         "status": "ok",
+                        "ready": replica.is_ready(),
                         "models": sorted(replica.engine.models),
+                        "versions": replica.engine.versions(),
                         "replica": replica.replica,
                         "batch_sizes": replica.engine.batch_sizes,
                     },
@@ -366,12 +407,20 @@ def _make_handler(replica: ServeReplica):
             # the client-minted trace id (obs/trace.py); malformed or absent
             # headers get a fresh id — the spans must always have a key
             trace_id = ensure_trace_id(self.headers.get(TRACE_HEADER))
+            model = ""  # filled once the body parses; the shed hint's key
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 body = json.loads(self.rfile.read(length) or b"{}")
+                if isinstance(body, dict):
+                    model = str(body.get("model", ""))
                 self._reply(200, replica.handle(body, trace_id), trace_id)
             except QueueFullError as exc:
-                self._reply(503, {"error": "shed", "detail": str(exc)}, trace_id)
+                self._reply(
+                    503,
+                    {"error": "shed", "detail": str(exc)},
+                    trace_id,
+                    retry_after_s=replica.batcher.retry_after_s(model),
+                )
             except BadRequest as exc:
                 self._reply(400, {"error": "bad_request", "detail": str(exc)}, trace_id)
             except (json.JSONDecodeError, UnicodeDecodeError) as exc:

@@ -1,0 +1,277 @@
+"""dtpu-deploy smoke check — the CI `deploy-smoke` job's driver (and a local
+one-command sanity run, docs/SERVING.md "Continuous deployment").
+
+The whole production loop, end to end on CPU:
+
+1. a real 2-step DUMMY_INPUT train (1 step/epoch x 2 epochs) writes
+   integrity-manifested checkpoints into its OUT_DIR;
+2. a LIVE 2-replica supervised serving fleet (dtpu-agent serve mode) hosts
+   epoch-1's checkpoint with the deploy watcher armed on the training
+   checkpoints dir;
+3. the epoch-2 checkpoint lands while a client drives continuous traffic:
+   hot reload -> stage -> canary -> promote, with ZERO dropped requests and
+   both replicas converging on the new version (/healthz version report);
+4. a deliberately-poisoned (NaN-weights, quality-failing) checkpoint then
+   rolls back automatically — typed `deploy_rollback`, incumbent keeps
+   serving throughout;
+5. the serving journal schema-validates and `obs summarize` renders the
+   deployments lifecycle.
+
+Exit 0 = all of the above held. Usage:
+
+    python scripts/run_deploy_check.py [--out-dir DIR]
+
+Invoked with --worker, this file runs one dtpu-serve replica instead (the
+agent's AGENT.CMD worker): self-contained CPU platform pinning, so the
+check works on boxes where the JAX_PLATFORMS env var is not honored.
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_N_DEVICES = 8 if "--worker" not in sys.argv else 1
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={_N_DEVICES}"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+IM, NC, LADDER = 16, 4, [1, 4]
+
+
+def worker_main(argv) -> int:
+    from distribuuuu_tpu.runtime.compile_cache import enable_persistent_cache
+    from distribuuuu_tpu.serve.frontend import serve_main
+
+    enable_persistent_cache()
+    return serve_main(argv)
+
+
+def _train(out_dir: str, max_epoch: int) -> None:
+    """One in-process DUMMY_INPUT train stage (1 step/epoch at this batch
+    geometry); auto-resume turns the second call into 'train one MORE
+    epoch', which drops exactly one new checkpoint into the watch dir —
+    the live training run the deploy watcher follows."""
+    from distribuuuu_tpu import config, trainer
+
+    config.reset_cfg()
+    config.cfg.merge_from_list([
+        "MODEL.ARCH", "resnet18", "MODEL.DTYPE", "float32",
+        "MODEL.NUM_CLASSES", str(NC), "MODEL.DUMMY_INPUT", "True",
+        "TRAIN.BATCH_SIZE", "2", "TRAIN.IM_SIZE", str(IM),
+        "TEST.IM_SIZE", str(IM), "TEST.CROP_SIZE", str(IM),
+        "TEST.BATCH_SIZE", "2", "TRAIN.DUMMY_EPOCH_SAMPLES", "16",
+        "TRAIN.PRINT_FREQ", "1", "OPTIM.MAX_EPOCH", str(max_epoch),
+        "OPTIM.WARMUP_EPOCHS", "0", "RNG_SEED", "1", "OUT_DIR", out_dir,
+        # the reference recipe's BASE_LR is sized for 90 epochs of real
+        # data, and per-device batch 2 collapses local-BN variance at the
+        # 1x1 deep stages (exploding grads -> NaN logits): a sane toy
+        # geometry needs SyncBN over the global batch + a small LR, or the
+        # "healthy" checkpoint would legitimately fail the quality gate
+        "OPTIM.BASE_LR", "0.001", "MODEL.SYNCBN", "True",
+    ])
+    config.cfg.freeze()
+    trainer.train_model()
+    from distribuuuu_tpu.checkpoint import wait_for_saves
+
+    wait_for_saves()  # checkpoints AND their integrity manifests durable
+    config.reset_cfg()
+
+
+def _poison_checkpoint(path: str) -> str:
+    """A quality-failing checkpoint: real layout, NaN weights."""
+    import orbax.checkpoint as ocp
+
+    from distribuuuu_tpu import checkpoint as ckpt
+    from distribuuuu_tpu.convert import synthetic_variables
+
+    variables = synthetic_variables("resnet18", 3, IM, NC)
+    variables["params"] = jax.tree.map(
+        lambda x: np.full_like(np.asarray(x), np.nan), variables["params"]
+    )
+    ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).save(
+        os.path.abspath(path), variables, force=True
+    )
+    ckpt.write_manifest(path)
+    return path
+
+
+def _healthz(port: int):
+    import json
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=2
+        ) as resp:
+            return json.loads(resp.read())
+    except Exception:
+        return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out-dir", default="/tmp/deploy_smoke")
+    args = ap.parse_args()
+
+    from distribuuuu_tpu.obs.journal import read_journal, validate_journal
+    from distribuuuu_tpu.obs.summarize import summarize_file
+    from distribuuuu_tpu.runtime.compile_cache import enable_persistent_cache
+    from distribuuuu_tpu.runtime.dist import pick_rendezvous_port
+    from distribuuuu_tpu.serve.client import ServeClient
+
+    enable_persistent_cache()
+    out_dir = args.out_dir
+    train_dir = os.path.join(out_dir, "train")
+    serve_dir = os.path.join(out_dir, "serve")
+    os.makedirs(serve_dir, exist_ok=True)
+    watch = os.path.join(train_dir, "checkpoints")
+
+    print("== stage 1: train epoch 1 (the initial serving version)")
+    _train(train_dir, max_epoch=1)
+    initial = os.path.join(watch, "ckpt_ep_001")
+    assert os.path.isdir(initial), initial
+
+    print("== stage 2: 2-replica supervised serving fleet, watcher armed")
+    port = pick_rendezvous_port()
+    ports = [port, port + 1]
+    worker_overrides = (
+        f"OUT_DIR {serve_dir} MODEL.NUM_CLASSES {NC} "
+        f"SERVE.MODELS \"['m=resnet18@{initial}']\" "
+        f"SERVE.BATCH_SIZES [{','.join(map(str, LADDER))}] "
+        f"SERVE.IM_SIZE {IM} SERVE.INPUT_DTYPE float32 SERVE.DTYPE float32 "
+        f"SERVE.MAX_QUEUE_DELAY_MS 2 SERVE.SLO_WINDOW_S 5 "
+        f"SERVE.HOST 127.0.0.1 "
+        f"SERVE.DEPLOY.WATCH_DIR {watch} SERVE.DEPLOY.POLL_S 0.3 "
+        f"SERVE.DEPLOY.CANARY_FRACTION 0.5 SERVE.DEPLOY.CANARY_S 10 "
+        f"SERVE.DEPLOY.MIN_CANARY_REQUESTS 4 "
+        # the default 0.5 agreement floor: a 1-step toy train legitimately
+        # moves argmaxes of near-uniform logits (rmse stays tiny) — the
+        # gate's job here is the NaN/garbage catch in stage 4
+        f"SERVE.DEPLOY.MIN_TOP1_AGREE 0.5 SERVE.DEPLOY.LOCK_LEASE_S 60"
+    )
+    agent_cmd = [
+        sys.executable, "-m", "distribuuuu_tpu.agent",
+        "OUT_DIR", serve_dir,
+        "AGENT.SERVE", "True", "AGENT.NPROCS", "2",
+        "AGENT.PREFLIGHT_DEVICE_PROBE", "False", "AGENT.MIN_FREE_DISK_GB", "0",
+        "AGENT.MAX_RESTARTS", "5", "SERVE.PORT", str(port),
+        "AGENT.CMD",
+        f"{sys.executable} {os.path.abspath(__file__)} --worker "
+        + worker_overrides,
+    ]
+    proc = subprocess.Popen(agent_cmd, env=dict(os.environ))
+
+    failures, served = [], [0]
+    stop_driving = threading.Event()
+
+    def driver():
+        client = ServeClient(ports, deadline_s=60)
+        rng = np.random.default_rng(5)
+        i = 0
+        while not stop_driving.is_set():
+            n = (1, 2)[i % 2]
+            x = rng.standard_normal((n, IM, IM, 3), dtype=np.float32)
+            try:
+                logits = client.predict("m", x, trace_id=f"smoke-{i}")
+                assert logits.shape == (n, NC), logits.shape
+                served[0] += 1
+            except Exception as exc:  # noqa: BLE001 - zero drops IS the gate
+                failures.append((i, repr(exc)))
+            i += 1
+            time.sleep(0.05)
+
+    def wait_converged(suffix: str, deadline_s: float) -> None:
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            states = [_healthz(p) for p in ports]
+            if all(
+                s is not None and s.get("ready")
+                and s["versions"]["m"]["path"].endswith(suffix)
+                and "staged" not in s["versions"]["m"]
+                for s in states
+            ):
+                return
+            time.sleep(0.3)
+        raise AssertionError(
+            f"fleet never converged on {suffix}: {[_healthz(p) for p in ports]}"
+        )
+
+    try:
+        ServeClient(ports, deadline_s=60).wait_ready(deadline_s=300)
+        drive = threading.Thread(target=driver)
+        drive.start()
+
+        print("== stage 3: train epoch 2 — a new checkpoint lands LIVE")
+        _train(train_dir, max_epoch=2)  # auto-resume: one more epoch
+        wait_converged("ckpt_ep_002", 180.0)
+        print(f"   both replicas promoted to ckpt_ep_002 "
+              f"({served[0]} requests served so far, zero drops)")
+
+        print("== stage 4: poisoned checkpoint -> automatic rollback")
+        _poison_checkpoint(os.path.join(watch, "ckpt_ep_003"))
+        journal = os.path.join(serve_dir, "telemetry.jsonl")
+        deadline = time.monotonic() + 180.0
+        while time.monotonic() < deadline:
+            rollbacks = [
+                r for r in read_journal(journal)
+                if r.get("kind") == "deploy_rollback"
+                and r["path"].endswith("ckpt_ep_003")
+            ]
+            if rollbacks:
+                break
+            time.sleep(0.5)
+        assert rollbacks, "poisoned checkpoint never rolled back"
+        assert "quality" in rollbacks[0]["reason"], rollbacks[0]
+        # the incumbent never stopped serving
+        wait_converged("ckpt_ep_002", 60.0)
+
+        stop_driving.set()
+        drive.join(timeout=120)
+        assert not failures, f"dropped requests: {failures}"
+        assert served[0] > 0
+        print(f"   rollback journaled; {served[0]} requests total, zero drops")
+    finally:
+        stop_driving.set()
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+
+    print("== stage 5: journal schema + summarize")
+    journal = os.path.join(serve_dir, "telemetry.jsonl")
+    schema_errors = validate_journal(journal)
+    assert not schema_errors, schema_errors
+    recs = list(read_journal(journal))
+    kinds = {r.get("kind") for r in recs}
+    for kind in ("deploy_stage", "deploy_canary", "deploy_promote",
+                 "deploy_rollback"):
+        assert kind in kinds, f"no {kind} record journaled"
+    report = summarize_file(journal)
+    print(report)
+    assert "deployments:" in report, "summarize did not render deployments"
+    assert "ROLLBACK" in report
+    print("deploy smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    if "--worker" in sys.argv:
+        argv = [a for a in sys.argv[1:] if a != "--worker"]
+        sys.exit(worker_main(argv))
+    sys.exit(main())
