@@ -49,6 +49,11 @@ def _variant_tags() -> str:
         tags += " +s2d" if stem_s2d else " +nos2d"
     if os.environ.get("DTPU_FUSED_ATTN", "0") == "1":
         tags += " +fused-attn"
+    seq_env = os.environ.get("DTPU_BENCH_SEQ", "")
+    if seq_env not in ("", "0", "1"):
+        # the sequence-parallel A/B arm (parallel/seq.py): the mesh grows a
+        # seq axis of this size and attention runs the tagged formulation
+        tags += f" +seq{seq_env}-{os.environ.get('DTPU_BENCH_SEQ_ATTN', 'ring')}"
     if os.environ.get("DTPU_FUSED_EPILOGUE", "0") == "1":
         # the fused conv-epilogue A/B arm (ops/epilogue.py): the env var is
         # read by the model's bn_epilogue routing at trace time, so setting
@@ -195,9 +200,14 @@ def main():
     # 224 is the measured configuration; smaller values are for CPU-mesh
     # smoke runs of the bench harness itself (scripts/cpu_mesh_run.py)
     im_size = int(os.environ.get("DTPU_BENCH_IM_SIZE", "224"))
-    global_batch = per_chip_batch * n_chips
+    # DTPU_BENCH_SEQ=N: the sequence-parallel arm — the mesh grows a seq
+    # axis, a seq group of N chips cooperates on each batch shard (so the
+    # global batch is carried by the remaining chips), and attention runs
+    # DTPU_BENCH_SEQ_ATTN (ring|ulysses). Transformer archs only.
+    seq_n = int(os.environ.get("DTPU_BENCH_SEQ", "1") or 1)
+    global_batch = per_chip_batch * (n_chips // max(seq_n, 1))
 
-    mesh = data_mesh(-1)
+    mesh = data_mesh(-1, 1, seq_n)
     # Default arm = the shipped-best TPU recipe: bf16 BN boundaries
     # (+20% measured; statistics still f32) and the space-to-depth stem for
     # resnet/botnet families (identical math, MXU-shaped; tests prove
@@ -210,9 +220,15 @@ def main():
     kw = {"stem_s2d": True} if stem_s2d else {}
     if os.environ.get("DTPU_BENCH_REMAT", "0") == "1":
         kw["remat"] = True  # A/B arm: cost of per-block jax.checkpoint
+    task = "mae" if arch.startswith("mae_") else "classify"
+    if seq_n > 1:
+        kw["seq_axis"] = "seq"
+        kw["seq_impl"] = os.environ.get("DTPU_BENCH_SEQ_ATTN", "ring")
+        if arch.startswith("vit_"):
+            kw["pool"] = "gap"  # the class token has no home shard
     model = build_model(arch, num_classes=1000, **kw)  # bf16 trunk by default
     state, tx = create_train_state(model, jax.random.PRNGKey(0), mesh, im_size)
-    train_step = make_train_step(model, tx, mesh, topk=5)
+    train_step = make_train_step(model, tx, mesh, topk=5, task=task)
 
     batch = make_synthetic_batch(mesh, global_batch, im_size=im_size)
     lr = jnp.asarray(0.1, jnp.float32)
@@ -221,7 +237,7 @@ def main():
     if os.environ.get("DTPU_BENCH_EVAL", "0") == "1":
         _eval_bench(
             jax, make_eval_step, zero_metrics, model, mesh, state, batch,
-            arch, im_size, global_batch, n_chips, timer,
+            arch, im_size, global_batch, n_chips, timer, task,
         )
         return
 
@@ -290,11 +306,11 @@ def _print_metric(
 
 def _eval_bench(
     jax, make_eval_step, zero_metrics, model, mesh, state, batch,
-    arch, im_size, global_batch, n_chips, timer,
+    arch, im_size, global_batch, n_chips, timer, task="classify",
 ):
     """DTPU_BENCH_EVAL=1: forward-only throughput. The eval step takes and
     returns running metric totals — the cadence loop's chained carry."""
-    eval_step = make_eval_step(model, mesh, topk=5)
+    eval_step = make_eval_step(model, mesh, topk=5, task=task)
     totals = zero_metrics(5, mesh)
     for _ in range(3):  # warmup
         totals = eval_step(state, batch, totals)

@@ -11,8 +11,9 @@ failure are static:
   dict literals *assigned to a name* that the same module later passes to
   ``create_mesh`` (``data_mesh`` builds its ``('data', 'fsdp')`` axes dict
   in a variable), string tuples passed to ``Mesh(...)``/``axis_names=``,
-  string defaults of ``axis_name``/``bn_axis_name`` parameters (a library
-  function defaulting to ``"seq"`` is declaring that axis's vocabulary),
+  string defaults of ``axis_name``/``bn_axis_name``/``seq_axis`` parameters
+  (a library function defaulting to ``"seq"`` is declaring that axis's
+  vocabulary — ``seq_axis`` is the MODEL.SEQ_ATTN routing kwarg),
   and axis-vocabulary constants — ``FSDP_AXIS = "fsdp"``-style assignments
   to a name ending in ``_AXIS`` (the `parallel/fsdp.py` partition-rule
   idiom: the axis name declared in exactly one place and referenced by
@@ -55,7 +56,12 @@ _COLLECTIVES = {
     "pswapaxes",
     "psum_scatter",
 }
-_AXIS_KWARGS = {"axis_name", "bn_axis_name"}
+# seq_axis: the sequence-parallel routing kwarg (models/vit.py, models/mae.py
+# — the MODEL.SEQ_ATTN plumbing). A literal string passed there names a mesh
+# axis exactly like axis_name does, so it joins both the census (a library
+# default declares the vocabulary) and the validation (a typo'd
+# ``seq_axis="sqe"`` is a trace error on the pod, hours into a queue).
+_AXIS_KWARGS = {"axis_name", "bn_axis_name", "seq_axis"}
 
 
 def collect(tree: ast.AST, ctx, model: ModuleModel) -> None:

@@ -57,6 +57,20 @@ _C.MODEL.STEM_S2D = False
 # on-chip verdict (`scripts/soak_fused_attn.py --epilogue`); the
 # DTPU_FUSED_EPILOGUE env var overrides this knob (the bench A/B arm).
 _C.MODEL.FUSED_EPILOGUE = False
+# Sequence-parallel attention formulation once MESH.SEQ > 1 (parallel/seq.py,
+# docs/PARALLELISM.md "The seq axis"): "ring" rotates K/V blocks over the seq
+# axis (P-1 ppermute neighbor hops, any head count, O(L_local²) memory);
+# "ulysses" reshards heads↔sequence with two all-to-alls and runs dense
+# attention locally (needs heads % MESH.SEQ == 0). "none" (default) keeps the
+# dense single-device attention — invalid with MESH.SEQ > 1 (tokens would be
+# sharded with nothing stitching the attention contraction back together).
+_C.MODEL.SEQ_ATTN = "none"
+# Masked-autoencoder pretraining knobs (models/mae.py; active with
+# TRAIN.TASK "mae"): fraction of patch tokens replaced by the learned mask
+# token (SimMIM-style full-length masking — the token count stays static and
+# seq-shardable), and the width of the pixel-decoder head.
+_C.MODEL.MAE_MASK_RATIO = 0.25
+_C.MODEL.MAE_DECODER_DIM = 512
 # BatchNorm boundary dtype: what dtype BN *emits* between conv stages.
 # Statistics are always computed in float32 and running stats/affine params
 # always stored float32; "bfloat16" halves inter-stage HBM traffic (the
@@ -79,6 +93,11 @@ _C.TRAIN.WORKERS = 4
 _C.TRAIN.PIN_MEMORY = True  # kept for CLI compat; maps to device prefetch
 _C.TRAIN.PRINT_FREQ = 30
 _C.TRAIN.TOPK = 5
+# Training task: "classify" (softmax-CE on labels — the reference's only
+# task) or "mae" (masked-autoencoder pixel reconstruction, models/mae.py:
+# patch-masking in the input path, pixel MSE on masked patches; labels ride
+# along unused). "mae" is the large-L workload that exercises MESH.SEQ.
+_C.TRAIN.TASK = "classify"
 # TPU additions
 _C.TRAIN.PREFETCH = 2  # batches prefetched to device HBM ahead of compute
 # synthetic samples per DUMMY_INPUT epoch (reference DummyDataset length,
@@ -158,6 +177,16 @@ _C.MESH.FSDP = 1
 # stay replicated (BN scales, biases — sharding them saves ~nothing and costs
 # a collective). The census of what sharded is logged and journaled.
 _C.MESH.FSDP_MIN_SIZE = 16384
+# Sequence parallelism (parallel/seq.py, docs/PARALLELISM.md): >1 appends a
+# trailing 'seq' axis to the training mesh and shards ACTIVATIONS along the
+# token dimension — each seq-group device holds L/SEQ tokens (the journaled
+# activation_bytes census is the measured 1/SEQ claim) and the attention
+# contraction runs as MODEL.SEQ_ATTN (ring or Ulysses). The batch replicates
+# along seq (a group cooperates on one shard), so global batch =
+# BATCH_SIZE × DATA × FSDP, unchanged by SEQ. Must divide the model's token
+# count (and the head count, for ulysses); requires a BatchNorm-free
+# transformer arch (vit_*/mae_*). No -1 wildcard.
+_C.MESH.SEQ = 1
 
 # Dataplane (TPU addition; docs/DATA.md). `dtpu-dataplane --cfg ...` runs a
 # disaggregated input service — a dispatcher owning the seed+epoch-keyed

@@ -462,6 +462,12 @@ def _convert_vit(sd: Dict[str, np.ndarray]) -> dict:
 def convert_state_dict(state_dict: Mapping[str, Any], arch: str) -> dict:
     """torch state_dict → ``{"params": ..., "batch_stats": ...}`` numpy trees."""
     sd = _unwrap(state_dict)
+    if arch.startswith("mae_"):
+        raise ValueError(
+            f"{arch} has no torch counterpart to convert from: MAE "
+            "pretraining (models/mae.py) is a from-scratch workload — load "
+            "dtpu checkpoints directly (MODEL.WEIGHTS)"
+        )
     if arch == "botnet50":
         return _convert_botnet50(sd)
     if arch.startswith("vit"):
@@ -697,6 +703,11 @@ def export_state_dict(variables: Mapping, arch: str) -> Dict[str, np.ndarray]:
     buffers are not emitted — pass ``strict=False`` to ``load_state_dict``
     or backfill zeros if the target module carries them.
     """
+    if arch.startswith("mae_"):
+        raise ValueError(
+            f"{arch} has no torch-layout schema to export to (no published "
+            "torch counterpart); ship the dtpu checkpoint itself"
+        )
     if arch.startswith("vit"):
         return _export_vit(variables)
     mod_inv = _family_inverse(arch)

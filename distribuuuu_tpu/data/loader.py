@@ -440,17 +440,27 @@ class DummyLoader:
 
 
 def _topology(mesh=None):
-    """(process_index, process_count, local devices, global devices) — from
-    the mesh actually being trained on when given, so a submesh run (elastic
-    resume onto fewer devices than the host has, `runtime.mesh.data_mesh`)
-    sizes its host batches by the mesh, not the whole fleet."""
+    """(process_index, process_count, local BATCH devices, global BATCH
+    devices) — from the mesh actually being trained on when given, so a
+    submesh run (elastic resume onto fewer devices than the host has,
+    `runtime.mesh.data_mesh`) sizes its host batches by the mesh, not the
+    whole fleet. Devices along a ``seq`` axis cooperate on ONE batch shard
+    (`parallel/seq.py`), so the counts divide out the seq extent — the host
+    batch is sized by the distinct shards this host feeds, and the batch
+    replicates along seq at `prefetch_to_device` (whose sharding spec never
+    names the seq axis)."""
     if mesh is None:
         return jax.process_index(), jax.process_count(), jax.local_device_count(), jax.device_count()
+    if "seq" in mesh.axis_names:
+        local_seq = max(int(mesh.local_mesh.shape["seq"]), 1)
+        global_seq = max(int(mesh.shape["seq"]), 1)
+    else:
+        local_seq = global_seq = 1
     return (
         jax.process_index(),
         jax.process_count(),
-        int(mesh.local_mesh.devices.size),
-        int(mesh.devices.size),
+        int(mesh.local_mesh.devices.size) // local_seq,
+        int(mesh.devices.size) // global_seq,
     )
 
 

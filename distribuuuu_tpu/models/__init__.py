@@ -1,6 +1,6 @@
 """Model zoo: registry + all families, imported for registration side effects."""
 
 from distribuuuu_tpu.models.registry import build_model, list_models, register_model
-from distribuuuu_tpu.models import botnet, densenet, efficientnet, regnet, resnet, vit  # noqa: F401
+from distribuuuu_tpu.models import botnet, densenet, efficientnet, mae, regnet, resnet, vit  # noqa: F401
 
 __all__ = ["build_model", "list_models", "register_model"]

@@ -1,0 +1,138 @@
+"""Masked-autoencoder ViT — the large-L pretraining workload (TRAIN.TASK mae).
+
+Every model trained before this one runs at L≈196–197 tokens; this family is
+the workload where the scale machinery earns its keep: at 448px/patch-16 the
+encoder runs L=784 tokens end-to-end — the regime the sequence-parallel axis
+(`parallel/seq.py`, cfg.MESH.SEQ) and the blockwise fused-attention kernels
+(`ops/attention.py`) exist for.
+
+Formulation: SimMIM-style masked image modeling (Xie et al., 2022) rather
+than the encoder-drops-tokens MAE (He et al., 2021) — masked patches are
+REPLACED by a learned mask token and the encoder runs the full static token
+count. That choice is deliberate for this framework: a static L keeps every
+shape compile-stable (CompileGuard-exact steady state) and makes the token
+dimension uniformly shardable over the seq axis — the drop-token variant
+would shuffle a data-dependent token subset across seq shards. The loss is
+per-patch pixel MSE on the MASKED patches only (`trainer._forward_loss_mae`).
+
+Sequence-parallel contract (matches `models/vit.py`): embedding + masking +
+positions run redundantly per seq member on the full token stream (one cheap
+matmul), the member's shard is sliced (`parallel.seq.local_tokens` — the
+transpose keeps param grads partial), the encoder runs ring/Ulysses
+attention, and the pixel-decoder head is purely per-token — so EVERY
+parameter gradient is member-partial and the trainer's uniform seq-axis psum
+is exact. There is no pooling and no classifier: nothing replicated ever
+consumes a post-collective value.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distribuuuu_tpu.models.registry import register_model
+from distribuuuu_tpu.models.vit import encode_tokens, trunc_normal_02, xavier_uniform
+
+
+def patchify(images: jnp.ndarray, patch: int) -> jnp.ndarray:
+    """``[B, H, W, C] -> [B, L, patch²·C]`` in the patch-conv's token order
+    (row-major over the (H/p, W/p) grid) — the reconstruction target."""
+    b, h, w, c = images.shape
+    gh, gw = h // patch, w // patch
+    x = images.reshape(b, gh, patch, gw, patch, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, gh * gw, patch * patch * c)
+
+
+class MAEViT(nn.Module):
+    """Patch embed → mask-token substitution → ViT encoder → pixel decoder.
+
+    ``__call__(x, mask=None, train=False)``: ``mask`` is a ``[B, L]`` bool
+    (True = masked) minted by the trainer from the step RNG; ``None`` runs
+    unmasked (init/eval-shape convenience). Returns per-token pixel
+    predictions ``[B, L(_local), patch²·3]`` in float32 — the loss lives in
+    the trainer, next to its seq-axis reductions.
+
+    ``num_classes``/``bn_axis_name`` are accepted for the `build_model`
+    contract and ignored (pixel head, no BN).
+    """
+
+    patch: int = 16
+    dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    decoder_dim: int = 512
+    num_classes: int = 0  # build_model contract only; the head emits pixels
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    bn_axis_name: str | None = None  # no BN; build_model contract only
+    seq_axis: str | None = None
+    seq_impl: str = "ring"
+
+    @nn.compact
+    def __call__(
+        self, x: jnp.ndarray, mask: jnp.ndarray | None = None, train: bool = False
+    ) -> jnp.ndarray:
+        x = nn.Conv(
+            self.dim, (self.patch, self.patch),
+            strides=(self.patch, self.patch), padding="VALID",
+            dtype=self.dtype, param_dtype=jnp.float32,
+            kernel_init=trunc_normal_02, name="patch_embed",
+        )(x.astype(self.dtype))
+        b = x.shape[0]
+        x = x.reshape(b, -1, self.dim)
+        # the mask token is created unconditionally so init (mask=None) and
+        # the masked train forward share one parameter inventory
+        mask_token = self.param("mask_token", trunc_normal_02, (1, 1, self.dim), jnp.float32)
+        if mask is not None:
+            m = mask.astype(x.dtype)[..., None]
+            x = x * (1.0 - m) + mask_token.astype(x.dtype) * m
+        pos = self.param(
+            "pos_embed", trunc_normal_02, (1, x.shape[1], self.dim), jnp.float32
+        )
+        x = x + pos.astype(x.dtype)
+
+        if self.seq_axis is not None:
+            from distribuuuu_tpu.parallel.seq import local_tokens
+
+            x = local_tokens(x, self.seq_axis)
+
+        x = encode_tokens(
+            x, depth=self.depth, num_heads=self.num_heads, mlp_dim=self.mlp_dim,
+            dtype=self.dtype, remat=self.remat,
+            seq_axis=self.seq_axis, seq_impl=self.seq_impl,
+        )
+
+        # pixel decoder: per-token, so it is seq-local by construction
+        h = nn.Dense(
+            self.decoder_dim, dtype=self.dtype, param_dtype=jnp.float32,
+            kernel_init=xavier_uniform, name="dec_fc",
+        )(x)
+        h = nn.gelu(h, approximate=False)
+        return nn.Dense(
+            self.patch * self.patch * 3, dtype=jnp.float32, param_dtype=jnp.float32,
+            kernel_init=xavier_uniform, name="dec_pred",
+        )(h)
+
+
+def _mae(patch, dim, depth, heads, mlp, **kw) -> MAEViT:
+    kw.pop("zero_init_residual", None)  # resnet-family knob; meaningless here
+    return MAEViT(patch=patch, dim=dim, depth=depth, num_heads=heads, mlp_dim=mlp, **kw)
+
+
+@register_model("mae_vit_s16")
+def mae_vit_s16(**kw):
+    return _mae(16, 384, 12, 6, 1536, **kw)
+
+
+@register_model("mae_vit_b16")
+def mae_vit_b16(**kw):
+    return _mae(16, 768, 12, 12, 3072, **kw)
+
+
+@register_model("mae_vit_l16")
+def mae_vit_l16(**kw):
+    return _mae(16, 1024, 24, 16, 4096, **kw)

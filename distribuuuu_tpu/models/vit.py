@@ -71,10 +71,10 @@ class MultiHeadSelfAttention(nn.Module):
         q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))  # [B,H,L,hd]
 
         if self.seq_axis is not None:
-            from distribuuuu_tpu.parallel import ring_attention, ulysses_attention
+            from distribuuuu_tpu.parallel.seq import seq_attention
 
-            attn = ring_attention if self.seq_impl == "ring" else ulysses_attention
-            out = attn(q, k, v, axis_name=self.seq_axis)  # scales internally
+            # MODEL.SEQ_ATTN routes here; scales internally
+            out = seq_attention(q, k, v, impl=self.seq_impl, axis_name=self.seq_axis)
         else:
             scale = head_dim**-0.5
             s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
@@ -143,11 +143,22 @@ class ViT(nn.Module):
     dtype: Any = jnp.bfloat16
     remat: bool = False
     bn_axis_name: str | None = None  # no BN in ViT; build_model contract only
+    # Sequence-parallel execution (cfg.MESH.SEQ > 1, inside shard_map):
+    # tokens are embedded redundantly per seq member, sliced to the local
+    # shard, and the encoder runs with ring/Ulysses attention. Requires
+    # pool='gap' (a broadcast class token has no single home shard).
+    seq_axis: str | None = None
+    seq_impl: str = "ring"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         if self.pool not in ("token", "gap"):
             raise ValueError(f"pool must be 'token' or 'gap', got {self.pool!r}")
+        if self.seq_axis is not None and self.pool != "gap":
+            raise ValueError(
+                "sequence-parallel ViT requires pool='gap': the class token "
+                "has no home shard once tokens shard over the seq axis"
+            )
         # [B, H, W, 3] -> [B, L, D]: non-overlapping patch conv (one big
         # [B·L, 3p²]×[3p², D] matmul after XLA's im2col — pure MXU work).
         x = nn.Conv(
@@ -166,19 +177,46 @@ class ViT(nn.Module):
         )
         x = x + pos.astype(x.dtype)
 
+        if self.seq_axis is not None:
+            # embedding ran redundantly per seq member (one cheap matmul);
+            # slice the local token shard — the slice transpose zero-pads, so
+            # patch-embed/pos grads stay PARTIAL and psum over seq is exact
+            from distribuuuu_tpu.parallel.seq import local_tokens
+
+            x = local_tokens(x, self.seq_axis)
+
         x = encode_tokens(
             x, depth=self.depth, num_heads=self.num_heads, mlp_dim=self.mlp_dim,
             dtype=self.dtype, remat=self.remat,
+            seq_axis=self.seq_axis, seq_impl=self.seq_impl,
         )
 
+        head = nn.Dense(
+            self.num_classes, dtype=jnp.float32, param_dtype=jnp.float32,
+            kernel_init=nn.initializers.zeros, name="head",
+        )
+        if self.seq_axis is not None:
+            # Partial-sum pooling + the bias-1/P head: every parameter's
+            # contribution stays member-partial so the trainer's uniform
+            # seq-axis grad psum is exact. logits_i = W·(Σ_local x)/L + b/P
+            # (the second head call contributes only -b·(P-1)/P — no W use),
+            # and Σ_i logits_i = W·mean(x) + b, the dense head exactly. The
+            # sum is psum_partial — partial values under a replicated
+            # cotangent (parallel/seq.py), so grads stay exact partials.
+            from distribuuuu_tpu.parallel.seq import psum_partial
+
+            p = jax.lax.axis_size(self.seq_axis)
+            l_global = x.shape[1] * p
+            rep_partial = jnp.sum(x.astype(jnp.float32), axis=1) / l_global
+            logits_partial = head(rep_partial) - (1.0 - 1.0 / p) * head(
+                jnp.zeros_like(rep_partial)
+            )
+            return psum_partial(logits_partial, self.seq_axis)
         if self.pool == "token":
             rep = x[:, 0].astype(jnp.float32)
         else:
             rep = jnp.mean(x, axis=1, dtype=jnp.float32)
-        return nn.Dense(
-            self.num_classes, dtype=jnp.float32, param_dtype=jnp.float32,
-            kernel_init=nn.initializers.zeros, name="head",
-        )(rep)
+        return head(rep)
 
 
 def encode_tokens(

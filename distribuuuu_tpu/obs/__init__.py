@@ -40,7 +40,7 @@ from distribuuuu_tpu.obs.journal import (  # noqa: F401
     validate_journal,
     validate_record,
 )
-from distribuuuu_tpu.obs.memory import state_bytes  # noqa: F401
+from distribuuuu_tpu.obs.memory import activation_bytes, state_bytes  # noqa: F401
 from distribuuuu_tpu.obs.monitors import MonitoringBridge  # noqa: F401
 from distribuuuu_tpu.obs.profiler import (  # noqa: F401
     ProfilerWindows,

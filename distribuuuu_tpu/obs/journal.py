@@ -492,6 +492,22 @@ SCHEMA: dict[str, tuple[dict[str, tuple], dict[str, tuple]]] = {
             "bn_global_bytes": _INT,
         },
     ),
+    # per-device encoder activation-byte census (priced from token geometry
+    # — obs/memory.activation_bytes): the journaled 1/seq claim for the
+    # sequence-parallel axis, the activation twin of state_bytes
+    "activation_bytes": (
+        {
+            "seq": _INT,
+            "l_global": _INT,
+            "l_local": _INT,
+            "depth": _INT,
+            "dim": _INT,
+            "batch_per_device": _INT,
+            "token_bytes": _INT,
+            "token_global_bytes": _INT,
+        },
+        {},
+    ),
     "profile": (
         {"gstep": _INT, "steps": _INT, "logdir": _STR},
         {"device_ms_per_step": _NUM_OR_NONE, "top_ops": _LIST, "trigger": _STR},

@@ -78,6 +78,45 @@ def state_bytes(state, fsdp: int = 1) -> dict:
     return out
 
 
+def activation_bytes(
+    *,
+    batch_per_device: int,
+    l_global: int,
+    seq: int = 1,
+    dim: int,
+    depth: int,
+    mlp_dim: int,
+    dtype_bytes: int = 2,
+) -> dict:
+    """Per-device encoder activation-byte census: the seq-axis twin of
+    `state_bytes` — the priced 1/seq claim (`parallel/seq.py`), journaled as
+    a typed ``activation_bytes`` record at state creation.
+
+    Prices the O(B·L·D) per-block token tensors the backward pass holds
+    live (qkv + attention out + the two residual/LN streams + the MLP
+    hidden ≈ ``6·dim + mlp_dim`` floats per token per block) — the terms
+    that dominate transformer activation memory at large L and the ones the
+    seq axis divides by P. Attention's O(L²) weights are deliberately
+    excluded: the ring/blockwise paths never materialize them. This is a
+    deterministic PRICE; the allocator's per-epoch ``memory`` snapshots
+    (``peak_bytes_in_use``) are the on-chip measured complement.
+    """
+    seq = max(int(seq), 1)
+    l_local = int(l_global) // seq
+    per_block = int(batch_per_device) * l_local * (6 * int(dim) + int(mlp_dim))
+    token_bytes = int(depth) * per_block * int(dtype_bytes)
+    return {
+        "seq": seq,
+        "l_global": int(l_global),
+        "l_local": l_local,
+        "depth": int(depth),
+        "dim": int(dim),
+        "batch_per_device": int(batch_per_device),
+        "token_bytes": token_bytes,
+        "token_global_bytes": token_bytes * seq,
+    }
+
+
 def snapshot() -> dict:
     """``{live_arrays, live_bytes, per_device}`` for this process.
 

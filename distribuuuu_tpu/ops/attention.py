@@ -11,7 +11,11 @@ the fwd+bwd soak, and botnet50 end-to-end 1545 vs 1834 img/s. At L~196 the
 L×L intermediates are small enough that XLA's emitter already keeps them
 close to the MXU; the hand kernel's per-tile grid overhead costs more than
 the HBM traffic it saves. The kernel stays as an opt-in (DTPU_FUSED_ATTN=1)
-for larger-L regimes where the O(L²) HBM round-trip argument regains force.
+for larger-L regimes where the O(L²) HBM round-trip argument regains force —
+and past the single-tile VMEM budget the dispatch now re-tiles to the
+BLOCKWISE online-softmax kernels below (O(block²) per tile), so L≥1024 runs
+in-kernel instead of falling back; the large-L flip/keep verdict comes from
+`scripts/soak_fused_attn.py --seq` (docs/PERFORMANCE.md "Large-L kernels").
 
 Training support: `fused_attention` is a `jax.custom_vjp`. The forward is the
 Pallas kernel; the backward recomputes the attention weights with XLA einsums
@@ -34,22 +38,64 @@ from jax.experimental import pallas as pl
 
 from distribuuuu_tpu.ops.vmem_guard import VmemBudgetGuard
 
-# VMEM-budget guard: the kernels keep a whole (batch·head) tile resident, so
-# per-tile footprint grows O(L²) — past ~16 MB/core the Mosaic compile fails
-# with an opaque allocation error deep in the serve/train stack. Estimate the
-# footprint up front and fall back to the XLA path with ONE warning per shape
-# instead (the fallback is exactly the code XLA already wins with at small L).
+# VMEM-budget guard: the single-tile kernels keep a whole (batch·head) tile
+# resident, so per-tile footprint grows O(L²) — past ~16 MB/core the Mosaic
+# compile fails with an opaque allocation error deep in the serve/train
+# stack. Past the single-tile budget the dispatch RE-TILES to the blockwise
+# (flash-style online-softmax) kernels below, whose per-tile footprint is
+# O(block²) — so L=1024+ runs in-kernel instead of falling back (the large-L
+# regime the kernel was kept for, docs/PERFORMANCE.md). Only when no block
+# size divides L does the guard count a fallback to the XLA path, with ONE
+# warning per shape.
 _VMEM_GUARD = VmemBudgetGuard("DTPU_ATTN_VMEM_BUDGET_MB")
+
+# Blockwise tile bounds: blocks are divisors of L (padding a remainder
+# block would complicate the bias tiling), sublane-aligned (multiples of 8
+# — Mosaic tiles f32 as (8, 128)), and capped at 512 so the per-tile
+# softmax intermediates stay small. Divisor-based, not a fixed candidate
+# list: the patch-grid token counts this exists for (784 at 448px/16 →
+# block 392, 1024 → block 512) are not all powers of two.
+_BLOCK_MAX = 512
+_BLOCK_ALIGN = 8
 
 
 def _tile_vmem_bytes(l: int, d: int, dv: int, itemsize: int, bias_input: bool) -> int:
-    """Per-tile VMEM estimate: in/out blocks double-buffered by the grid
+    """Single-tile VMEM estimate: in/out blocks double-buffered by the grid
     pipeline, plus the f32 [L, L] logits/exp intermediates the softmax holds."""
     inputs = 2 * l * d * itemsize + l * dv * itemsize  # q, k, v tiles
     inputs += l * l * 4 if bias_input else l * d * itemsize  # bias | emb table
     output = l * dv * itemsize
     intermediates = 2 * l * l * 4  # logits + exp, f32
     return 2 * (inputs + output) + intermediates
+
+
+def _tile_vmem_bytes_blockwise(
+    bq: int, bk: int, d: int, dv: int, itemsize: int, bias_input: bool
+) -> int:
+    """Blockwise-tile VMEM estimate: the softmax intermediates are priced at
+    the [bq, bk] BLOCK, not the full [L, L] — the fix for the guard's
+    over-refusal at large L (it used to price full f32 L² and refuse shapes
+    the re-tiled kernel runs comfortably)."""
+    inputs = bq * d * itemsize + bk * d * itemsize + bk * dv * itemsize
+    inputs += bq * bk * 4 if bias_input else bk * d * itemsize  # bias | emb blk
+    # f32 accumulator + the m/l online-softmax rows, revisited across k steps
+    outputs = bq * dv * 4 + 2 * bq * 4
+    intermediates = 2 * bq * bk * 4  # s + exp(s), f32
+    return 2 * (inputs + outputs) + intermediates
+
+
+def _pick_block(l: int, d: int, dv: int, itemsize: int, bias_input: bool):
+    """Largest sublane-aligned divisor of L (≥2 blocks, ≤ _BLOCK_MAX) whose
+    blockwise estimate fits the budget; None when the shape can't re-tile
+    (→ XLA fallback)."""
+    budget = _VMEM_GUARD.budget_bytes()
+    start = min(_BLOCK_MAX, l // 2)
+    start -= start % _BLOCK_ALIGN  # walk aligned values only
+    for b in range(start, _BLOCK_ALIGN - 1, -_BLOCK_ALIGN):
+        if l % b == 0:
+            if _tile_vmem_bytes_blockwise(b, b, d, dv, itemsize, bias_input) <= budget:
+                return b
+    return None
 
 
 def _within_vmem_budget(kind: str, l: int, d: int, dv: int, itemsize: int,
@@ -156,21 +202,189 @@ def _bwd(interpret, res, g):
 _fused_attention.defvjp(_fwd, _bwd)
 
 
+# ---------------------------------------------------------------------------
+# Blockwise (flash-style) variant: the large-L re-tiling
+# ---------------------------------------------------------------------------
+#
+# Grid (batch·head, q-block, k-block) with the k dimension innermost: TPU
+# grids execute sequentially, so the f32 accumulator and the online-softmax
+# m/l rows live in revisited output blocks (their index maps ignore ki) and
+# carry across k steps. Per-tile footprint is O(block²) where the single-tile
+# kernel is O(L²) — at L=1024 the single-tile estimate blows the 12 MB budget
+# ~20x while a 512-block tile fits with room to spare. The backward is the
+# same XLA flash-style recompute as the single-tile kernels (math-identical
+# logits, so one VJP serves both tilings).
+
+
+def _attn_kernel_blk(q_ref, k_ref, v_ref, bias_ref, o_ref, m_ref, l_ref):
+    """One (bn, q-block, k-block) step: online-softmax accumulate in f32."""
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, -jnp.inf, m_ref.dtype)
+        l_ref[...] = jnp.zeros(l_ref.shape, l_ref.dtype)
+        o_ref[...] = jnp.zeros(o_ref.shape, o_ref.dtype)
+
+    q = q_ref[0]  # [bq, D]
+    k = k_ref[0]  # [bk, D]
+    v = v_ref[0]  # [bk, Dv]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) + bias_ref[0]
+    m_prev = m_ref[0]  # [bq, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)  # first step: exp(-inf - finite) = 0
+    l_ref[0] = l_ref[0] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    o_ref[0] = o_ref[0] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[0] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        o_ref[0] = o_ref[0] / l_ref[0]
+
+
+def _attn_kernel_abs_blk(q_ref, k_ref, v_ref, emb_ref, o_ref, m_ref, l_ref):
+    """Blockwise abs variant: the bias block is q·emb_blkᵀ, formed in-kernel
+    from the [bk, D] slice of the shared table (never materialized in HBM)."""
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, -jnp.inf, m_ref.dtype)
+        l_ref[...] = jnp.zeros(l_ref.shape, l_ref.dtype)
+        o_ref[...] = jnp.zeros(o_ref.shape, o_ref.dtype)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    emb = emb_ref[...]  # [bk, D] block of the table
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) + jax.lax.dot_general(
+        q, emb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_prev = m_ref[0]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_ref[0] = l_ref[0] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    o_ref[0] = o_ref[0] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[0] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        o_ref[0] = o_ref[0] / l_ref[0]
+
+
+def _fused_fwd_blk_impl(q, k, v, bias_or_emb, block, *, abs_table: bool,
+                        interpret: bool = False):
+    b, n, l, d = q.shape
+    dv = v.shape[-1]
+    nq = nk = l // block
+    qf = q.reshape(b * n, l, d)
+    kf = k.reshape(b * n, l, d)
+    vf = v.reshape(b * n, l, dv)
+    if abs_table:
+        kernel = _attn_kernel_abs_blk
+        last_in = bias_or_emb.astype(q.dtype)  # [L, D] table
+        last_spec = pl.BlockSpec((block, d), lambda i, qi, ki: (ki, 0))
+    else:
+        kernel = _attn_kernel_blk
+        last_in = bias_or_emb.astype(jnp.float32).reshape(b * n, l, l)
+        last_spec = pl.BlockSpec((1, block, block), lambda i, qi, ki: (i, qi, ki))
+    out, _, _ = pl.pallas_call(
+        kernel,
+        grid=(b * n, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block, d), lambda i, qi, ki: (i, qi, 0)),
+            pl.BlockSpec((1, block, d), lambda i, qi, ki: (i, ki, 0)),
+            pl.BlockSpec((1, block, dv), lambda i, qi, ki: (i, ki, 0)),
+            last_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block, dv), lambda i, qi, ki: (i, qi, 0)),
+            pl.BlockSpec((1, block, 1), lambda i, qi, ki: (i, qi, 0)),
+            pl.BlockSpec((1, block, 1), lambda i, qi, ki: (i, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * n, l, dv), jnp.float32),
+            jax.ShapeDtypeStruct((b * n, l, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * n, l, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, last_in)
+    return out.astype(q.dtype).reshape(b, n, l, dv)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _fused_attention_blk(q, k, v, bias, block, interpret=False):
+    return _fused_fwd_blk_impl(q, k, v, bias, block, abs_table=False,
+                               interpret=interpret)
+
+
+def _blk_fwd(q, k, v, bias, block, interpret):
+    out = _fused_fwd_blk_impl(q, k, v, bias, block, abs_table=False,
+                              interpret=interpret)
+    return out, (q, k, v, bias)
+
+
+def _blk_bwd(block, interpret, res, g):
+    return _bwd(interpret, res, g)  # identical logits → identical gradients
+
+
+_fused_attention_blk.defvjp(_blk_fwd, _blk_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _fused_attention_abs_blk(q, k, v, emb, block, interpret=False):
+    return _fused_fwd_blk_impl(q, k, v, emb, block, abs_table=True,
+                               interpret=interpret)
+
+
+def _abs_blk_fwd(q, k, v, emb, block, interpret):
+    out = _fused_fwd_blk_impl(q, k, v, emb, block, abs_table=True,
+                              interpret=interpret)
+    return out, (q, k, v, emb)
+
+
+def _abs_blk_bwd(block, interpret, res, g):
+    return _abs_bwd(interpret, res, g)
+
+
+_fused_attention_abs_blk.defvjp(_abs_blk_fwd, _abs_blk_bwd)
+
+
 def fused_attention(q, k, v, bias, *, interpret: bool = False):
     """softmax(q·kᵀ + bias)·v, fused on TPU; differentiable.
 
     q is expected pre-scaled (matching the reference, `botnet.py:205`).
-    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU tests).
-    A tile too large for VMEM falls back to `xla_attention` with a one-time
-    warning instead of failing opaquely inside Mosaic at large L.
+    ``interpret=True`` runs the kernels in the Pallas interpreter (CPU
+    tests). Dispatch by VMEM footprint: the single-tile kernel where the
+    whole (batch·head) tile fits the budget (the measured small-L path,
+    unchanged), the blockwise online-softmax kernel where it doesn't but a
+    block size divides L (the large-L regime — L=1024 fits the default
+    12 MB budget re-tiled), and the XLA path — with a one-time warning —
+    only when no tiling works.
     """
     l, d = q.shape[-2], q.shape[-1]
-    if not _within_vmem_budget(
-        "fused_attention", l, d, v.shape[-1],
-        np.dtype(q.dtype).itemsize, bias_input=True,
-    ):
-        return xla_attention(q, k, v, bias)
-    return _fused_attention(q, k, v, bias, interpret)
+    dv, itemsize = v.shape[-1], np.dtype(q.dtype).itemsize
+    if _tile_vmem_bytes(l, d, dv, itemsize, True) <= _VMEM_GUARD.budget_bytes():
+        return _fused_attention(q, k, v, bias, interpret)
+    block = _pick_block(l, d, dv, itemsize, True)
+    if block is not None:
+        return _fused_attention_blk(q, k, v, bias, block, interpret)
+    _within_vmem_budget("fused_attention", l, d, dv, itemsize, bias_input=True)
+    return xla_attention(q, k, v, bias)
 
 
 # ---------------------------------------------------------------------------
@@ -268,19 +482,23 @@ _fused_attention_abs.defvjp(_abs_fwd, _abs_bwd)
 def fused_attention_abs(q, k, v, emb, *, interpret: bool = False):
     """softmax(q·kᵀ + q·embᵀ)·v with the [L, D] position table applied
     in-kernel; differentiable (incl. d/d emb). q pre-scaled, as above.
-    Over the VMEM budget the fallback is the XLA composition — which
-    *materializes* the [B, N, L, L] bias product the kernel exists to avoid,
-    but runs (the one-time warning says what it costs)."""
+    Dispatch mirrors `fused_attention`: single-tile → blockwise (the bias
+    block is formed from the table slice in-kernel, so large L never
+    materializes the [B, N, L, L] product) → XLA composition — which DOES
+    materialize that product, but runs (the one-time warning says what it
+    costs)."""
     l, d = q.shape[-2], q.shape[-1]
-    if not _within_vmem_budget(
-        "fused_attention_abs", l, d, v.shape[-1],
-        np.dtype(q.dtype).itemsize, bias_input=False,
-    ):
-        return xla_attention(
-            q, k, v,
-            jnp.einsum(
-                "bnid,jd->bnij", q, emb.astype(q.dtype),
-                preferred_element_type=jnp.float32,
-            ),
-        )
-    return _fused_attention_abs(q, k, v, emb, interpret)
+    dv, itemsize = v.shape[-1], np.dtype(q.dtype).itemsize
+    if _tile_vmem_bytes(l, d, dv, itemsize, False) <= _VMEM_GUARD.budget_bytes():
+        return _fused_attention_abs(q, k, v, emb, interpret)
+    block = _pick_block(l, d, dv, itemsize, False)
+    if block is not None:
+        return _fused_attention_abs_blk(q, k, v, emb, block, interpret)
+    _within_vmem_budget("fused_attention_abs", l, d, dv, itemsize, bias_input=False)
+    return xla_attention(
+        q, k, v,
+        jnp.einsum(
+            "bnid,jd->bnij", q, emb.astype(q.dtype),
+            preferred_element_type=jnp.float32,
+        ),
+    )

@@ -24,9 +24,14 @@ building blocks that extend the same mesh design to other axes:
   axis — shape-pure partition rules, all-gather-on-use parameters (whose
   autodiff transpose is the grad reduce-scatter), shard-resident optimizer
   updates; composes with the data axis (cfg.MESH.FSDP).
+- `seq`: the ``seq`` axis as a first-class TRAINING axis (cfg.MESH.SEQ):
+  token-dim activation partition rules (the SNIPPETS [3] ``"seq"`` TODO
+  answered), the local-token slice whose transpose keeps param grads
+  partial, and the ring/Ulysses dispatcher `MODEL.SEQ_ATTN` routes through;
+  composes with ``data`` and ``fsdp``.
 """
 
-from distribuuuu_tpu.parallel import fsdp
+from distribuuuu_tpu.parallel import fsdp, seq
 from distribuuuu_tpu.parallel.collectives import (
     barrier,
     pmean_tree,
@@ -40,6 +45,7 @@ from distribuuuu_tpu.parallel.ulysses import ulysses_attention
 
 __all__ = [
     "fsdp",
+    "seq",
     "barrier",
     "pmean_tree",
     "scaled_all_reduce",

@@ -1,0 +1,161 @@
+"""Sequence parallelism over a ``seq`` mesh axis — partition rules + helpers.
+
+The attention formulations themselves live next door (`ring_attention.py`,
+`ulysses.py`); this module is the part that makes them a *training axis*
+instead of orphan primitives: the axis vocabulary, the shape-pure partition
+rules that shard the **token dimension of activations** (the answer to
+SNIPPETS [3]'s ``"seq": None  # TODO: Can we use sequence parallel?``), and
+the under-shard_map helpers the models/trainer compose.
+
+Design (docs/PARALLELISM.md "The ``seq`` axis"):
+
+- **What shards**: activations along their token dimension — each device in
+  a seq group holds ``L/P`` tokens, so per-device activation memory is 1/P
+  (the journaled ``activation_bytes`` census is the measured claim). Params
+  and optimizer state stay replicated over ``seq`` (compose with the
+  ``fsdp`` axis to shard those).
+- **What replicates**: the batch. A seq group of P devices cooperates on ONE
+  batch shard; the batch-bearing device count is ``mesh_size / P``
+  (`batch_device_count`), which is what the loader and the samples-per-step
+  accounting size by.
+- **Gradient contract**: the model's seq path keeps every parameter use
+  *partial* — each member's grads reflect only its token shard (embeddings
+  are computed redundantly but sliced, so non-local token grads are zero;
+  the classifier head applies the bias-1/P trick, `models/vit.py`). The full
+  gradient is therefore a plain ``psum`` over the seq axis, which the train
+  step inserts before the data/fsdp reductions (`trainer.make_train_step`).
+- **Randomness contract**: seq members of one group MUST share their RNG
+  stream (they process the same samples — e.g. the MAE mask must agree), so
+  the per-device fold excludes the seq index.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+# The axis name everything sequence-parallel shards over — declared in
+# exactly one place, like parallel/fsdp.FSDP_AXIS (the DT005 axis census
+# reads the vocabulary from this constant).
+SEQ_AXIS = "seq"
+
+
+def seq_size(mesh: Mesh) -> int:
+    """Size of the mesh's seq axis (1 when the mesh doesn't declare one)."""
+    if SEQ_AXIS not in mesh.axis_names:
+        return 1
+    return int(mesh.shape[SEQ_AXIS])
+
+
+def batch_device_count(mesh: Mesh) -> int:
+    """Devices carrying DISTINCT batch shards: the mesh minus the seq axis.
+
+    A seq group cooperates on one batch shard, so global batch, loader host
+    batches and samples-per-step all size by this, not by ``devices.size``.
+    """
+    return int(mesh.devices.size) // seq_size(mesh)
+
+
+def token_spec(rank: int, *, token_dim: int = 1, batch_axes=None) -> P:
+    """The activation partition rule: shard ``token_dim`` over the seq axis.
+
+    Shape-pure (a function of rank/dims only, like `fsdp.partition_spec`).
+    ``batch_axes`` ("data" or ("data", "fsdp")) optionally shards dim 0 —
+    the composed ``data×fsdp×seq`` layout for a [B, L, D] token stream is
+    ``token_spec(3, batch_axes=("data", "fsdp")) == P(('data','fsdp'),
+    'seq', None)``; a [B, H, L, D] attention head layout is
+    ``token_spec(4, token_dim=2)``. This is the rule SNIPPETS [3]'s
+    partition table left as ``"seq": None  # TODO``.
+    """
+    if not 0 <= token_dim < rank:
+        raise ValueError(f"token_dim {token_dim} out of range for rank {rank}")
+    entries: list = [None] * rank
+    if batch_axes is not None:
+        if token_dim == 0:
+            raise ValueError("token_dim 0 cannot also carry the batch axes")
+        entries[0] = batch_axes
+    entries[token_dim] = SEQ_AXIS
+    return P(*entries)
+
+
+def local_tokens(x: jnp.ndarray, axis_name: str = SEQ_AXIS, dim: int = 1):
+    """This member's token shard of a replicated token tensor (inside
+    shard_map): block ``i`` of ``P`` equal blocks along ``dim``.
+
+    The embedding path computes the full token stream redundantly per seq
+    member (one cheap matmul) and slices here; the slice's autodiff
+    transpose zero-pads, so upstream parameter grads are *partial* — exactly
+    the contract the trainer's seq-axis ``psum`` completes.
+    """
+    p = jax.lax.axis_size(axis_name)
+    l = x.shape[dim]
+    if l % p != 0:
+        raise ValueError(
+            f"sequence length {l} not divisible by the '{axis_name}' axis "
+            f"size {p} — pick MESH.SEQ dividing the token count"
+        )
+    i = jax.lax.axis_index(axis_name)
+    return jax.lax.dynamic_slice_in_dim(x, i * (l // p), l // p, axis=dim)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_partial(x, axis_name: str = SEQ_AXIS):
+    """``psum`` whose transpose hands each member the output cotangent ONCE
+    — the reduction for summing *member-partial* values (per-shard loss
+    terms, the partial logits of the seq classifier head) into a replicated
+    total.
+
+    Why not plain ``lax.psum``: under ``check_vma=False`` shard_map (how
+    every step here runs) psum's transpose is psum again — correct for
+    device-VARYING cotangents, but the cotangent flowing back into these
+    reductions is replicated (the loss is a replicated scalar), so plain
+    psum would multiply every upstream gradient by the axis size. The true
+    derivative of ``total = Σ_i partial_i`` is ``∂total/∂partial_i = 1``:
+    exactly this identity transpose. (Caught by the seq-vs-replicated
+    oracle: every grad leaf came back exactly P× — tests/test_seq_parallel.py
+    pins it.)
+    """
+    return jax.lax.psum(x, axis_name)
+
+
+def _psum_partial_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _psum_partial_bwd(axis_name, _, g):
+    return (g,)
+
+
+psum_partial.defvjp(_psum_partial_fwd, _psum_partial_bwd)
+
+
+def seq_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    impl: str,
+    axis_name: str = SEQ_AXIS,
+    causal: bool = False,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Dispatch the sequence-parallel attention formulation by name.
+
+    ``impl='ring'``: K/V blocks rotate over the axis (P-1 ppermute neighbor
+    hops on the ICI torus, memory O(L_local²)) — works for any head count,
+    the choice at extreme L. ``impl='ulysses'``: two all-to-alls reshard
+    heads↔sequence and run dense attention locally — fewer collectives, but
+    needs ``heads % axis_size == 0`` and the full L per device. The decision
+    table lives in docs/PARALLELISM.md; `MODEL.SEQ_ATTN` routes here.
+    """
+    from distribuuuu_tpu.parallel.ring_attention import ring_attention
+    from distribuuuu_tpu.parallel.ulysses import ulysses_attention
+
+    if impl == "ring":
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal, scale=scale)
+    if impl == "ulysses":
+        return ulysses_attention(q, k, v, axis_name=axis_name, causal=causal, scale=scale)
+    raise ValueError(f"seq attention impl must be 'ring' or 'ulysses', got {impl!r}")

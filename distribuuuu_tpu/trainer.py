@@ -52,6 +52,7 @@ from distribuuuu_tpu.metrics import (
 )
 from distribuuuu_tpu.models import build_model
 from distribuuuu_tpu.parallel import fsdp
+from distribuuuu_tpu.parallel import seq as seqpar
 from distribuuuu_tpu.runtime import data_mesh, setup_distributed, setup_seed
 from distribuuuu_tpu.runtime.compat import ensure_jax_compat
 from distribuuuu_tpu.runtime.seeding import configure_determinism
@@ -104,9 +105,59 @@ def _forward_loss(model, params, batch_stats, batch, train: bool, rng, qat=None)
     return loss, (logits, new_stats)
 
 
+def _forward_loss_mae(model, params, batch_stats, batch, train: bool, rng, seq_n: int,
+                      sample_weights=None):
+    """Masked-autoencoder forward + pixel loss (TRAIN.TASK "mae").
+
+    The mask is minted per step from the (data/fsdp-folded) step RNG —
+    identical on every member of a seq group, which processes the same
+    samples. The loss is mean squared error over MASKED patches only,
+    normalized by the GLOBAL masked-token count: under seq sharding each
+    member sums its local shard and ``psum_partial`` over the seq axis makes
+    the loss (and thus the metric) replicated while keeping every parameter
+    gradient member-partial — the contract `make_train_step`'s uniform
+    seq-axis grad psum completes.
+    """
+    from distribuuuu_tpu.models.mae import patchify
+
+    images = device_normalize(batch["image"])
+    b = images.shape[0]
+    patch = model.patch
+    l_total = (images.shape[1] // patch) * (images.shape[2] // patch)
+    mask_rng, dropout_rng = jax.random.split(rng)
+    mask = jax.random.bernoulli(mask_rng, cfg.MODEL.MAE_MASK_RATIO, (b, l_total))
+    pred = model.apply(
+        {"params": params}, images, mask=mask, train=train,
+        rngs={"dropout": dropout_rng} if train else None,
+    )
+    target = patchify(images.astype(jnp.float32), patch)
+    mask_f = mask.astype(jnp.float32)
+    if sample_weights is not None:
+        # weight-masked exact metrics (eval): padded samples (zero image,
+        # weight 0 — the val loader's final-batch fill) must not contaminate
+        # the masked-MSE average, mirroring the classify path's nll*w
+        mask_f = mask_f * sample_weights.astype(jnp.float32)[:, None]
+    if seq_n > 1:
+        target = seqpar.local_tokens(target)
+        mask_f = seqpar.local_tokens(mask_f)
+    err = jnp.mean((pred.astype(jnp.float32) - target) ** 2, axis=-1)  # [B, L_local]
+    se = jnp.sum(err * mask_f)
+    cnt = jnp.sum(mask_f)
+    if seq_n > 1:
+        # psum_partial, not lax.psum: the members' sums are PARTIAL and the
+        # cotangent coming back is replicated — plain psum's unchecked-mode
+        # transpose would scale every gradient by seq_n (parallel/seq.py)
+        se, cnt = seqpar.psum_partial((se, cnt), seqpar.SEQ_AXIS)
+    loss = se / jnp.maximum(cnt, 1.0)
+    # pred rides the logits slot (metrics skip top-k for mae); MAE has no
+    # BatchNorm, so the stats pass through untouched
+    return loss, (pred, batch_stats)
+
+
 def make_train_step(
     model, tx, mesh: Mesh, topk: int, accum_steps: int = 1,
     nonfinite_guard: bool | None = None, state_specs=None, qat=None,
+    task: str | None = None,
 ):
     """Build the jitted SPMD train step.
 
@@ -143,9 +194,29 @@ def make_train_step(
     ``QUANT.QAT`` fine-tune mode (quant/qat.py). The step's SPMD structure
     (collectives, guard, donation) is identical; only the traced forward
     changes.
+
+    ``task`` (default ``cfg.TRAIN.TASK``): "classify" (softmax-CE, top-k
+    metrics) or "mae" (masked pixel reconstruction, `_forward_loss_mae`;
+    top-k counters stay zero).
+
+    A mesh with a ``seq`` axis (cfg.MESH.SEQ > 1, `parallel/seq.py`) runs
+    the model sequence-parallel: the batch replicates along seq (in_specs
+    untouched — `fsdp.batch_axes` never includes seq), the model shards the
+    token dim internally, and each member's grads are PARTIAL (its token
+    shard's contribution) — a single ``psum`` over the seq axis, inserted
+    before the data/fsdp reductions, completes them. Loss/metrics arrive
+    seq-replicated (the model/loss psum their scalar reductions), so metric
+    psums still span only the batch-bearing axes.
     """
     if nonfinite_guard is None:
         nonfinite_guard = cfg.FAULT.NONFINITE_GUARD
+    if task is None:
+        task = cfg.TRAIN.TASK
+    if task not in ("classify", "mae"):
+        raise ValueError(f"TRAIN.TASK must be 'classify' or 'mae', got {task!r}")
+    seq_n = seqpar.seq_size(mesh)
+    if task == "mae" and qat is not None:
+        raise ValueError("QUANT.QAT supports TRAIN.TASK 'classify' only")
     if fsdp.fsdp_size(mesh) > 1 and state_specs is None:
         # without specs the step would shard the batch over both axes but
         # reduce grads over 'data' only — silent per-fsdp-group divergence
@@ -160,7 +231,9 @@ def make_train_step(
     # grads/BN stats/metrics reduce over every batch-bearing axis: fsdp
     # composes with dp, so the fleet mean spans both
     reduce_axes = ("data", fsdp.FSDP_AXIS) if use_fsdp else "data"
-    n_mesh_devices = int(mesh.devices.size)
+    # metric/guard psums span the batch-bearing devices only — values are
+    # already seq-replicated when a seq axis exists
+    n_reduce_devices = int(mesh.devices.size) // seq_n
 
     def grads_one(params, batch_stats, micro, rng):
         def loss_fn(p):
@@ -169,6 +242,8 @@ def make_train_step(
                 # the tiled all-gather is a psum_scatter, so the grads this
                 # returns are already 1/N shards (summed over the fsdp axis)
                 p = fsdp.all_gather_params(p, param_specs)
+            if task == "mae":
+                return _forward_loss_mae(model, p, batch_stats, micro, True, rng, seq_n)
             return _forward_loss(model, p, batch_stats, micro, True, rng, qat=qat)
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -221,6 +296,14 @@ def make_train_step(
             # input stats never enter a train-mode forward, so grads/outputs
             # are unaffected; equality vs the sequential oracle is pinned in
             # tests/test_train_step.py).
+        if seq_n > 1:
+            # each seq member holds the PARTIAL gradient of its token shard
+            # (the model's seq path keeps every parameter use partial —
+            # slice-transpose zero-padding, bias-1/P head, psum'd loss
+            # sums); the sum over the seq axis is the full gradient. This
+            # runs FIRST so the fsdp/data reductions below see seq-complete
+            # values, exactly as on a seq-less mesh.
+            grads = jax.lax.psum(grads, seqpar.SEQ_AXIS)
         if use_fsdp:
             # sharded leaves arrive as per-shard fsdp-axis SUMS from the
             # gather transpose (÷N makes them means); replicated leaves still
@@ -235,7 +318,12 @@ def make_train_step(
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optim.apply_updates_with_lr(state.params, updates, lr)
         n = jnp.float32(batch["label"].shape[0])
-        correct = topk_correct(logits, batch["label"], ks=(1, topk))
+        if task == "mae":
+            # pixel reconstruction has no top-k; the counters stay zero so
+            # the metric schema (and the meters) are task-invariant
+            correct = {1: jnp.float32(0.0), topk: jnp.float32(0.0)}
+        else:
+            correct = topk_correct(logits, batch["label"], ks=(1, topk))
         if nonfinite_guard:
             # keep is derived from pmean'd values only, so it is identical on
             # every device and the selection below stays replicated. A NaN
@@ -253,7 +341,7 @@ def make_train_step(
                 ok_count = jax.lax.psum(
                     local_ok.astype(jnp.float32), reduce_axes
                 )
-                keep = jnp.logical_and(keep, ok_count == n_mesh_devices)
+                keep = jnp.logical_and(keep, ok_count == n_reduce_devices)
             else:
                 keep = jnp.logical_and(keep, local_ok)
 
@@ -295,7 +383,8 @@ def make_train_step(
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def make_eval_step(model, mesh: Mesh, topk: int, state_specs=None, qat=None):
+def make_eval_step(model, mesh: Mesh, topk: int, state_specs=None, qat=None,
+                   task: str | None = None):
     """Jitted SPMD eval step with weight-masked exact metrics (SURVEY §3.3).
 
     Takes and returns the running metric totals so accumulation happens
@@ -304,7 +393,9 @@ def make_eval_step(model, mesh: Mesh, topk: int, state_specs=None, qat=None):
     fsdp-sharded params are all-gathered per batch for the forward pass.
     ``qat`` mirrors `make_train_step` too: under ``QUANT.QAT`` the eval
     forward is fake-quantized, so validation accuracy measures what the
-    quantized serve path will deliver.
+    quantized serve path will deliver. ``task`` "mae" evaluates masked pixel
+    reconstruction under a FIXED mask key (deterministic across runs and
+    topologies); "loss" is the weighted mean masked-MSE, top-k stays zero.
     """
     if fsdp.fsdp_size(mesh) > 1 and state_specs is None:
         raise ValueError(
@@ -313,18 +404,37 @@ def make_eval_step(model, mesh: Mesh, topk: int, state_specs=None, qat=None):
         )
     use_fsdp = state_specs is not None and fsdp.fsdp_size(mesh) > 1
     reduce_axes = ("data", fsdp.FSDP_AXIS) if use_fsdp else "data"
+    if task is None:
+        task = cfg.TRAIN.TASK
+    seq_n = seqpar.seq_size(mesh)
 
     def step(state: TrainState, batch, totals):
         params = state.params
         if use_fsdp:
             params = fsdp.all_gather_params(params, state_specs.params)
+        w = batch["weight"]
+        if task == "mae":
+            # same mask for every batch/run: eval is a fixed, comparable
+            # yardstick, not a sampled estimate that drifts between epochs
+            eval_rng = jax.random.PRNGKey(cfg.RNG_SEED or 0)
+            loss, _ = _forward_loss_mae(
+                model, params, state.batch_stats, batch, False, eval_rng, seq_n,
+                sample_weights=w,
+            )
+            n_local = jnp.sum(w)
+            m = {
+                "loss_sum": jax.lax.psum(loss * n_local, reduce_axes),
+                "n": jax.lax.psum(n_local, reduce_axes),
+                "correct1": jnp.float32(0.0),
+                f"correct{topk}": jnp.float32(0.0),
+            }
+            return jax.tree.map(jnp.add, totals, m)
         apply = model.apply if qat is None else functools.partial(qat.apply, model)
         logits = apply(
             {"params": params, "batch_stats": state.batch_stats},
             device_normalize(batch["image"]),
             train=False,
         )
-        w = batch["weight"]
         logits32 = logits.astype(jnp.float32)
         nll = per_example_nll(logits32, batch["label"])
         correct = topk_correct_weighted(logits32, batch["label"], w, ks=(1, topk))
@@ -372,9 +482,15 @@ def create_train_state(model, key, mesh: Mesh, im_size: int):
     `jax.eval_shape` before anything is allocated.
     """
     fsdp_n = fsdp.fsdp_size(mesh)
+    init_model = model
+    if getattr(model, "seq_axis", None) is not None:
+        # init runs OUTSIDE shard_map (no seq axis bound), and the seq path
+        # only reroutes activations — the parameter inventory is identical —
+        # so a seq-less clone initializes the exact same model
+        init_model = model.clone(seq_axis=None)
 
     def model_init(key):
-        variables = model.init(
+        variables = init_model.init(
             key, jnp.zeros((1, im_size, im_size, 3), jnp.float32), train=False
         )
         return variables["params"], variables.get("batch_stats", {})
@@ -453,6 +569,10 @@ def _build_cfg_model():
     from distribuuuu_tpu.models.layers import set_bn_compute_dtype
 
     _import_arch_modules()
+    if cfg.TRAIN.TASK not in ("classify", "mae"):
+        raise ValueError(
+            f"TRAIN.TASK must be 'classify' or 'mae', got {cfg.TRAIN.TASK!r}"
+        )
     if cfg.MODEL.DTYPE not in ("float32", "bfloat16"):
         raise ValueError(
             f"MODEL.DTYPE must be 'float32' or 'bfloat16', got {cfg.MODEL.DTYPE!r}"
@@ -483,6 +603,39 @@ def _build_cfg_model():
     kwargs = {}
     if cfg.MODEL.STEM_S2D:  # resnet/botnet-family option; loud TypeError elsewhere
         kwargs["stem_s2d"] = True
+    if cfg.MODEL.SEQ_ATTN not in ("none", "ring", "ulysses"):
+        raise ValueError(
+            f"MODEL.SEQ_ATTN must be 'none', 'ring' or 'ulysses', "
+            f"got {cfg.MODEL.SEQ_ATTN!r}"
+        )
+    if cfg.MESH.SEQ > 1:
+        if cfg.MODEL.SEQ_ATTN == "none":
+            # sharded tokens with dense per-shard attention would silently
+            # attend within shards only — wrong math, so refuse at build
+            raise ValueError(
+                "MESH.SEQ > 1 needs MODEL.SEQ_ATTN 'ring' or 'ulysses' to "
+                "stitch the attention contraction across token shards"
+            )
+        kwargs["seq_axis"] = seqpar.SEQ_AXIS
+        kwargs["seq_impl"] = cfg.MODEL.SEQ_ATTN
+        if cfg.TRAIN.TASK == "classify" and cfg.MODEL.ARCH.startswith("vit_"):
+            # the class token has no home shard; gap pooling is the
+            # seq-compatible representation (models/vit.py)
+            kwargs["pool"] = "gap"
+    if cfg.TRAIN.TASK == "mae":
+        if not cfg.MODEL.ARCH.startswith("mae_"):
+            raise ValueError(
+                f"TRAIN.TASK 'mae' needs a pixel-decoder arch (mae_*), "
+                f"got MODEL.ARCH {cfg.MODEL.ARCH!r}"
+            )
+        kwargs["decoder_dim"] = cfg.MODEL.MAE_DECODER_DIM
+    elif cfg.MODEL.ARCH.startswith("mae_"):
+        # the converse hole: an MAE model emits pixels, which softmax-CE
+        # would crash into deep inside metrics — refuse with the story here
+        raise ValueError(
+            f"MODEL.ARCH {cfg.MODEL.ARCH!r} emits pixel reconstructions, "
+            f"not class logits: set TRAIN.TASK 'mae'"
+        )
     return build_model(
         cfg.MODEL.ARCH,
         num_classes=cfg.MODEL.NUM_CLASSES,
@@ -559,8 +712,12 @@ def train_epoch(
     # the OBS.ENABLED gating (legacy TRAIN.PROFILE stays independent of it)
     prof = obs.ProfilerWindows.from_cfg(epoch, telemetry=tel) if is_primary else None
     # per optimizer step the fleet consumes this many samples — sized by the
-    # mesh actually training (a submesh run leaves the other chips idle)
-    step_imgs = cfg.TRAIN.BATCH_SIZE * cfg.TRAIN.ACCUM_STEPS * int(mesh.devices.size)
+    # BATCH-BEARING mesh devices (a submesh run leaves the other chips idle;
+    # a seq group of P devices cooperates on one batch shard, so seq never
+    # multiplies the sample count)
+    step_imgs = (
+        cfg.TRAIN.BATCH_SIZE * cfg.TRAIN.ACCUM_STEPS * seqpar.batch_device_count(mesh)
+    )
     steps_per_epoch = len(loader)
     max_consec = cfg.FAULT.MAX_CONSECUTIVE_SKIPS
     epoch_skipped = 0
@@ -806,6 +963,38 @@ def _journal_state_bytes(state, mesh: Mesh) -> None:
         logger.warning(f"state-bytes snapshot failed: {exc!r}")
 
 
+def _journal_activation_bytes(model, mesh: Mesh) -> None:
+    """Typed per-device activation-byte census: the seq-axis twin of
+    `_journal_state_bytes` — the priced 1/seq claim (obs/memory.py
+    ``activation_bytes``; the allocator's `memory` snapshots are the
+    on-chip measured complement). Transformer archs only (the census needs
+    token geometry); silently skipped elsewhere."""
+    patch = getattr(model, "patch", None)
+    dim = getattr(model, "dim", None)
+    depth = getattr(model, "depth", None)
+    mlp_dim = getattr(model, "mlp_dim", None)
+    if None in (patch, dim, depth, mlp_dim):
+        return
+    l_global = (cfg.TRAIN.IM_SIZE // patch) ** 2
+    if getattr(model, "pool", None) == "token":
+        l_global += 1  # the class token rides the stream
+    try:
+        obs.current().event(
+            "activation_bytes",
+            **obs.activation_bytes(
+                batch_per_device=cfg.TRAIN.BATCH_SIZE,
+                l_global=l_global,
+                seq=seqpar.seq_size(mesh),
+                dim=dim,
+                depth=depth,
+                mlp_dim=mlp_dim,
+                dtype_bytes=2 if cfg.MODEL.DTYPE == "bfloat16" else 4,
+            ),
+        )
+    except Exception as exc:  # observability must never kill the run
+        logger.warning(f"activation-bytes census failed: {exc!r}")
+
+
 def _build_qat(model, state, mesh: Mesh):
     """Calibrate the ``QUANT.QAT`` fake-quant sites on the run's weights.
 
@@ -833,6 +1022,13 @@ def _build_qat(model, state, mesh: Mesh):
         raise ValueError(
             "QUANT.QAT requires MESH.FSDP 1: the calibration pass runs on "
             "the unsharded weights (fine-tune the model data-parallel)"
+        )
+    if seqpar.seq_size(mesh) > 1 or cfg.TRAIN.TASK == "mae":
+        # the eager calibration forward has no seq group to stitch ring
+        # attention across, and the quant serve grid targets classifiers
+        raise ValueError(
+            "QUANT.QAT requires MESH.SEQ 1 and TRAIN.TASK 'classify' "
+            "(fine-tune the classifier data-parallel)"
         )
     tic = time.time()
     rng = np.random.default_rng(cfg.QUANT.CALIB_SEED)
@@ -989,10 +1185,13 @@ def train_model():
             f"Fleet-managed run: gang epoch {fleet_poller.fleet_epoch}, "
             f"cooperative-stop signals at {fleet_poller.signals_dir}"
         )
-    mesh = data_mesh(cfg.MESH.DATA, cfg.MESH.FSDP)
+    mesh = data_mesh(cfg.MESH.DATA, cfg.MESH.FSDP, cfg.MESH.SEQ)
     # fleet-wide samples one optimizer step consumes — the unit elastic
-    # resume remaps checkpointed sample offsets with
-    samples_per_step = cfg.TRAIN.BATCH_SIZE * cfg.TRAIN.ACCUM_STEPS * int(mesh.devices.size)
+    # resume remaps checkpointed sample offsets with (seq devices share
+    # their group's batch shard, so they don't multiply it)
+    samples_per_step = (
+        cfg.TRAIN.BATCH_SIZE * cfg.TRAIN.ACCUM_STEPS * seqpar.batch_device_count(mesh)
+    )
     logger.info(
         f"Devices: {info.global_device_count} ({info.process_count} hosts), "
         f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))}, "
@@ -1016,12 +1215,20 @@ def train_model():
     state, tx = create_train_state(model, init_key, mesh, cfg.TRAIN.IM_SIZE)
     logger.info(f"Model:\n{cfg.MODEL.ARCH}")
     logger.info(f"Params(M): {count_parameters(state.params):.3f}")
+    if seqpar.seq_size(mesh) > 1 and jax.tree.leaves(state.batch_stats):
+        # BN statistics would need their own seq-aware reduction (the token
+        # shards see different activations); no transformer arch here has BN
+        raise ValueError(
+            "MESH.SEQ > 1 requires a BatchNorm-free model (vit_*/mae_*): "
+            f"{cfg.MODEL.ARCH} carries batch_stats"
+        )
     # the committed state's actual shardings are the authoritative specs the
     # step functions carry (None on a 1-D mesh: the replicated fast path)
     state_specs = (
         fsdp.specs_of(state) if fsdp.fsdp_size(mesh) > 1 else None
     )
     _journal_state_bytes(state, mesh)
+    _journal_activation_bytes(model, mesh)
 
     train_loader = construct_train_loader(mesh)
     val_loader = construct_val_loader(mesh)
@@ -1167,7 +1374,7 @@ def test_model():
     _enable_compile_cache()
     info = setup_distributed()
     setup_logger(cfg.OUT_DIR, info.process_index)
-    mesh = data_mesh(cfg.MESH.DATA, cfg.MESH.FSDP)
+    mesh = data_mesh(cfg.MESH.DATA, cfg.MESH.FSDP, cfg.MESH.SEQ)
     model = _build_cfg_model()
     key = jax.random.PRNGKey(0)
     state, _ = create_train_state(model, key, mesh, cfg.TRAIN.IM_SIZE)

@@ -21,6 +21,14 @@ hot-block shapes — fwd + grad numerics plus the fwd+bwd microbench that
 is the flip/keep signal for DTPU_FUSED_EPILOGUE / MODEL.FUSED_EPILOGUE.
 Same interpreter caveat off-TPU; the docs/PERFORMANCE.md attention row
 is the reason every kernel measures before any default flips.
+
+``--seq`` soaks the LARGE-L regime (ISSUE 15): the blockwise fused
+attention kernels at L=1024 against the XLA path (fwd + grad numerics,
+fwd+bwd microbench — the flip/keep signal for DTPU_FUSED_ATTN at large
+L, where the small-L measured loss no longer applies), plus ring vs
+Ulysses vs dense attention over a seq mesh. Emits ONE JSON verdict line
+(docs/PERFORMANCE.md "Large-L kernels"); off-TPU the timings are
+interpreter/CPU noise and the verdict field says so.
 """
 
 import argparse
@@ -318,6 +326,132 @@ def main_epilogue():
     sys.exit(0 if ok else 1)
 
 
+def main_seq():
+    """--seq: the large-L verdict. Blockwise fused attention vs XLA at
+    L=1024 (numerics + fwd+bwd microbench) and ring/Ulysses/dense attention
+    over a seq mesh. Prints one JSON verdict line; `flip` is meaningful
+    ON-CHIP only (the `interpret` field marks CPU runs)."""
+    import functools
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from distribuuuu_tpu.ops import attention as att
+    from distribuuuu_tpu.parallel.seq import seq_attention
+    from distribuuuu_tpu.runtime import create_mesh
+    from distribuuuu_tpu.runtime.compat import ensure_jax_compat
+
+    ensure_jax_compat()
+    interpret = jax.devices()[0].platform != "tpu"
+    print(f"devices: {jax.devices()}", flush=True)
+    rng = np.random.default_rng(0)
+    # L=1024: past the single-tile VMEM budget, the regime the blockwise
+    # re-tiling exists for. Small batch off-TPU (interpreter grids are
+    # python loops); ViT-B head shapes on chip.
+    B, N, L, D = (1, 2, 1024, 64) if interpret else (8, 12, 1024, 64)
+    dt = jnp.float32 if interpret else jnp.bfloat16
+    q = jnp.asarray(rng.standard_normal((B, N, L, D)) * 0.1, dt)
+    k = jnp.asarray(rng.standard_normal((B, N, L, D)) * 0.1, dt)
+    v = jnp.asarray(rng.standard_normal((B, N, L, D)), dt)
+    bias = jnp.asarray(rng.standard_normal((B, N, L, L)) * 0.1, jnp.float32)
+
+    fused = functools.partial(att.fused_attention, interpret=interpret)
+    fallbacks_before = att._VMEM_GUARD.fallbacks
+
+    def loss(fn):
+        return lambda *a: jnp.sum(fn(*a).astype(jnp.float32) ** 2)
+
+    # jitted callables bound once up front (not jit-then-call per use): the
+    # compile cache stays keyed on stable function objects — dtpu-lint DT003
+    jit_fused = jax.jit(fused)
+    jit_xla = jax.jit(att.xla_attention)
+    jit_grad_fused = jax.jit(jax.grad(loss(fused), argnums=(0, 3)))
+    jit_grad_xla = jax.jit(jax.grad(loss(att.xla_attention), argnums=(0, 3)))
+    out_f = jax.device_get(jit_fused(q, k, v, bias))
+    out_x = jax.device_get(jit_xla(q, k, v, bias))
+    fwd_diff = float(np.max(np.abs(out_f.astype(np.float32) - out_x.astype(np.float32))))
+    gf = jax.device_get(jit_grad_fused(q, k, v, bias))
+    gx = jax.device_get(jit_grad_xla(q, k, v, bias))
+    grad_diff = max(
+        float(np.max(np.abs(a.astype(np.float32) - b.astype(np.float32))))
+        for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gx))
+    )
+    assert att._VMEM_GUARD.fallbacks == fallbacks_before, (
+        "blockwise dispatch fell back to XLA — the soak measured nothing"
+    )
+
+    ms = {}
+    for name, f in [("fused", jax.jit(jax.grad(loss(fused)))),
+                    ("xla", jax.jit(jax.grad(loss(att.xla_attention))))]:
+        jax.device_get(f(q, k, v, bias))
+        t0 = time.perf_counter()
+        for _ in range(3 if interpret else 10):
+            jax.device_get(f(q, k, v, bias))
+        ms[name] = (time.perf_counter() - t0) / (3 if interpret else 10) * 1000
+
+    # ring vs Ulysses vs dense over a seq mesh (fwd+bwd of sum-of-squares)
+    n_dev = jax.device_count()
+    p = 1
+    for cand in (8, 4, 2):
+        if n_dev % cand == 0 and N % cand == 0 and L % cand == 0:
+            p = cand
+            break
+    seq_ms = {}
+    if p > 1:
+        mesh = create_mesh({"seq": p}, devices=jax.devices()[:p])
+        spec = P(None, None, "seq", None)
+
+        def arm(impl):
+            def member(q_, k_, v_):
+                if impl == "dense":
+                    s = jnp.einsum("bhqd,bhkd->bhqk", q_, k_,
+                                   preferred_element_type=jnp.float32)
+                    w = jax.nn.softmax(s * (D ** -0.5), axis=-1)
+                    out = jnp.einsum("bhqk,bhkd->bhqd", w.astype(v_.dtype), v_)
+                else:
+                    out = seq_attention(q_, k_, v_, impl=impl)
+                return jnp.sum(out.astype(jnp.float32) ** 2)
+
+            in_specs = (P(),) * 3 if impl == "dense" else (spec,) * 3
+            mapped = jax.shard_map(member, mesh=mesh, in_specs=in_specs,
+                                   out_specs=P(), check_vma=False)
+            return jax.jit(jax.grad(lambda a, b, c: mapped(a, b, c), argnums=0))
+
+        for impl in ("dense", "ring", "ulysses"):
+            f = arm(impl)
+            jax.device_get(f(q, k, v))
+            t0 = time.perf_counter()
+            for _ in range(3):
+                jax.device_get(f(q, k, v))
+            seq_ms[f"{impl}_ms"] = round((time.perf_counter() - t0) / 3 * 1000, 2)
+
+    tol = 0.05 if dt == jnp.bfloat16 else 1e-3
+    ok = fwd_diff < tol and grad_diff < (1.0 if dt == jnp.bfloat16 else 0.05)
+    speedup = ms["xla"] / ms["fused"]
+    verdict = {
+        "metric": "seq_soak",
+        "l": L,
+        "heads": N,
+        "batch": B,
+        "fused_ms": round(ms["fused"], 2),
+        "xla_ms": round(ms["xla"], 2),
+        "fused_speedup": round(speedup, 3),
+        # flip DTPU_FUSED_ATTN's large-L default only on an on-chip >1x win
+        "flip": bool(not interpret and speedup > 1.0),
+        "interpret": interpret,
+        "seq": p,
+        "fwd_maxdiff": round(fwd_diff, 5),
+        "grad_maxdiff": round(grad_diff, 5),
+        "numerics": "pass" if ok else "fail",
+        **seq_ms,
+    }
+    print(json.dumps(verdict), flush=True)
+    sys.exit(0 if ok else 1)
+
+
 if __name__ == "__main__":
     parser = argparse.ArgumentParser(description=__doc__)
     which = parser.add_mutually_exclusive_group()
@@ -329,10 +463,17 @@ if __name__ == "__main__":
         "--epilogue", action="store_true",
         help="soak the fused conv-epilogue kernels instead of attention",
     )
+    which.add_argument(
+        "--seq", action="store_true",
+        help="soak the large-L blockwise attention + ring/Ulysses arms; "
+        "emits the flip/keep verdict JSON",
+    )
     args = parser.parse_args()
     if args.moe:
         main_moe()
     elif args.epilogue:
         main_epilogue()
+    elif args.seq:
+        main_seq()
     else:
         main()

@@ -367,6 +367,18 @@ def test_dt005_census_is_cross_file():
     assert [(f.code, f.path, f.line) for f in mixed] == [("DT005", "a.py", 2)]
 
 
+def test_dt005_seq_axis_kwarg_censused_and_checked():
+    """seq_axis (the MODEL.SEQ_ATTN routing kwarg) is axis vocabulary: a
+    library default declares it, a typo'd literal at a call site is flagged
+    (ISSUE 15's seq-axis census teaching)."""
+    lib = 'def encode(x, seq_axis="seq"):\n    return x\n'
+    ok = 'from lib import encode\ndef f(m):\n    return m(seq_axis="seq")\n'
+    typo = 'from lib import encode\ndef f(m):\n    return m(seq_axis="sqe")\n'
+    assert lint_sources({"lib.py": lib, "use.py": ok}) == []
+    bad = lint_sources({"lib.py": lib, "use.py": typo})
+    assert [(f.code, f.path, f.line) for f in bad] == [("DT005", "use.py", 3)]
+
+
 # ---------------------------------------------------------------------------
 # DT006 — untimed device work
 # ---------------------------------------------------------------------------

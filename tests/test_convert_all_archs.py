@@ -77,6 +77,14 @@ def _assert_trees_equal(got, expected):
 
 @pytest.mark.parametrize("arch", list_models())
 def test_convert_roundtrip(arch):
+    if arch.startswith("mae_"):
+        # no torch counterpart exists to round-trip through; the converter
+        # refuses with the full story instead (pinned below)
+        with pytest.raises(ValueError, match="no torch"):
+            convert_state_dict({}, arch)
+        with pytest.raises(ValueError, match="no torch"):
+            export_state_dict({"params": {}}, arch)
+        return
     tree = _model_tree(arch)
     sd, expected = _synthesize(arch, tree)
     converted = convert_state_dict(sd, arch)

@@ -129,14 +129,97 @@ def test_mhsa_fused_equals_xla_path(rel):
     )
 
 
-def test_vmem_budget_guard_falls_back_at_large_l():
-    """L=1024 blows the per-tile VMEM estimate: the wrapper must fall back
-    to xla_attention (numerically identical, one warning, counter bumped)
-    instead of failing opaquely inside Mosaic."""
+def test_large_l_runs_blockwise_within_budget():
+    """L=1024 exceeds the single-tile estimate but FITS the default 12 MB
+    budget re-tiled: the dispatch must route to the blockwise kernel (no
+    fallback counted) and match XLA fwd+grad — the large-L regime the
+    kernel was kept for (ISSUE 15 acceptance)."""
+    from distribuuuu_tpu.ops import attention
+
+    rng = np.random.default_rng(11)
+    l, d = 1024, 64
+    # regression pin: single-tile over-refuses, blockwise estimate fits
+    assert attention._tile_vmem_bytes(l, d, d, 4, True) > attention._VMEM_GUARD.budget_bytes()
+    block = attention._pick_block(l, d, d, 4, True)
+    assert block is not None
+    assert attention._tile_vmem_bytes_blockwise(
+        block, block, d, d, 4, True
+    ) <= attention._VMEM_GUARD.budget_bytes()
+
+    q = jnp.asarray(rng.standard_normal((1, 2, l, d)) * 0.1, jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 2, l, d)) * 0.1, jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 2, l, d)), jnp.float32)
+    bias = jnp.asarray(rng.standard_normal((1, 2, l, l)) * 0.1, jnp.float32)
+    before = attention._VMEM_GUARD.fallbacks
+    got = fused_attention(q, k, v, bias, interpret=True)
+    assert attention._VMEM_GUARD.fallbacks == before, "blockwise path fell back"
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(xla_attention(q, k, v, bias)),
+        rtol=2e-5, atol=2e-5,
+    )
+    g_f = jax.grad(
+        lambda *a: jnp.sum(fused_attention(*a, interpret=True) ** 2), argnums=(0, 3)
+    )(q, k, v, bias)
+    g_x = jax.grad(
+        lambda *a: jnp.sum(xla_attention(*a) ** 2), argnums=(0, 3)
+    )(q, k, v, bias)
+    for a, b_ in zip(g_f, g_x):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-4, atol=1e-4)
+
+    # abs variant: the [bk, D] table slice forms the bias block in-kernel
+    emb = jnp.asarray(rng.standard_normal((l, d)) * 0.1, jnp.float32)
+    before = attention._VMEM_GUARD.fallbacks
+    got_abs = fused_attention_abs(q, k, v, emb, interpret=True)
+    assert attention._VMEM_GUARD.fallbacks == before
+    expect_abs = xla_attention(
+        q, k, v,
+        jnp.einsum("bnid,jd->bnij", q, emb, preferred_element_type=jnp.float32),
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_abs), np.asarray(expect_abs), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_pick_block_covers_patch_grid_token_counts():
+    """The divisor-based picker re-tiles the real workloads: L=784 (the MAE
+    448px patch grid, whose f32 single-tile estimate just exceeds the 12 MB
+    budget) gets block 392, L=1024 gets 512; an untileable L (999: no
+    sublane-aligned divisor) returns None → counted XLA fallback."""
+    from distribuuuu_tpu.ops import attention
+
+    assert attention._tile_vmem_bytes(784, 128, 128, 4, True) > attention._VMEM_GUARD.budget_bytes()
+    assert attention._pick_block(784, 128, 128, 4, True) == 392
+    assert attention._pick_block(1024, 64, 64, 4, True) == 512
+    assert attention._pick_block(999, 128, 128, 4, True) is None
+
+
+def test_blockwise_matches_single_tile_kernel():
+    """Where both tilings run, they agree: the online-softmax accumulation
+    reproduces the single-tile softmax to float tolerance."""
+    from distribuuuu_tpu.ops.attention import (
+        _fused_attention,
+        _fused_attention_blk,
+    )
+
+    rng = np.random.default_rng(12)
+    l, d = 256, 32
+    q = jnp.asarray(rng.standard_normal((2, 2, l, d)) * 0.1, jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 2, l, d)) * 0.1, jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 2, l, d)), jnp.float32)
+    bias = jnp.asarray(rng.standard_normal((2, 2, l, l)) * 0.5, jnp.float32)
+    single = _fused_attention(q, k, v, bias, True)
+    blk = _fused_attention_blk(q, k, v, bias, 128, True)
+    np.testing.assert_allclose(np.asarray(blk), np.asarray(single), rtol=2e-5, atol=2e-5)
+
+
+def test_vmem_budget_guard_falls_back_at_untileable_l():
+    """An L no block size divides (999) still falls back to xla_attention
+    (numerically identical, one warning, counter bumped) instead of failing
+    opaquely inside Mosaic."""
     from distribuuuu_tpu.ops import attention
 
     rng = np.random.default_rng(9)
-    l, d = 1024, 128  # both variants' estimates exceed the 12 MB budget here
+    l, d = 999, 128  # single-tile over budget; 512/256/128 don't divide 999
     q = jnp.asarray(rng.standard_normal((1, 1, l, d)) * 0.1, jnp.float32)
     k = jnp.asarray(rng.standard_normal((1, 1, l, d)) * 0.1, jnp.float32)
     v = jnp.asarray(rng.standard_normal((1, 1, l, d)), jnp.float32)
