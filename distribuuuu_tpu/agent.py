@@ -1234,27 +1234,57 @@ class Agent:
 
     # -- serving mode (AGENT.SERVE: keep N dtpu-serve replicas alive) --------
 
-    def _serve_ports(self) -> list[int]:
+    def _serve_ports(self, count: int | None = None) -> list[int]:
         """Stable per-replica frontend ports for the whole supervision:
         SERVE.PORT+rank when pinned, otherwise distinct ephemeral picks that
         avoid the rendezvous ports in play. Stability matters — a restarted
         replica must come back on the SAME port, or the clients retrying
-        against the replica set would never find it again."""
+        against the replica set would never find it again. ``count`` covers
+        the full slot table including autoscale headroom (FLEET.AUTOSCALE
+        SERVE_MAX) — all ports are allocated up front so a scale-up never
+        races an ephemeral pick against a client's retry rotation."""
         from distribuuuu_tpu.runtime.dist import (
             pick_rendezvous_port,
             rendezvous_ports_in_play,
         )
 
+        count = self.nprocs if count is None else int(count)
         base = int(cfg.SERVE.PORT) if "SERVE" in cfg else 0
         if base > 0:
-            return [base + r for r in range(self.nprocs)]
+            return [base + r for r in range(count)]
         exclude = set(rendezvous_ports_in_play())
         ports: list[int] = []
-        for _ in range(self.nprocs):
+        for _ in range(count):
             p = pick_rendezvous_port(exclude=exclude)
             exclude.add(p)
             ports.append(p)
         return ports
+
+    @staticmethod
+    def _pick_serve_slots(
+        desired: int,
+        max_slots: int,
+        running: set[int],
+        done: set[int],
+        retiring: set[int],
+        retry_at: dict[int, float],
+        now: float,
+    ) -> set[int]:
+        """The ``desired`` replica slots that should be serving now: keep
+        already-running slots (a scale change must never churn healthy
+        replicas), then fill from spare slots whose backoff gate is open
+        before ones still cooling down — a scale-up ROUTES AROUND a
+        crash-quarantined slot instead of waiting out its backoff, falling
+        back to quarantined slots only when nothing healthy is left
+        (pinned by the dead-slot chaos test in tests/test_autoscale.py)."""
+        keep = [r for r in sorted(running - retiring) if r not in done]
+        spares = [
+            r for r in range(max_slots)
+            if r not in running and r not in done
+        ]
+        healthy = [r for r in spares if retry_at.get(r, 0.0) <= now]
+        cooling = [r for r in spares if retry_at.get(r, 0.0) > now]
+        return set((keep + healthy + cooling)[: max(0, desired)])
 
     def _replica_ready(self, port: int, timeout_s: float = 1.0) -> bool:
         """One replica's /healthz readiness: answers AND reports ready=True
@@ -1336,7 +1366,19 @@ class Agent:
         a = cfg.AGENT
         self._install_signals()
         tic = time.time()
-        ports = self._serve_ports()
+        # dynamic capacity (fleet_autoscale.py): the autoscaler publishes a
+        # serving target in <OUT_DIR>/fleet/serve_scale.json and this loop
+        # resizes its replica slot table to match. The table (and its port
+        # plan) is sized for the policy's ceiling up front — a scale-up only
+        # ever fills pre-planned slots
+        max_slots = self.nprocs
+        if (
+            "FLEET" in cfg
+            and "AUTOSCALE" in cfg.FLEET
+            and bool(cfg.FLEET.AUTOSCALE.ENABLE)
+        ):
+            max_slots = max(self.nprocs, int(cfg.FLEET.AUTOSCALE.SERVE_MAX))
+        ports = self._serve_ports(max_slots)
         self.journal.event(
             "supervisor_start",
             nprocs=self.nprocs,
@@ -1357,6 +1399,17 @@ class Agent:
         # independence is the whole point of serve mode), so backoff is a
         # timestamp gate, not a sleep
         retry_at: dict[int, float] = {}
+        # autoscale state: the current serving target, the last scale-file
+        # seq applied, slots draining for a scale-down (their reap is a
+        # retirement, not a failure — no restart, no budget spend; the
+        # drained slot's on-disk compile cache is the warm pool a future
+        # scale-up reuses), and the in-flight change awaiting its
+        # readiness-gated ``fleet_scale action=applied`` record
+        desired = self.nprocs
+        scale_seq = 0
+        retiring: set[int] = set()
+        pending_apply: dict | None = None
+        next_scale_poll = 0.0
         # rolling-restart gate (docs/SERVING.md "Continuous deployment"):
         # when several replicas need restarting, relaunch ONE at a time and
         # gate the next on the previous one reporting ready via /healthz —
@@ -1432,10 +1485,70 @@ class Agent:
             if self._stop.is_set():
                 verdict, reason = "preempted", f"signal {self._stop_signum}"
                 break
+            now_mono = time.monotonic()
+            if max_slots > self.nprocs and now_mono >= next_scale_poll:
+                # 1 Hz: pick up a new autoscale target and, once a change
+                # lands, report it (readiness-gated for ups: the new
+                # capacity counts only when every serving replica answers
+                # /healthz ready — the before/after warm-pool proof rides
+                # the record's measured wall_s)
+                next_scale_poll = now_mono + 1.0
+                sc = resilience.read_serve_scale(cfg.OUT_DIR)
+                if sc is not None and int(sc["seq"]) > scale_seq:
+                    scale_seq = int(sc["seq"])
+                    new_desired = max(1, min(max_slots, int(sc["replicas"])))
+                    if new_desired != desired:
+                        if pending_apply is None:
+                            pending_apply = {"from_n": desired, "tic": time.time()}
+                        logger.info(
+                            f"agent[serve]: autoscale target {desired} -> "
+                            f"{new_desired} (seq {scale_seq})"
+                        )
+                        desired = new_desired
+                if pending_apply is not None:
+                    serving = sorted(
+                        w.rank for w in self._workers if w.rank not in retiring
+                    )
+                    if desired > pending_apply["from_n"]:
+                        landed = len(serving) >= desired and all(
+                            self._replica_ready(ports[r]) for r in serving
+                        )
+                    else:
+                        landed = len(serving) <= desired and not retiring
+                    if landed:
+                        wall = round(time.time() - pending_apply["tic"], 3)
+                        self.journal.event(
+                            "fleet_scale",
+                            resource="serve_replicas",
+                            action="applied",
+                            from_n=int(pending_apply["from_n"]),
+                            to_n=int(desired),
+                            reason="serve fleet resized to the autoscaler's target",
+                            seq=scale_seq,
+                            wall_s=wall,
+                        )
+                        logger.info(
+                            f"agent[serve]: capacity "
+                            f"{pending_apply['from_n']} -> {desired} applied "
+                            f"in {wall:.1f}s (replicas ready)"
+                        )
+                        pending_apply = None
             # (re)launch every replica slot that should be serving and whose
-            # backoff gate has passed
+            # backoff gate has passed; the want-set keeps running slots and
+            # routes scale-ups around quarantined ones
             running = {w.rank for w in self._workers}
-            for rank in range(self.nprocs):
+            want = self._pick_serve_slots(
+                desired, max_slots, running, done, retiring, retry_at, now_mono
+            )
+            for w in self._workers:
+                if w.rank not in want and w.rank not in retiring and w.rank not in done:
+                    retiring.add(w.rank)
+                    w.signal(signal.SIGTERM)
+                    logger.info(
+                        f"agent[serve]: replica {w.rank} draining "
+                        f"(scale-down to {desired})"
+                    )
+            for rank in sorted(want):
                 if (
                     rank in done
                     or rank in running
@@ -1513,7 +1626,7 @@ class Agent:
                     recover_restart(rank, attempt, fail_outcome)
             if verdict is not None:
                 break
-            if not self._workers and len(done) == self.nprocs:
+            if not self._workers and done and len(done) >= max(self.nprocs, desired):
                 verdict, reason = "clean", "every replica exited cleanly"
                 break
             # short poll: exits, stop signals and due backoff gates all get
@@ -1527,6 +1640,11 @@ class Agent:
                 outcome = self._reap_replica(
                     worker, time.time() - launch_tic.get(rank, time.time())
                 )
+                if rank in retiring:
+                    # deliberate scale-down drain, not a failure: no restart,
+                    # no budget spend — the slot returns to the spare pool
+                    retiring.discard(rank)
+                    continue
                 if self._stop.is_set():
                     continue  # the loop top turns this into the preempted verdict
                 if outcome == resilience.EXIT_CLEAN:

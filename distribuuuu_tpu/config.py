@@ -304,8 +304,9 @@ _C.OBS.TRAIN_SPANS = True
 # healthy ones). Per-model serve metrics (serve_p99_ms, serve_qps,
 # serve_shed, serve_queue_depth) evaluate per hosted model. Fires/clears are
 # journaled as typed alarm/alarm_clear records and invoke registered hooks
-# (the fleet controller's hook journals fleet_alarm — the autoscaler
-# trigger, docs/OBSERVABILITY.md "Alarms").
+# (the fleet controller's hook journals fleet_alarm — the trigger the
+# FLEET.AUTOSCALE policy acts on, docs/OBSERVABILITY.md "Alarms" and
+# docs/FAULT_TOLERANCE.md "Autoscaled fleets").
 _C.OBS.ALARMS = [
     "goodput_floor=goodput<0.1:for=3",
     "data_wait_ceiling=data_wait_frac>0.5:for=3",
@@ -635,6 +636,54 @@ _C.FLEET.DRAIN_S = 120.0
 # OUT_DIR/fleet/queue/. Empty: one built-in training job (the same worker
 # the dtpu-agent launches) using this config's argv.
 _C.FLEET.QUEUE = []
+
+# SLO-driven autoscaling (fleet_autoscale.py; docs/FAULT_TOLERANCE.md
+# "Autoscaled fleets"). The closed control loop over the OBS.ALARMS rules:
+# the controller's fleet_alarm hook and the live aggregator's gauges drive
+# an AutoscalePolicy that scales serving replicas, preempts/resumes
+# training for traffic spikes, and co-scales dataplane decode workers.
+# Every decision is a typed fleet_scale journal record; per-resource
+# hysteresis (cooldown + sustained-health window + min/max bounds) keeps
+# capacity from oscillating under an alarm storm.
+_C.FLEET.AUTOSCALE = CN()
+_C.FLEET.AUTOSCALE.ENABLE = False
+# Serving-replica bounds and step. MIN is the capacity floor a scale-down
+# can never cross; MAX both caps scale-up and sizes the agent's slot table
+# (the dtpu-agent serving mode allocates ports for max(AGENT.NPROCS, MAX)
+# slots up front, so a scale-up never races an ephemeral port pick).
+_C.FLEET.AUTOSCALE.SERVE_MIN = 1
+_C.FLEET.AUTOSCALE.SERVE_MAX = 4
+_C.FLEET.AUTOSCALE.SERVE_STEP = 1
+# Which alarm METRICS mean "the serving tier is hurting" — an active
+# fleet_alarm on any of these is the scale-up (and training-preemption)
+# trigger. Names match the per-model serve gauges the aggregator tracks.
+_C.FLEET.AUTOSCALE.SERVE_UP_METRICS = [
+    "serve_p99_ms", "serve_shed", "serve_queue_depth",
+]
+# Per-resource hysteresis. COOLDOWN_S: minimum wall time between two
+# capacity changes of the SAME resource (the flap clamp — an alarm storm
+# firing/clearing every evaluation produces exactly one change per
+# cooldown, pinned by tests/test_autoscale.py). DOWN_STABLE_S: how long
+# the resource must be continuously healthy (no up-alarm active, fill
+# below the floor) before any scale-down / training resume — every
+# re-fire resets the clock, so oscillating alarms can never shrink
+# capacity they just asked for.
+_C.FLEET.AUTOSCALE.COOLDOWN_S = 60.0
+_C.FLEET.AUTOSCALE.DOWN_STABLE_S = 120.0
+# Fill collapse: scale serving down only when every hosted model's
+# serve_mean_fill gauge sits at or below this AND no queue is backed up —
+# "the fleet is padding batches for nobody", the inverse of the p99 spike.
+_C.FLEET.AUTOSCALE.FILL_FLOOR = 0.25
+# Traffic spikes may preempt training via the existing priority-queue
+# cooperative-stop protocol (emergency checkpoint, elastic resume when
+# the spike clears) — training capacity is the scale-up reservoir.
+_C.FLEET.AUTOSCALE.PREEMPT_TRAINING = True
+# Dataplane co-scaling on data_wait_frac alarms: the fleet-owned input
+# service respawns with more decode workers (trainers ride the
+# DATA.FALLBACK local-decode gap), stepping DATA_STEP at a time up to
+# DATA_MAX; sustained health steps back down toward DATA.WORKERS.
+_C.FLEET.AUTOSCALE.DATA_MAX = 8
+_C.FLEET.AUTOSCALE.DATA_STEP = 2
 
 # Resume policy (TPU addition). Epoch checkpoints stay the primary contract;
 # these govern the extra step-granular/robustness behavior on top.

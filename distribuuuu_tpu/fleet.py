@@ -346,6 +346,8 @@ class DataplaneSidecar:
         )
         self._next_spawn = 0.0
         self._gave_up = False
+        # autoscaled decode-worker count (None = the config's DATA.WORKERS)
+        self._workers_n: int | None = None
 
     def _spawn(self) -> None:
         cmd = [
@@ -354,6 +356,9 @@ class DataplaneSidecar:
             "OUT_DIR", str(cfg.OUT_DIR),
             "DATA.PORT", str(self._port),
         ]
+        if self._workers_n is not None:
+            # autoscaled worker count overrides the config's DATA.WORKERS
+            cmd += ["DATA.WORKERS", str(self._workers_n)]
         env = dict(os.environ)
         env.pop("DTPU_DATA_SERVICE", None)  # the service is not a client
         self._worker = Worker(
@@ -405,6 +410,34 @@ class DataplaneSidecar:
             return
         if time.monotonic() >= self._next_spawn:
             self._spawn()
+
+    def scale(self, workers: int) -> None:
+        """Respawn the service at a new decode-worker count (the
+        FLEET.AUTOSCALE ``data_workers`` actuator). Trainers ride the
+        DATA.FALLBACK local-decode gap exactly as they do for a service
+        crash, and the restarted service picks streams back up at their
+        next epoch registration. The old process is reaped HERE,
+        synchronously — a deliberate resize must not reach ``poll()`` as a
+        death and spend the crash-restart budget."""
+        workers = int(workers)
+        current = self._workers_n
+        if current is None:
+            current = int(cfg.DATA.WORKERS) if "DATA" in cfg else workers
+        if self._gave_up or workers == current:
+            return
+        self._workers_n = workers
+        w = self._worker
+        if w is not None:
+            w.signal(signal.SIGTERM)
+            deadline = time.monotonic() + 10.0
+            while w.returncode is None and time.monotonic() < deadline:
+                time.sleep(0.1)
+            if w.returncode is None:
+                w.signal_group(signal.SIGKILL)
+            w.finish()
+            self._worker = None
+        self._spawn()
+        logger.info(f"fleet: dataplane rescaled to {workers} decode worker(s)")
 
     def stop(self) -> None:
         os.environ.pop("DTPU_DATA_SERVICE", None)
@@ -1049,6 +1082,25 @@ class FleetQueue:
         self._scan_queue_dir()
         self._prune_withdrawn()
 
+    def _poll_autoscale(self, obs_plane) -> None:
+        """Throttled autoscale evaluation (1 s cadence): hand the policy the
+        live aggregator's snapshot (the fill/backlog gauges its scale-down
+        logic reads) and apply whatever it decides."""
+        autoscaler = getattr(self, "_autoscaler", None)
+        if autoscaler is None:
+            return
+        now = time.monotonic()
+        if now < getattr(self, "_next_autoscale", 0.0):
+            return
+        self._next_autoscale = now + 1.0
+        snapshot = (
+            obs_plane.aggregator.snapshot() if obs_plane is not None else None
+        )
+        try:
+            autoscaler.poll(snapshot)
+        except Exception as exc:  # the pool outlives a broken autoscaler
+            logger.warning(f"fleet: autoscale poll failed: {exc!r}")
+
     def _prune_withdrawn(self) -> None:
         """Drop still-pending submissions whose queue file was deleted —
         deleting the file withdraws the job up until the moment it is picked
@@ -1081,9 +1133,11 @@ class FleetQueue:
         OBS.ALARMS rules, and (OBS.METRICS_PORT > 0) serve ``/metrics``.
 
         The controller's registered alarm hook relays every fire/clear as a
-        typed ``fleet_alarm`` record into its own journal part — the trigger
-        the SLO autoscaler will act on; today the controller only records
-        it. The plane observes; it must never take down the pool.
+        typed ``fleet_alarm`` record into its own journal part and feeds the
+        transition to the FLEET.AUTOSCALE policy when one is armed
+        (fleet_autoscale.py — the closed loop that scales serving replicas,
+        preempts training for spikes and co-scales the dataplane on these
+        records). The plane observes; it must never take down the pool.
         """
         try:
             from distribuuuu_tpu.obs.exporter import ObsPlane
@@ -1118,6 +1172,9 @@ class FleetQueue:
         if transition.get("model"):
             fields["model"] = str(transition["model"])
         self.journal.event("fleet_alarm", **fields)
+        autoscaler = getattr(self, "_autoscaler", None)
+        if autoscaler is not None:
+            autoscaler.on_alarm(fields)
 
     def run(self) -> int:
         from distribuuuu_tpu.runtime import pathio
@@ -1145,12 +1202,39 @@ class FleetQueue:
         if "DATA" in cfg and str(cfg.DATA.SERVICE).strip().lower() == "fleet":
             dataplane = DataplaneSidecar(self.journal, self._argv)
             dataplane.start()
+        # SLO autoscaler (fleet_autoscale.py, FLEET.AUTOSCALE.ENABLE): the
+        # alarm hook above feeds it transitions; _poll_autoscale applies its
+        # decisions (serve scale file / training hold / dataplane respawn)
+        try:
+            from distribuuuu_tpu.fleet_autoscale import controller_from_cfg
+
+            self._autoscaler = controller_from_cfg(
+                self.journal.event, dataplane=dataplane
+            )
+        except Exception as exc:  # the pool outlives a broken autoscaler
+            logger.warning(f"fleet: autoscaler unavailable: {exc!r}")
+            self._autoscaler = None
+        if self._autoscaler is not None:
+            logger.info(
+                f"fleet: SLO autoscaler armed (serve "
+                f"{self._autoscaler.policy.serve_n} replica(s) in "
+                f"[{self._autoscaler.policy.cfg.serve_min}, "
+                f"{self._autoscaler.policy.cfg.serve_max}], preempt_training="
+                f"{self._autoscaler.policy.cfg.preempt_training})"
+            )
         rc = 0
         try:
             while self.jobs and not self._stop.is_set():
                 self._poll_queue()
                 if dataplane is not None:
                     dataplane.poll()
+                self._poll_autoscale(obs_plane)
+                if self._autoscaler is not None and self._autoscaler.training_hold:
+                    # a traffic spike holds training preempted: the queued
+                    # job stays parked until the policy's sustained-clear
+                    # resume decision, then relaunches into elastic resume
+                    self._stop.wait(0.2)
+                    continue
                 if not self.jobs:
                     break
                 job = min(self.jobs, key=lambda j: j.sort_key)
@@ -1176,6 +1260,24 @@ class FleetQueue:
                     self._poll_queue()
                     if dataplane is not None:
                         dataplane.poll()
+                    self._poll_autoscale(obs_plane)
+                    if (
+                        self._autoscaler is not None
+                        and self._autoscaler.training_hold
+                        and not controller._preempt.is_set()
+                    ):
+                        # the policy decided a traffic spike needs training's
+                        # capacity: the same bounded-drain cooperative stop a
+                        # higher-priority job triggers (emergency checkpoint,
+                        # exit 118/143, elastic resume when the hold clears)
+                        self.journal.event(
+                            "fleet_preempt",
+                            job=job.name,
+                            by="autoscale",
+                            priority=float(job.priority),
+                            drain_s=float(f.DRAIN_S),
+                        )
+                        controller.request_preempt("autoscale")
                     waiting = [j for j in self.jobs if j.priority > job.priority]
                     if waiting and not controller._preempt.is_set():
                         by = min(waiting, key=lambda j: j.sort_key)

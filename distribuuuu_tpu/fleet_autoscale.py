@@ -1,0 +1,530 @@
+"""SLO-driven fleet autoscaling: the loop that closes telemetry back onto
+capacity (docs/FAULT_TOLERANCE.md "Autoscaled fleets").
+
+Every earlier layer observes or recovers; this one *acts*. The alarm engine
+(obs/alarms.py) already debounces SLO breaches into fire/clear transitions,
+the fleet controller already journals them as ``fleet_alarm`` records, and
+the live aggregator already tracks the serving fill/backlog gauges — the
+`AutoscalePolicy` here consumes exactly those two inputs and emits typed
+`ScaleDecision`s:
+
+- **serving replicas** scale up on an active p99/shed/queue-depth alarm and
+  down on sustained fill collapse (every hosted model's ``serve_mean_fill``
+  at or below ``FLEET.AUTOSCALE.FILL_FLOOR`` with empty queues), within
+  ``[SERVE_MIN, SERVE_MAX]``;
+- **training** is the scale-up reservoir: a spike that persists with the
+  serving tier at SERVE_MAX preempts the running training job through the
+  existing cooperative-stop protocol (emergency checkpoint, exit 118/143,
+  elastic resume when the spike clears);
+- **dataplane decode workers** co-scale on ``data_wait_frac`` alarms.
+
+The policy is a pure fold — alarms and snapshots in, decisions out, all
+clocks passed as arguments — so the flap proof is a unit test, not a soak.
+Per-resource hysteresis makes oscillation structurally impossible: an up
+needs an active alarm *and* an expired cooldown; a down (or resume) needs
+``DOWN_STABLE_S`` of *continuous* health, and every re-fire resets that
+clock. An alarm storm firing/clearing each window therefore produces
+exactly one change per ``COOLDOWN_S``, however fast it flaps
+(tests/test_autoscale.py pins changes <= 1).
+
+Actuation is split by ownership. The `AutoscaleController` journals every
+decision as a typed ``fleet_scale`` record and:
+
+- publishes the serving target atomically as
+  ``<OUT_DIR>/fleet/serve_scale.json`` (resilience.SERVE_SCALE_NAME) — the
+  dtpu-agent serving mode polls it and resizes its replica slot table with
+  readiness-gated bring-up, journaling ``fleet_scale action=applied`` with
+  the measured wall as the warm-pool proof (a drained slot keeps the
+  persistent compile cache, so a re-up pays near-zero ``serve_compile``);
+- raises/clears a *training hold* the FleetQueue checks (the queue issues
+  the cooperative preempt and parks the job until the hold clears);
+- respawns the fleet-owned dataplane sidecar at the new worker count
+  (trainers ride the DATA.FALLBACK local-decode gap).
+
+Standalone mode (``python -m distribuuuu_tpu.fleet_autoscale --cfg ...``)
+runs the loop next to any OUT_DIR without a fleet controller: its own
+ObsPlane over the journal, decisions into the ``.part3100`` supervisory
+continuation — how the CI autoscale smoke drives a plain serving fleet.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from dataclasses import dataclass
+
+from distribuuuu_tpu import resilience
+from distribuuuu_tpu.config import cfg, load_cfg_fom_args
+from distribuuuu_tpu.logging import logger
+
+#: the standalone autoscaler's supervisory journal part (the fleet
+#: controller's embedded policy journals through .part3000 instead)
+AUTOSCALE_PART = 3100
+
+RESOURCE_SERVE = "serve_replicas"
+RESOURCE_TRAIN = "train_jobs"
+RESOURCE_DATA = "data_workers"
+
+
+@dataclass(frozen=True)
+class ScaleDecision:
+    """One capacity change the policy wants made."""
+
+    resource: str  # RESOURCE_SERVE | RESOURCE_TRAIN | RESOURCE_DATA
+    action: str  # "up" | "down" | "preempt" | "resume"
+    from_n: int
+    to_n: int
+    reason: str
+    rule: str = ""  # the alarm rule that triggered it, when one did
+    model: str = ""
+
+
+@dataclass
+class AutoscaleConfig:
+    """The FLEET.AUTOSCALE knobs as a plain object (policy stays importable
+    and testable without the config singleton)."""
+
+    serve_min: int = 1
+    serve_max: int = 4
+    serve_step: int = 1
+    serve_up_metrics: tuple = ("serve_p99_ms", "serve_shed", "serve_queue_depth")
+    cooldown_s: float = 60.0
+    down_stable_s: float = 120.0
+    fill_floor: float = 0.25
+    preempt_training: bool = True
+    data_min: int = 2
+    data_max: int = 8
+    data_step: int = 2
+
+    @classmethod
+    def from_cfg(cls) -> "AutoscaleConfig":
+        a = cfg.FLEET.AUTOSCALE
+        return cls(
+            serve_min=int(a.SERVE_MIN),
+            serve_max=int(a.SERVE_MAX),
+            serve_step=max(1, int(a.SERVE_STEP)),
+            serve_up_metrics=tuple(str(m) for m in a.SERVE_UP_METRICS),
+            cooldown_s=float(a.COOLDOWN_S),
+            down_stable_s=float(a.DOWN_STABLE_S),
+            fill_floor=float(a.FILL_FLOOR),
+            preempt_training=bool(a.PREEMPT_TRAINING),
+            data_min=int(cfg.DATA.WORKERS) if "DATA" in cfg else 2,
+            data_max=int(a.DATA_MAX),
+            data_step=max(1, int(a.DATA_STEP)),
+        )
+
+
+def autoscale_enabled() -> bool:
+    return (
+        "FLEET" in cfg
+        and "AUTOSCALE" in cfg.FLEET
+        and bool(cfg.FLEET.AUTOSCALE.ENABLE)
+    )
+
+
+class AutoscalePolicy:
+    """Pure decision logic: `on_alarm` transitions + `poll` snapshots in,
+    `ScaleDecision`s out. No I/O, no wall clock of its own — ``now`` is an
+    argument everywhere, so the hysteresis proof runs on synthetic time.
+
+    Hysteresis, per resource:
+
+    - *cooldown*: at most one capacity change per ``cooldown_s`` — the hard
+      clamp that bounds an alarm storm to one change per window;
+    - *sustained health*: downs (and training resume) require
+      ``down_stable_s`` of continuous health; any up-alarm re-fire resets
+      the clock to zero, so a flapping alarm can hold capacity up forever
+      but can never pump it;
+    - *bounds*: ``[serve_min, serve_max]`` / ``[data_min, data_max]`` are
+      clamps on the target, never on the arithmetic.
+    """
+
+    def __init__(self, acfg: AutoscaleConfig, *, serve_n: int = 0, data_n: int = 0):
+        self.cfg = acfg
+        # serve_n 0 = no serving fleet under this policy: serve decisions
+        # are disabled and a spike goes straight to the training reservoir
+        self.serve_n = int(serve_n)
+        self.data_n = int(data_n)
+        self.training_held = False
+        self.peak_serve_n = self.serve_n
+        # active up-alarms, keyed "rule[model]" -> the firing transition
+        self._serve_alarms: dict[str, dict] = {}
+        self._data_alarms: dict[str, dict] = {}
+        self._last_change: dict[str, float] = {}
+        self._healthy_since: dict[str, float | None] = {
+            RESOURCE_SERVE: None,
+            RESOURCE_TRAIN: None,
+            RESOURCE_DATA: None,
+        }
+
+    # -- inputs --------------------------------------------------------------
+
+    @staticmethod
+    def _key(transition: dict) -> str:
+        model = transition.get("model")
+        return f"{transition.get('rule', '?')}{f'[{model}]' if model else ''}"
+
+    def on_alarm(self, transition: dict) -> None:
+        """Fold one fire/clear transition (the fleet_alarm hook's dict, or a
+        journaled fleet_alarm record — both carry rule/metric/state)."""
+        metric = str(transition.get("metric", ""))
+        state = transition.get("state") or (
+            "fire" if transition.get("kind") == "alarm" else "clear"
+        )
+        for metrics, active in (
+            (self.cfg.serve_up_metrics, self._serve_alarms),
+            (("data_wait_frac",), self._data_alarms),
+        ):
+            if metric not in metrics:
+                continue
+            if state == "fire":
+                active[self._key(transition)] = dict(transition)
+            else:
+                active.pop(self._key(transition), None)
+
+    def warm_pool(self) -> int:
+        """Drained serve slots still holding the persistent compile cache."""
+        return max(0, self.peak_serve_n - self.serve_n)
+
+    # -- helpers -------------------------------------------------------------
+
+    def _cooled(self, resource: str, now: float) -> bool:
+        last = self._last_change.get(resource)
+        return last is None or now - last >= self.cfg.cooldown_s
+
+    def _stable(self, resource: str, now: float) -> bool:
+        """Has the resource been continuously healthy for down_stable_s?
+        Arms the clock on the first healthy observation; the CALLER resets
+        it (to None) whenever health breaks."""
+        since = self._healthy_since[resource]
+        if since is None:
+            self._healthy_since[resource] = now
+            return False
+        return now - since >= self.cfg.down_stable_s
+
+    def _fill_collapsed(self, snapshot: dict | None) -> bool:
+        """Every hosted model padding batches for nobody: all
+        ``serve_mean_fill`` gauges at/below the floor and no backlog. No
+        serving data at all is *unknown*, not idle — never scale down on
+        an empty snapshot."""
+        if not snapshot:
+            return False
+        per_model = snapshot.get("per_model", {})
+        fills = per_model.get("serve_mean_fill", {})
+        if not fills:
+            return False
+        if any(v > self.cfg.fill_floor for v in fills.values()):
+            return False
+        depths = per_model.get("serve_queue_depth", {})
+        return all(v <= 0 for v in depths.values())
+
+    def _spike_rule(self) -> str:
+        return next(iter(sorted(self._serve_alarms)), "")
+
+    # -- the decision fold ---------------------------------------------------
+
+    def poll(self, snapshot: dict | None, now: float) -> list[ScaleDecision]:
+        decisions: list[ScaleDecision] = []
+        a = self.cfg
+        spike = bool(self._serve_alarms)
+
+        # serving tier ------------------------------------------------------
+        if spike:
+            self._healthy_since[RESOURCE_SERVE] = None
+            self._healthy_since[RESOURCE_TRAIN] = None
+            rule = self._spike_rule()
+            tr = self._serve_alarms[rule]
+            if (
+                self.serve_n > 0
+                and self.serve_n < a.serve_max
+                and self._cooled(RESOURCE_SERVE, now)
+            ):
+                to_n = min(a.serve_max, self.serve_n + a.serve_step)
+                decisions.append(
+                    ScaleDecision(
+                        RESOURCE_SERVE, "up", self.serve_n, to_n,
+                        f"alarm {rule} active "
+                        f"({tr.get('metric', '?')}={tr.get('value', '?')})",
+                        rule=rule, model=str(tr.get("model") or ""),
+                    )
+                )
+                self.serve_n = to_n
+                self.peak_serve_n = max(self.peak_serve_n, to_n)
+                self._last_change[RESOURCE_SERVE] = now
+            elif (
+                a.preempt_training
+                and not self.training_held
+                # serving at SERVE_MAX — or no serving tier at all (serve_n
+                # 0): either way training is the only capacity left to take
+                and (self.serve_n == 0 or self.serve_n >= a.serve_max)
+                and self._cooled(RESOURCE_TRAIN, now)
+            ):
+                # serving capacity exhausted: take the training reservoir
+                decisions.append(
+                    ScaleDecision(
+                        RESOURCE_TRAIN, "preempt", 1, 0,
+                        f"alarm {rule} active with serving at "
+                        f"SERVE_MAX={a.serve_max} — preempting training for "
+                        f"the spike",
+                        rule=rule,
+                    )
+                )
+                self.training_held = True
+                self._last_change[RESOURCE_TRAIN] = now
+        else:
+            if self.serve_n > 0 and self._fill_collapsed(snapshot):
+                if (
+                    self._stable(RESOURCE_SERVE, now)
+                    and self.serve_n > a.serve_min
+                    and self._cooled(RESOURCE_SERVE, now)
+                ):
+                    to_n = max(a.serve_min, self.serve_n - a.serve_step)
+                    decisions.append(
+                        ScaleDecision(
+                            RESOURCE_SERVE, "down", self.serve_n, to_n,
+                            f"fill collapse sustained {a.down_stable_s:.0f}s "
+                            f"(mean_fill <= {a.fill_floor})",
+                        )
+                    )
+                    self.serve_n = to_n
+                    self._last_change[RESOURCE_SERVE] = now
+            else:
+                self._healthy_since[RESOURCE_SERVE] = None
+            if self.training_held and self._stable(RESOURCE_TRAIN, now):
+                decisions.append(
+                    ScaleDecision(
+                        RESOURCE_TRAIN, "resume", 0, 1,
+                        f"spike clear sustained {a.down_stable_s:.0f}s — "
+                        f"training elastic-resumes",
+                    )
+                )
+                self.training_held = False
+                self._last_change[RESOURCE_TRAIN] = now
+
+        # dataplane tier ----------------------------------------------------
+        if self.data_n > 0:
+            if self._data_alarms:
+                self._healthy_since[RESOURCE_DATA] = None
+                if self.data_n < a.data_max and self._cooled(RESOURCE_DATA, now):
+                    rule = next(iter(sorted(self._data_alarms)))
+                    to_n = min(a.data_max, self.data_n + a.data_step)
+                    decisions.append(
+                        ScaleDecision(
+                            RESOURCE_DATA, "up", self.data_n, to_n,
+                            f"alarm {rule} active (trainers starved on input)",
+                            rule=rule,
+                        )
+                    )
+                    self.data_n = to_n
+                    self._last_change[RESOURCE_DATA] = now
+            elif (
+                self.data_n > a.data_min
+                and self._stable(RESOURCE_DATA, now)
+                and self._cooled(RESOURCE_DATA, now)
+            ):
+                to_n = max(a.data_min, self.data_n - a.data_step)
+                decisions.append(
+                    ScaleDecision(
+                        RESOURCE_DATA, "down", self.data_n, to_n,
+                        f"data_wait healthy {a.down_stable_s:.0f}s",
+                    )
+                )
+                self.data_n = to_n
+                self._last_change[RESOURCE_DATA] = now
+        return decisions
+
+
+# ---------------------------------------------------------------------------
+# Actuation
+# ---------------------------------------------------------------------------
+
+def write_serve_scale(out_dir: str, replicas: int, seq: int) -> None:
+    """Publish the serving-capacity target atomically (tmp + rename via
+    pathio — the agent never reads a torn marker)."""
+    from distribuuuu_tpu.runtime import pathio
+
+    path = resilience.serve_scale_path(out_dir)
+    pathio.makedirs(os.path.dirname(path))
+    pathio.write_text(path, json.dumps({"replicas": int(replicas), "seq": int(seq)}))
+
+
+class AutoscaleController:
+    """Policy + actuators + the journal: one `poll` applies every decision.
+
+    ``journal_event`` is any ValidatedJournal's ``event`` (the fleet
+    controller's .part3000 writer, or the standalone loop's .part3100).
+    ``dataplane`` is the fleet's `DataplaneSidecar` when the pool owns one.
+    The training hold is exposed as a flag — the FleetQueue owns the
+    cooperative-stop protocol and reads ``training_hold`` to know when to
+    issue the preempt and when to let the parked job relaunch.
+    """
+
+    def __init__(
+        self,
+        journal_event,
+        out_dir: str,
+        policy: AutoscalePolicy,
+        *,
+        dataplane=None,
+    ):
+        self._event = journal_event
+        self._out_dir = str(out_dir)
+        self.policy = policy
+        self._dataplane = dataplane
+        self._lock = threading.Lock()
+        self._seq = 0
+        #: True while a spike holds training preempted; consumed by the
+        #: FleetQueue (preempt on rising edge, re-pick the job when cleared)
+        self.training_hold = False
+        # seed the published target so the agent and the policy agree on
+        # the starting capacity (seq 0 = "no decision yet")
+        if self.policy.serve_n > 0:
+            write_serve_scale(self._out_dir, self.policy.serve_n, 0)
+
+    def on_alarm(self, transition: dict) -> None:
+        with self._lock:
+            self.policy.on_alarm(transition)
+
+    def poll(self, snapshot: dict | None = None, now: float | None = None) -> list[ScaleDecision]:
+        """Evaluate the policy and apply every decision it returns."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            decisions = self.policy.poll(snapshot, now)
+            for d in decisions:
+                self._apply(d)
+        return decisions
+
+    def _apply(self, d: ScaleDecision) -> None:
+        fields = {}
+        if d.rule:
+            fields["rule"] = d.rule
+        if d.model:
+            fields["model"] = d.model
+        self._event(
+            "fleet_scale",
+            resource=d.resource,
+            action=d.action,
+            from_n=int(d.from_n),
+            to_n=int(d.to_n),
+            reason=d.reason,
+            warm_pool=self.policy.warm_pool(),
+            cooldown_s=float(self.policy.cfg.cooldown_s),
+            seq=self._seq + 1,
+            **fields,
+        )
+        self._seq += 1
+        logger.info(
+            f"autoscale: {d.resource} {d.action} {d.from_n} -> {d.to_n} "
+            f"({d.reason})"
+        )
+        if d.resource == RESOURCE_SERVE:
+            write_serve_scale(self._out_dir, d.to_n, self._seq)
+        elif d.resource == RESOURCE_TRAIN:
+            self.training_hold = d.action == "preempt"
+        elif d.resource == RESOURCE_DATA and self._dataplane is not None:
+            try:
+                self._dataplane.scale(d.to_n)
+            except Exception as exc:  # actuation must not kill the loop
+                logger.warning(f"autoscale: dataplane scale failed: {exc!r}")
+
+
+def controller_from_cfg(
+    journal_event, *, dataplane=None, serve_n: int | None = None
+) -> AutoscaleController | None:
+    """The FLEET.AUTOSCALE-configured controller, or None when disabled.
+
+    ``serve_n`` seeds the policy's view of current serving capacity; the
+    default assumes the fleet's serving agents launched AGENT.NPROCS
+    replicas (0 = no serving tier: spikes go straight to the training
+    reservoir).
+    """
+    if not autoscale_enabled():
+        return None
+    acfg = AutoscaleConfig.from_cfg()
+    if serve_n is None:
+        serve_n = int(cfg.AGENT.NPROCS) if bool(cfg.AGENT.SERVE) else 0
+    data_n = (
+        acfg.data_min
+        if dataplane is not None
+        or ("DATA" in cfg and str(cfg.DATA.SERVICE).strip().lower() == "fleet")
+        else 0
+    )
+    policy = AutoscalePolicy(acfg, serve_n=int(serve_n), data_n=data_n)
+    return AutoscaleController(
+        journal_event, str(cfg.OUT_DIR), policy, dataplane=dataplane
+    )
+
+
+# ---------------------------------------------------------------------------
+# Standalone loop (python -m distribuuuu_tpu.fleet_autoscale)
+# ---------------------------------------------------------------------------
+
+def autoscale_main(argv: list[str] | None = None) -> int:
+    """Run the control loop beside any OUT_DIR: its own ObsPlane tails the
+    journal, alarms feed the policy, decisions land in ``.part3100`` and
+    the serve scale file. SIGTERM/SIGINT stop it cleanly."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    parser = argparse.ArgumentParser(
+        prog="python -m distribuuuu_tpu.fleet_autoscale",
+        description="SLO-driven autoscaler over a running OUT_DIR "
+        "(docs/FAULT_TOLERANCE.md 'Autoscaled fleets').",
+        add_help=False,
+    )
+    _, rest = parser.parse_known_args(argv)
+    load_cfg_fom_args("dtpu-autoscale: SLO-driven fleet control.", argv=rest)
+    from distribuuuu_tpu.logging import setup_logger
+    from distribuuuu_tpu.obs.exporter import ObsPlane
+    from distribuuuu_tpu.obs.journal import ValidatedJournal
+    from distribuuuu_tpu.obs.telemetry import journal_path
+
+    setup_logger(None, 0)
+    path = journal_path(cfg.OUT_DIR)
+    journal = ValidatedJournal(
+        f"{path}.part{AUTOSCALE_PART}", label="autoscale journal"
+    )
+    port = int(cfg.OBS.METRICS_PORT)
+    plane = ObsPlane(
+        path,
+        alarm_event=journal.event,
+        port=port if port > 0 else None,
+        host=str(cfg.OBS.METRICS_HOST),
+        interval_s=float(cfg.OBS.TAIL_INTERVAL_S),
+    )
+    controller = controller_from_cfg(journal.event)
+    if controller is None:
+        logger.error("autoscale: FLEET.AUTOSCALE.ENABLE is False — nothing to do")
+        journal.close()
+        return 2
+    plane.register_alarm_hook(controller.on_alarm)
+    stop = threading.Event()
+
+    def handler(signum, frame):
+        stop.set()
+
+    try:
+        signal.signal(signal.SIGTERM, handler)
+        signal.signal(signal.SIGINT, handler)
+    except ValueError:  # pragma: no cover - embedded use
+        pass
+    logger.info(
+        f"autoscale: watching {path} (serve {controller.policy.serve_n} "
+        f"replica(s), bounds [{controller.policy.cfg.serve_min}, "
+        f"{controller.policy.cfg.serve_max}])"
+    )
+    try:
+        while not stop.wait(min(0.5, float(cfg.OBS.TAIL_INTERVAL_S))):
+            plane.poll_once()
+            controller.poll(plane.aggregator.snapshot())
+    finally:
+        plane.stop()
+        journal.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(autoscale_main())

@@ -20,8 +20,9 @@ breaching — a fresh journal never fires every floor alarm at once.
 Transitions are journaled as typed ``alarm`` / ``alarm_clear`` records
 through the supplied event sink and handed to every registered hook. The
 engine only ever *observes and reports*: acting on an alarm is the hook
-owner's business (the fleet controller's hook journals ``fleet_alarm`` —
-the record PR-12's SLO autoscaler will key on; today it takes no action).
+owner's business (the fleet controller's hook journals ``fleet_alarm`` and
+feeds the transition to the FLEET.AUTOSCALE policy — fleet_autoscale.py,
+the closed loop that scales capacity on these records).
 """
 
 from __future__ import annotations

@@ -458,12 +458,28 @@ SCHEMA: dict[str, tuple[dict[str, tuple], dict[str, tuple]]] = {
         {"model": _STR, "active_s": _NUM},
     ),
     # the fleet controller's registered alarm hook: the same transition,
-    # journaled from the controller's part so PR-12's autoscaler has its
-    # trigger record (state is fire|clear; no action is taken yet)
+    # journaled from the controller's part (state is fire|clear) — the
+    # trigger record the FLEET.AUTOSCALE policy acts on (fleet_autoscale.py)
     "fleet_alarm": (
         {"rule": _STR, "metric": _STR, "value": _NUM, "threshold": _NUM,
          "state": _STR},
         {"model": _STR, "job": _STR},
+    ),
+    # one autoscale decision (fleet_autoscale.py; docs/FAULT_TOLERANCE.md
+    # "Autoscaled fleets"): resource is serve_replicas | train_jobs |
+    # data_workers; action is up | down | preempt | resume for policy
+    # decisions and "applied" when the actuator (the dtpu-agent serving
+    # mode) reports the capacity change landed (readiness-gated for ups —
+    # to_n replicas answering /healthz ready). warm_pool counts drained
+    # slots still holding the persistent compile cache; seq ties an
+    # "applied" record back to the decision that requested it; wall_s on
+    # an "applied" record is the measured bring-up/drain time.
+    "fleet_scale": (
+        {"resource": _STR, "action": _STR, "from_n": _INT, "to_n": _INT,
+         "reason": _STR},
+        {"model": _STR, "job": _STR, "rule": _STR, "metric": _STR,
+         "value": _NUM, "warm_pool": _INT, "cooldown_s": _NUM, "seq": _INT,
+         "wall_s": _NUM},
     ),
     # counters / memory / profiler ---------------------------------------
     "counters": (
@@ -557,7 +573,8 @@ def _journal_parts(path: str) -> list[str]:
 
     Suffixes may nest: a *supervisory* journal is itself a part file
     (``.part2001`` for fleet host 1, ``.part3000`` for the controller,
-    ``.part1000+R`` for serve replicas, ``.part4000`` for the export
+    ``.part3100`` for the standalone autoscaler, ``.part1000+R`` for
+    serve replicas, ``.part4000`` for the export
     sidecar's alarm records), and on a remote OUT_DIR its own
     commit/reopen continuations land at ``.part2001.part1``, ``...part2``
     (object stores have no append — `Journal` opens the next part). Each

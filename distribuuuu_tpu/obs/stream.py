@@ -169,7 +169,7 @@ class LiveAggregator:
             # instead of latching (the journal-heartbeat supervisory-part
             # exclusion in agent.py, one layer down)
             if isinstance(ts, (int, float)) and kind not in (
-                "alarm", "alarm_clear", "fleet_alarm"
+                "alarm", "alarm_clear", "fleet_alarm", "fleet_scale"
             ):
                 self.last_record_ts = max(self.last_record_ts or 0.0, float(ts))
             try:
@@ -340,6 +340,33 @@ class LiveAggregator:
             self._count("dataplane_worker_exits_total")
         elif kind == "dataplane_fallback":
             self._count("dataplane_fallbacks_total")
+        elif kind == "fleet_scale":
+            # autoscale decisions (fleet_autoscale.py): desired capacity per
+            # resource as gauges — "applied" records (the actuator's report)
+            # drive fleet_replicas, policy decisions drive fleet_desired, so
+            # the /metrics surface shows both the target and the landed
+            # capacity (dtpu_fleet_desired vs dtpu_fleet_replicas diverging
+            # = a bring-up in flight)
+            self._count("fleet_scale_decisions_total")
+            resource = str(r.get("resource", "?"))
+            to_n = float(r.get("to_n", 0))
+            if resource == "serve_replicas":
+                model = str(r.get("model") or "all")
+                metric = (
+                    "fleet_replicas" if r.get("action") == "applied"
+                    else "fleet_desired"
+                )
+                self._model(metric, model, to_n)
+            elif resource == "data_workers":
+                self._gauge("fleet_data_workers_desired", to_n)
+            elif resource == "train_jobs":
+                self._gauge(
+                    "fleet_training_held",
+                    1.0 if r.get("action") == "preempt" else 0.0,
+                )
+            wp = r.get("warm_pool")
+            if isinstance(wp, (int, float)) and not isinstance(wp, bool):
+                self._gauge("fleet_warm_pool", float(wp))
         elif kind == "alarm":
             self._count("alarms_fired_total")
             self.active_alarms.add(self._alarm_key(r))

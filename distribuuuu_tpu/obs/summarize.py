@@ -172,7 +172,12 @@ def render(records: Iterable[dict]) -> str:
     # -- fleet orchestration (dtpu-fleet) -----------------------------------
     # only present for fleet-managed pools; omitted otherwise so ordinary
     # reports (and the golden test) are unchanged
-    if by_kind["fleet_start"] or by_kind["fleet_launch"] or by_kind["fleet_verdict"]:
+    if (
+        by_kind["fleet_start"]
+        or by_kind["fleet_launch"]
+        or by_kind["fleet_verdict"]
+        or by_kind["fleet_scale"]
+    ):
         out("")
         if by_kind["fleet_start"]:
             s = by_kind["fleet_start"][-1]
@@ -207,6 +212,33 @@ def render(records: Iterable[dict]) -> str:
                 f"  preempt: {r.get('job', '?')} (priority {r.get('priority', '?')}) "
                 f"by {r.get('by', '?')} (priority {r.get('by_priority', '?')})"
             )
+        # autoscale decisions (fleet_autoscale.py): the decision stream first
+        # (desired-state changes), then a one-line rollup per resource so a
+        # long run's report stays readable
+        if by_kind["fleet_scale"]:
+            by_resource: dict[str, list[dict]] = defaultdict(list)
+            for r in by_kind["fleet_scale"]:
+                by_resource[r.get("resource", "?")].append(r)
+            n_applied = sum(
+                1 for r in by_kind["fleet_scale"] if r.get("action") == "applied"
+            )
+            out(
+                f"  autoscale: {len(by_kind['fleet_scale'])} decision(s) "
+                f"across {len(by_resource)} resource(s), {n_applied} applied"
+            )
+            for r in by_kind["fleet_scale"]:
+                model_s = f"[{r['model']}]" if r.get("model") else ""
+                rule_s = f" on {r['rule']}" if r.get("rule") else ""
+                warm_s = (
+                    f", warm pool {r['warm_pool']}"
+                    if r.get("warm_pool") is not None
+                    else ""
+                )
+                out(
+                    f"    {r.get('action', '?'):>7} {r.get('resource', '?')}"
+                    f"{model_s}: {r.get('from_n', '?')} -> {r.get('to_n', '?')}"
+                    f"{rule_s} ({r.get('reason', '?')}{warm_s})"
+                )
         for r in by_kind["fleet_verdict"]:
             out(
                 f"  verdict[{r.get('job', '?')}]: {r.get('verdict', '?').upper()} "

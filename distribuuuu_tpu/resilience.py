@@ -487,6 +487,37 @@ def call_with_poison_exit(fn: Callable[[], Any]) -> tuple[int, Any]:
 FLEET_MARKER_NAME = "signals.json"
 FLEET_STOP_STEP_NAME = "stop_step"
 
+# The serve half of the autoscale protocol (fleet_autoscale.py writer;
+# the dtpu-agent's serving mode is the reader): the autoscaler publishes
+# its serving-capacity target as ``{"replicas": N, "seq": K}`` under the
+# same controller-owned signals directory. ``seq`` increments per decision
+# so the agent can tell a fresh target from the one it already applied —
+# the file is level-triggered state, the seq makes re-reads idempotent.
+SERVE_SCALE_NAME = "serve_scale.json"
+
+
+def serve_scale_path(out_dir: str) -> str:
+    return os.path.join(str(out_dir), "fleet", SERVE_SCALE_NAME)
+
+
+def read_serve_scale(out_dir: str) -> dict | None:
+    """Decode the autoscaler's serve-capacity target (None when absent or
+    torn — a torn read is simply retried at the agent's next poll). Rides
+    pathio like every other signals-dir read: OUT_DIR may be an object
+    store shared between the controller and the serving hosts."""
+    from distribuuuu_tpu.runtime import pathio
+
+    try:
+        marker = json.loads(pathio.read_bytes(serve_scale_path(out_dir)))
+    except Exception:
+        return None
+    if not isinstance(marker, dict) or "replicas" not in marker:
+        return None
+    try:
+        return {"replicas": int(marker["replicas"]), "seq": int(marker.get("seq", 0))}
+    except (TypeError, ValueError):
+        return None
+
 
 def _read_fleet_marker(signals_dir: str) -> dict:
     """Decode the controller's announcement ({} when absent/torn — a torn

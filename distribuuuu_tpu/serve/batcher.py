@@ -77,7 +77,8 @@ class SLOTracker:
         self._event = journal_event
         self.window_s = float(window_s)
         # live queue-depth sampler, set by the batcher: the serve_slo record
-        # carries the depth AT rollup time — the autoscaler's backlog signal
+        # carries the depth AT rollup time — the backlog signal the
+        # FLEET.AUTOSCALE loop scales replicas on (fleet_autoscale.py)
         self.depth_probe: Callable[[str], int] | None = None
         # replica id stamped onto rollups (set by the frontend): N replicas
         # of one model journal into one reassembled journal, and a tailing

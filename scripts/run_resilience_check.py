@@ -33,6 +33,13 @@ Scenarios:
   rejoin at the next checkpoint boundary (cooperative resize; world size
   returns to 2, the fleet epoch advances), finish with a complete step
   stream, and journal it all as schema-valid ``fleet_*`` records.
+- **autoscale** (docs/FAULT_TOLERANCE.md "Autoscaled fleets"): a 2-replica
+  CPU serve fleet under the dtpu-agent with a standalone autoscaler
+  (`python -m distribuuuu_tpu.fleet_autoscale`) tailing the journal. An
+  injected p99 breach must scale 2 -> 3 while a retrying client sees ZERO
+  dropped requests; a sustained fill collapse must scale 3 -> 2; every
+  decision (and the agent's readiness-gated apply) must land as
+  schema-valid ``fleet_scale`` records.
 
 Exit code 0 iff every requested scenario passes. Self-pins to a virtual
 8-device CPU mesh (cpu_mesh_run-style bootstrap), so it runs anywhere.
@@ -384,11 +391,219 @@ def check_fleet(scratch: str) -> bool:
     return False
 
 
+def check_autoscale(scratch: str) -> bool:
+    """Autoscale smoke (docs/FAULT_TOLERANCE.md "Autoscaled fleets"): a
+    2-replica serve fleet under the dtpu-agent, a standalone autoscaler
+    tailing the same journal. Injected SLO breach -> 2->3 with zero
+    client-visible drops; sustained fill collapse -> 3->2; all of it typed,
+    schema-valid ``fleet_scale`` records."""
+    import json
+    import subprocess
+    import threading
+
+    import orbax.checkpoint as ocp
+
+    from distribuuuu_tpu.convert import synthetic_variables
+    from distribuuuu_tpu.obs import read_journal
+    from distribuuuu_tpu.obs.journal import validate_journal
+    from distribuuuu_tpu.runtime.dist import pick_rendezvous_port
+    from distribuuuu_tpu.serve.client import ServeClient
+
+    out = os.path.join(scratch, "autoscale")
+    os.makedirs(out, exist_ok=True)
+    weights = os.path.abspath(os.path.join(scratch, "as_weights"))
+    ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).save(
+        weights, synthetic_variables("resnet18", 0, 16, 4), force=True
+    )
+    ckpt.write_manifest(weights)
+
+    port = pick_rendezvous_port()
+    ports = [port, port + 1, port + 2]
+    worker = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "_serve_worker.py",
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # each replica pins its own 1-device host
+    worker_overrides = (
+        f"OUT_DIR {out} MODEL.NUM_CLASSES 4 "
+        f'SERVE.MODELS "[\'rn=resnet18@{weights}\']" SERVE.BATCH_SIZES [1,4] '
+        f"SERVE.IM_SIZE 16 SERVE.INPUT_DTYPE float32 SERVE.DTYPE float32 "
+        f"SERVE.MAX_QUEUE_DELAY_MS 2 SERVE.SLO_WINDOW_S 1 SERVE.HOST 127.0.0.1"
+    )
+    agent_log = open(os.path.join(scratch, "as_agent.log"), "w")
+    scaler_log = open(os.path.join(scratch, "as_scaler.log"), "w")
+    agent_proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "distribuuuu_tpu.agent",
+            "OUT_DIR", out,
+            "AGENT.SERVE", "True",
+            "AGENT.NPROCS", "2",
+            "AGENT.PREFLIGHT_DEVICE_PROBE", "False",
+            "AGENT.MIN_FREE_DISK_GB", "0",
+            "AGENT.BACKOFF_BASE_S", "0.01",
+            "AGENT.BACKOFF_MAX_S", "0.05",
+            "AGENT.MAX_RESTARTS", "5",
+            "SERVE.PORT", str(port),
+            "FLEET.AUTOSCALE.ENABLE", "True",
+            "FLEET.AUTOSCALE.SERVE_MAX", "3",
+            "AGENT.CMD",
+            f"{sys.executable} {worker} " + worker_overrides,
+        ],
+        env=env, stdout=agent_log, stderr=subprocess.STDOUT, text=True,
+    )
+    scaler_proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "distribuuuu_tpu.fleet_autoscale",
+            "OUT_DIR", out,
+            "AGENT.SERVE", "True",
+            "AGENT.NPROCS", "2",
+            "FLEET.AUTOSCALE.ENABLE", "True",
+            "FLEET.AUTOSCALE.SERVE_MIN", "2",
+            "FLEET.AUTOSCALE.SERVE_MAX", "3",
+            "FLEET.AUTOSCALE.COOLDOWN_S", "2.0",
+            "FLEET.AUTOSCALE.DOWN_STABLE_S", "3.0",
+            "FLEET.AUTOSCALE.FILL_FLOOR", "0.25",
+            "OBS.ALARMS", "['p99_breach=serve_p99_ms>250']",
+            "OBS.TAIL_INTERVAL_S", "0.2",
+        ],
+        env=env, stdout=scaler_log, stderr=subprocess.STDOUT, text=True,
+    )
+    journal = os.path.join(out, "telemetry.jsonl")
+    # synthetic SLO windows land in their own journal part (a part number no
+    # real writer uses) so injection never races a live writer's appends
+    inject_part = journal + ".part900"
+
+    def inject(p99_ms: float, mean_fill: float, queue_depth: int, replicas):
+        with open(inject_part, "a") as f:
+            for r in replicas:
+                f.write(json.dumps({
+                    "ts": time.time(), "kind": "serve_slo", "model": "rn",
+                    "replica": r, "window_s": 1.0, "requests": 32, "shed": 0,
+                    "qps": 32.0, "p50_ms": p99_ms / 2.0, "p99_ms": p99_ms,
+                    "mean_fill": mean_fill, "queue_depth": queue_depth,
+                    "batches": 8,
+                }) + "\n")
+
+    def fleet_scale_records():
+        try:
+            return [r for r in read_journal(journal)
+                    if r.get("kind") == "fleet_scale"]
+        except (OSError, FileNotFoundError):
+            return []
+
+    failures: list = []
+    stop_hammer = threading.Event()
+    client = ServeClient(ports, deadline_s=60)
+
+    def hammer():
+        rng = np.random.default_rng(7)
+        i = 0
+        while not stop_hammer.is_set():
+            x = rng.standard_normal((1, 16, 16, 3), dtype=np.float32)
+            try:
+                logits = client.predict("rn", x)
+                assert logits.shape == (1, 4)
+            except Exception as exc:  # noqa: BLE001
+                failures.append((i, repr(exc)))
+            i += 1
+            time.sleep(0.1)
+
+    try:
+        t0 = time.time()
+        ServeClient(ports[:2]).wait_ready(deadline_s=240)
+        print(f"[1/3] 2 replicas serving in {time.time() - t0:.1f}s")
+        ht = threading.Thread(target=hammer)
+        ht.start()
+
+        # breach: a synthetic replica's p99 blows the alarm threshold until
+        # we say otherwise — the autoscaler must go 2 -> 3
+        t0 = time.time()
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            inject(p99_ms=900.0, mean_fill=1.0, queue_depth=8, replicas=[9])
+            if all(client.healthz(i) is not None for i in range(3)):
+                break
+            time.sleep(0.5)
+        else:
+            print("FAIL autoscale: replica 3 never came up on the breach")
+            return False
+        stop_hammer.set()
+        ht.join()
+        print(f"[2/3] p99 breach -> 3 replicas in {time.time() - t0:.1f}s; "
+              f"client drops={len(failures)} retries={client.retries}")
+        if failures:
+            print(f"FAIL autoscale: dropped requests: {failures[:5]}")
+            return False
+
+        # recovery: healthy windows clear the alarm, every replica's fill
+        # collapses below the floor — after DOWN_STABLE_S the autoscaler
+        # must go 3 -> 2 (and no further: SERVE_MIN clamps)
+        t0 = time.time()
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            inject(p99_ms=10.0, mean_fill=0.05, queue_depth=0,
+                   replicas=[0, 1, 2, 9])
+            # the drain has LANDED only when the agent journals the
+            # readiness-gated applied record — tearing down on the healthz
+            # probe alone races the reap-then-journal step
+            applied_down = any(
+                r["resource"] == "serve_replicas"
+                and r["action"] == "applied" and r["to_n"] == 2
+                for r in fleet_scale_records()
+            )
+            if (applied_down and client.healthz(2) is None
+                    and client.healthz(0) is not None):
+                break
+            time.sleep(0.3)
+        else:
+            print("FAIL autoscale: fleet never scaled back down to 2")
+            return False
+        print(f"[3/3] fill collapse -> 2 replicas in {time.time() - t0:.1f}s")
+    finally:
+        stop_hammer.set()
+        for proc in (scaler_proc, agent_proc):
+            proc.terminate()
+        for proc in (scaler_proc, agent_proc):
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        agent_log.close()
+        scaler_log.close()
+
+    schema_errors = validate_journal(journal)
+    recs = fleet_scale_records()
+    ups = [r for r in recs if r["resource"] == "serve_replicas"
+           and r["action"] == "up" and r["to_n"] == 3]
+    downs = [r for r in recs if r["resource"] == "serve_replicas"
+             and r["action"] == "down" and r["to_n"] == 2]
+    applied = sorted(
+        (r["to_n"] for r in recs if r["action"] == "applied"),
+    )
+    print(f"fleet_scale records: {[(r['action'], r['from_n'], r['to_n']) for r in recs]}; "
+          f"schema_errors={len(schema_errors)}")
+    if ups and downs and 3 in applied and 2 in applied and not schema_errors:
+        print("PASS autoscale: breach -> up -> zero drops -> collapse -> "
+              "down, all journaled")
+        return True
+    print(f"FAIL autoscale: ups={len(ups)} downs={len(downs)} "
+          f"applied={applied} schema_errors={schema_errors[:5]}")
+    for label, log in (("agent", agent_log), ("scaler", scaler_log)):
+        try:
+            with open(log.name) as f:
+                print(f"{label} tail:\n{f.read()[-2000:]}")
+        except OSError:
+            pass
+    return False
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--scenario",
                     choices=("basic", "elastic", "corrupt", "supervised",
-                             "fleet", "all"),
+                             "fleet", "autoscale", "all"),
                     default="basic")
     ap.add_argument("--preempt-step", type=int, default=5,
                     help="global step to inject the simulated SIGTERM before (basic)")
@@ -406,6 +621,7 @@ def main() -> int:
         "corrupt": lambda: check_corrupt(scratch, args.epochs),
         "supervised": lambda: check_supervised(scratch, args.epochs),
         "fleet": lambda: check_fleet(scratch),
+        "autoscale": lambda: check_autoscale(scratch),
     }
     selected = list(checks) if args.scenario == "all" else [args.scenario]
     rc = 0

@@ -1,0 +1,596 @@
+"""dtpu-autoscale: SLO-driven fleet control (docs/FAULT_TOLERANCE.md
+"Autoscaled fleets").
+
+Three tiers:
+
+- **unit**: the pure `AutoscalePolicy` fold on synthetic clocks — the
+  alarm-storm flap proof (a fire/clear storm inside one cooldown window
+  produces AT MOST ONE capacity change), up-at-max → training preempt,
+  the serve_n=0 straight-to-reservoir path, sustained-clear resume with
+  the health clock reset on every re-fire, fill-collapse scale-down,
+  dataplane co-scaling, warm-pool accounting; plus the agent's
+  `_pick_serve_slots` quarantine routing, the serve_scale.json protocol
+  round trip, the `fleet_scale` journal schema through a real
+  ValidatedJournal, the aggregator fold + Prometheus gauges, and the
+  `obs summarize` autoscale section.
+- **controller**: `AutoscaleController` actuation — journal + scale file
+  + training hold + dataplane stub, and the `controller_from_cfg` gate.
+- **chaos** (slow, ``chaos`` marker): a real 2-host CPU training gang
+  with the autoscaler armed and no serving tier — an injected p99 spike
+  preempts training through the cooperative-stop protocol
+  (``fleet_preempt by=autoscale``), the spike clears, and the job
+  elastic-resumes to a final state **bitwise identical** to an
+  uninterrupted reference.
+
+The live serving scale-up/scale-down path (2 replicas → injected breach
+→ 3 replicas with zero client-visible drops → fill collapse → 2) is the
+CI autoscale-smoke: ``scripts/run_resilience_check.py --scenario
+autoscale``.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+from distribuuuu_tpu import resilience
+from distribuuuu_tpu.agent import Agent
+from distribuuuu_tpu.fleet_autoscale import (
+    RESOURCE_DATA,
+    RESOURCE_SERVE,
+    RESOURCE_TRAIN,
+    AutoscaleConfig,
+    AutoscaleController,
+    AutoscalePolicy,
+    write_serve_scale,
+)
+from distribuuuu_tpu.obs.journal import (
+    ValidatedJournal,
+    read_journal,
+    validate_journal,
+    validate_record,
+)
+from distribuuuu_tpu.obs.stream import LiveAggregator
+from distribuuuu_tpu.obs.summarize import render
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_fleet_worker.py")
+
+
+def _acfg(**kw):
+    base = dict(serve_min=1, serve_max=4, serve_step=1, cooldown_s=60.0,
+                down_stable_s=120.0, fill_floor=0.25, data_min=2, data_max=8,
+                data_step=2)
+    base.update(kw)
+    return AutoscaleConfig(**base)
+
+
+def _fire(rule="p99_breach", metric="serve_p99_ms", value=900.0, model=None):
+    t = {"rule": rule, "metric": metric, "value": value, "threshold": 250.0,
+         "state": "fire"}
+    if model:
+        t["model"] = model
+    return t
+
+
+def _clear(rule="p99_breach", metric="serve_p99_ms", value=10.0, model=None):
+    t = {"rule": rule, "metric": metric, "value": value, "threshold": 250.0,
+         "state": "clear"}
+    if model:
+        t["model"] = model
+    return t
+
+
+def _collapsed_snapshot(fill=0.05, depth=0):
+    return {"per_model": {
+        "serve_mean_fill": {"rn#r0": fill, "rn#r1": fill},
+        "serve_queue_depth": {"rn#r0": depth, "rn#r1": depth},
+    }}
+
+
+# ---------------------------------------------------------------------------
+# Unit tier: the policy fold on synthetic clocks
+# ---------------------------------------------------------------------------
+
+def test_alarm_storm_flap_never_oscillates():
+    """THE hysteresis proof (ISSUE-16 acceptance): an alarm firing and
+    clearing every second for a full cooldown window moves capacity at
+    most once — the first fire scales up, every subsequent transition is
+    absorbed by the cooldown (ups) and the reset health clock (downs)."""
+    policy = AutoscalePolicy(_acfg(cooldown_s=60.0), serve_n=2)
+    changes = []
+    for t in range(55):  # 55 s of 1 Hz flapping inside a 60 s cooldown
+        policy.on_alarm(_fire() if t % 2 == 0 else _clear())
+        changes += policy.poll(_collapsed_snapshot(), now=float(t))
+    assert len(changes) <= 1, changes
+    # and the one change is the first fire's scale-up, nothing else
+    assert [(d.resource, d.action, d.from_n, d.to_n) for d in changes] == [
+        (RESOURCE_SERVE, "up", 2, 3)
+    ]
+    assert policy.serve_n == 3 and not policy.training_held
+
+
+def test_storm_over_many_cooldowns_is_rate_limited():
+    """A storm that outlives the cooldown still can't pump capacity faster
+    than one change per window, and a flapping alarm can never trigger a
+    down (every re-fire resets the continuous-health clock)."""
+    policy = AutoscalePolicy(_acfg(cooldown_s=60.0, serve_max=4), serve_n=1)
+    decided = []  # (now, decision)
+    for t in range(600):
+        policy.on_alarm(_fire() if t % 2 == 0 else _clear())
+        for d in policy.poll(_collapsed_snapshot(), now=float(t)):
+            decided.append((float(t), d))
+    serve = [(t, d) for t, d in decided if d.resource == RESOURCE_SERVE]
+    assert all(d.action == "up" for _, d in serve)  # NEVER down mid-storm
+    for (t0, _), (t1, _) in zip(serve, serve[1:]):
+        assert t1 - t0 >= 60.0, serve  # >= one cooldown apart
+    assert policy.serve_n == 4  # bounded at SERVE_MAX, not runaway
+
+
+def test_spike_at_serve_max_preempts_training_then_sustained_clear_resumes():
+    policy = AutoscalePolicy(_acfg(serve_max=3, cooldown_s=60.0,
+                                   down_stable_s=120.0), serve_n=2)
+    policy.on_alarm(_fire())
+    (up,) = policy.poll(None, now=0.0)
+    assert (up.resource, up.action, up.to_n) == (RESOURCE_SERVE, "up", 3)
+    # spike persists past the cooldown with serving now at max: the policy
+    # takes the training reservoir
+    (pre,) = policy.poll(None, now=61.0)
+    assert (pre.resource, pre.action) == (RESOURCE_TRAIN, "preempt")
+    assert pre.rule == "p99_breach" and "SERVE_MAX" in pre.reason
+    assert policy.training_held
+    assert policy.poll(None, now=62.0) == []  # held: no repeat preempt
+    # clear arrives; the health clock arms on the next poll...
+    policy.on_alarm(_clear())
+    assert policy.poll(None, now=100.0) == []
+    # ...but a re-fire RESETS it — 119 s of health then a blip must not
+    # resume at 120 s
+    policy.on_alarm(_fire())
+    assert policy.poll(None, now=219.0) == []
+    policy.on_alarm(_clear())
+    assert policy.poll(None, now=220.0) == []  # clock re-arms here
+    assert policy.poll(None, now=339.0) == []  # 119 s: not yet
+    (res,) = policy.poll(None, now=341.0)
+    assert (res.resource, res.action) == (RESOURCE_TRAIN, "resume")
+    assert not policy.training_held
+
+
+def test_no_serving_tier_spike_goes_straight_to_training():
+    """serve_n=0 (a pure training pool, AGENT.SERVE off): there are no
+    replicas to add, so the first sustained spike preempts training."""
+    policy = AutoscalePolicy(_acfg(), serve_n=0)
+    policy.on_alarm(_fire())
+    (pre,) = policy.poll(None, now=0.0)
+    assert (pre.resource, pre.action) == (RESOURCE_TRAIN, "preempt")
+    assert policy.training_held
+
+
+def test_preempt_training_false_never_touches_training():
+    policy = AutoscalePolicy(_acfg(preempt_training=False), serve_n=0)
+    policy.on_alarm(_fire())
+    assert policy.poll(None, now=0.0) == []
+    assert not policy.training_held
+
+
+def test_fill_collapse_scales_down_only_when_sustained():
+    policy = AutoscalePolicy(_acfg(serve_min=1, cooldown_s=60.0,
+                                   down_stable_s=120.0), serve_n=3)
+    # no serving data at all is UNKNOWN, not idle: never scale down on it
+    assert policy.poll(None, now=0.0) == []
+    assert policy.poll({"per_model": {}}, now=10.0) == []
+    # collapse observed: first poll arms the clock, not yet a decision
+    assert policy.poll(_collapsed_snapshot(), now=20.0) == []
+    assert policy.poll(_collapsed_snapshot(), now=139.0) == []
+    (down,) = policy.poll(_collapsed_snapshot(), now=141.0)
+    assert (down.resource, down.action, down.from_n, down.to_n) == (
+        RESOURCE_SERVE, "down", 3, 2)
+    # cooldown gates the next step even though the clock stayed healthy
+    assert policy.poll(_collapsed_snapshot(), now=150.0) == []
+    (down2,) = policy.poll(_collapsed_snapshot(), now=202.0)
+    assert down2.to_n == 1
+    # at SERVE_MIN: the floor holds
+    assert policy.poll(_collapsed_snapshot(), now=400.0) == []
+    assert policy.serve_n == 1
+
+
+def test_fill_above_floor_or_backlog_resets_the_down_clock():
+    policy = AutoscalePolicy(_acfg(down_stable_s=120.0), serve_n=3)
+    policy.poll(_collapsed_snapshot(), now=0.0)  # arms
+    # one busy model resets the clock entirely
+    busy = {"per_model": {"serve_mean_fill": {"rn#r0": 0.9, "rn#r1": 0.1},
+                          "serve_queue_depth": {"rn#r0": 0, "rn#r1": 0}}}
+    assert policy.poll(busy, now=60.0) == []
+    assert policy.poll(_collapsed_snapshot(), now=70.0) == []  # re-arms here
+    assert policy.poll(_collapsed_snapshot(), now=185.0) == []  # 115 s < 120
+    (down,) = policy.poll(_collapsed_snapshot(), now=191.0)
+    assert down.action == "down"
+    # queued work is load even when fill is low: no down decision
+    backlog = _collapsed_snapshot(fill=0.05, depth=4)
+    p2 = AutoscalePolicy(_acfg(down_stable_s=0.0, cooldown_s=0.0), serve_n=3)
+    p2.poll(backlog, now=0.0)
+    assert p2.poll(backlog, now=1.0) == []
+
+
+def test_dataplane_co_scales_on_data_wait_alarms():
+    policy = AutoscalePolicy(_acfg(cooldown_s=60.0, down_stable_s=120.0,
+                                   data_min=2, data_max=8, data_step=2),
+                             serve_n=0, data_n=2)
+    policy.on_alarm(_fire(rule="dw", metric="data_wait_frac", value=0.5))
+    (up,) = policy.poll(None, now=0.0)
+    assert (up.resource, up.action, up.from_n, up.to_n) == (
+        RESOURCE_DATA, "up", 2, 4)
+    (up2,) = policy.poll(None, now=61.0)
+    assert up2.to_n == 6
+    (up3,) = policy.poll(None, now=122.0)
+    assert up3.to_n == 8
+    assert policy.poll(None, now=200.0) == []  # DATA_MAX holds
+    policy.on_alarm(_clear(rule="dw", metric="data_wait_frac", value=0.01))
+    assert policy.poll(None, now=300.0) == []  # clock arms
+    (down,) = policy.poll(None, now=421.0)
+    assert (down.resource, down.action, down.to_n) == (RESOURCE_DATA, "down", 6)
+    assert policy.data_n == 6
+
+
+def test_warm_pool_counts_drained_slots():
+    policy = AutoscalePolicy(_acfg(cooldown_s=0.0, down_stable_s=0.0,
+                                   serve_max=4), serve_n=2)
+    assert policy.warm_pool() == 0
+    policy.on_alarm(_fire())
+    policy.poll(None, now=0.0)  # 2 -> 3
+    policy.poll(None, now=1.0)  # 3 -> 4
+    assert policy.serve_n == 4 and policy.warm_pool() == 0
+    policy.on_alarm(_clear())
+    policy.poll(_collapsed_snapshot(), now=2.0)  # arms
+    policy.poll(_collapsed_snapshot(), now=3.0)  # 4 -> 3
+    policy.poll(_collapsed_snapshot(), now=4.0)  # 3 -> 2
+    assert policy.serve_n == 2 and policy.warm_pool() == 2
+
+
+def test_per_model_alarms_tracked_independently():
+    """A clear for one model must not clear another model's fire."""
+    policy = AutoscalePolicy(_acfg(cooldown_s=0.0), serve_n=1)
+    policy.on_alarm(_fire(model="rn18"))
+    policy.on_alarm(_fire(model="rn50"))
+    (up,) = policy.poll(None, now=0.0)
+    assert up.action == "up"
+    policy.on_alarm(_clear(model="rn18"))
+    (up2,) = policy.poll(None, now=1.0)  # rn50 still firing
+    assert up2.action == "up" and up2.model == "rn50"
+    policy.on_alarm(_clear(model="rn50"))
+    assert policy.poll(None, now=2.0) == []
+
+
+# ---------------------------------------------------------------------------
+# Unit tier: the agent's slot picker (dead-slot routing)
+# ---------------------------------------------------------------------------
+
+def test_pick_serve_slots_routes_around_quarantined_slot():
+    """Scale-up with a dead serving slot (ISSUE-16 chaos scenario, distilled):
+    slot 2 crashed and sits in backoff quarantine — the up must land on the
+    healthy spare slot 3 instead of waiting out slot 2's cooldown."""
+    now = 100.0
+    want = Agent._pick_serve_slots(
+        desired=3, max_slots=4, running={0, 1}, done=set(), retiring=set(),
+        retry_at={2: now + 30.0}, now=now)
+    assert want == {0, 1, 3}
+
+
+def test_pick_serve_slots_falls_back_to_quarantine_when_nothing_healthy():
+    now = 100.0
+    want = Agent._pick_serve_slots(
+        desired=3, max_slots=4, running={0, 1}, done=set(), retiring=set(),
+        retry_at={2: now + 30.0, 3: now + 5.0}, now=now)
+    # both spares cooling: still reach desired, taking quarantined slots
+    assert want == {0, 1, 2} or want == {0, 1, 3}
+    assert len(want) == 3
+
+
+def test_pick_serve_slots_never_churns_running_and_skips_retiring():
+    now = 0.0
+    # scale-down keeps a running prefix — no healthy replica is replaced
+    assert Agent._pick_serve_slots(1, 4, {0, 1, 2}, set(), set(), {}, now) == {0}
+    # a slot mid-retirement is not kept and not re-picked as a spare
+    assert Agent._pick_serve_slots(
+        2, 4, {0, 1, 2}, set(), {1}, {}, now) == {0, 2}
+    # permanently-failed (done) slots are never picked
+    assert Agent._pick_serve_slots(
+        3, 3, {0}, {1}, set(), {}, now) == {0, 2}
+
+
+# ---------------------------------------------------------------------------
+# Unit tier: the serve_scale.json protocol
+# ---------------------------------------------------------------------------
+
+def test_serve_scale_file_roundtrip_and_torn_reads(tmp_path):
+    out = str(tmp_path)
+    assert resilience.read_serve_scale(out) is None  # absent
+    write_serve_scale(out, 3, 7)
+    assert resilience.read_serve_scale(out) == {"replicas": 3, "seq": 7}
+    # a torn/garbage marker reads as None, never a crash or a bad target
+    with open(resilience.serve_scale_path(out), "w") as f:
+        f.write('{"replicas": 3, "se')
+    assert resilience.read_serve_scale(out) is None
+
+
+# ---------------------------------------------------------------------------
+# Controller tier: actuation + journal schema + rendering + gauges
+# ---------------------------------------------------------------------------
+
+class _DataplaneStub:
+    def __init__(self):
+        self.calls = []
+
+    def scale(self, workers):
+        self.calls.append(int(workers))
+
+
+def test_controller_applies_decisions_and_journals_fleet_scale(tmp_path):
+    out = str(tmp_path)
+    part = os.path.join(out, "telemetry.jsonl.part3100")
+    journal = ValidatedJournal(part, label="autoscale journal")
+    dp = _DataplaneStub()
+    policy = AutoscalePolicy(
+        _acfg(serve_max=3, cooldown_s=10.0, down_stable_s=0.0),
+        serve_n=2, data_n=2)
+    ctl = AutoscaleController(journal.event, out, policy, dataplane=dp)
+    # construction seeds the published target at seq 0 (= "no decision yet")
+    assert resilience.read_serve_scale(out) == {"replicas": 2, "seq": 0}
+
+    ctl.on_alarm(_fire())
+    ctl.on_alarm(_fire(rule="dw", metric="data_wait_frac", value=0.5))
+    ctl.poll(None, now=0.0)   # serve 2->3, data 2->4
+    ctl.poll(None, now=1.0)   # serve at max -> training preempt
+    assert ctl.training_hold
+    ctl.on_alarm(_clear())
+    ctl.on_alarm(_clear(rule="dw", metric="data_wait_frac", value=0.01))
+    ctl.poll(None, now=2.0)   # clocks arm
+    ctl.poll(None, now=3.0)   # training resume (data still in cooldown)
+    assert not ctl.training_hold
+    ctl.poll(None, now=12.0)  # data cooldown expired: 4->2
+    journal.close()
+
+    # actuators: scale file tracks the serve target with an advancing seq
+    sc = resilience.read_serve_scale(out)
+    assert sc["replicas"] == 3 and sc["seq"] >= 1
+    assert dp.calls and dp.calls[0] == 4 and dp.calls[-1] == 2
+
+    # every decision is a schema-valid typed record
+    assert validate_journal(part) == []
+    recs = [r for r in read_journal(part) if r["kind"] == "fleet_scale"]
+    acts = [(r["resource"], r["action"]) for r in recs]
+    assert (RESOURCE_SERVE, "up") in acts
+    assert (RESOURCE_DATA, "up") in acts
+    assert (RESOURCE_TRAIN, "preempt") in acts
+    assert (RESOURCE_TRAIN, "resume") in acts
+    assert [r["seq"] for r in recs] == list(range(1, len(recs) + 1))
+    assert all("warm_pool" in r and "reason" in r for r in recs)
+
+    # and `obs summarize` renders the autoscale section from them
+    text = render(read_journal(part))
+    assert "autoscale:" in text
+    assert re.search(r"up serve_replicas: 2 -> 3 on p99_breach", text), text
+    assert "preempt train_jobs" in text and "resume train_jobs" in text
+
+
+def test_fleet_scale_schema_rejects_missing_fields():
+    assert validate_record(
+        {"ts": 1.0, "kind": "fleet_scale", "resource": "serve_replicas",
+         "action": "up", "from_n": 2, "to_n": 3, "reason": "r"}) == []
+    assert validate_record(
+        {"ts": 1.0, "kind": "fleet_scale", "resource": "serve_replicas",
+         "action": "up", "from_n": 2, "to_n": 3})  # reason missing
+    assert validate_record(
+        {"ts": 1.0, "kind": "fleet_scale", "resource": "serve_replicas",
+         "action": "up", "from_n": "two", "to_n": 3, "reason": "r"})
+
+
+def test_aggregator_folds_fleet_scale_into_gauges_and_prometheus():
+    from distribuuuu_tpu.obs.exporter import render_prometheus
+
+    agg = LiveAggregator()
+    agg.ingest_all([
+        {"ts": 1.0, "kind": "fleet_scale", "resource": "serve_replicas",
+         "action": "up", "from_n": 2, "to_n": 3, "reason": "r",
+         "rule": "p99_breach", "warm_pool": 0, "seq": 1},
+        {"ts": 2.0, "kind": "fleet_scale", "resource": "serve_replicas",
+         "action": "applied", "from_n": 2, "to_n": 3, "reason": "landed",
+         "seq": 1, "wall_s": 0.8},
+        {"ts": 3.0, "kind": "fleet_scale", "resource": "data_workers",
+         "action": "up", "from_n": 2, "to_n": 4, "reason": "r",
+         "warm_pool": 1, "seq": 2},
+        {"ts": 4.0, "kind": "fleet_scale", "resource": "train_jobs",
+         "action": "preempt", "from_n": 1, "to_n": 0, "reason": "r",
+         "seq": 3},
+    ])
+    snap = agg.snapshot(now=5.0)
+    # desired (policy) and replicas (actuator's applied report) both surface
+    assert snap["per_model"]["fleet_desired"]["all"] == 3.0
+    assert snap["per_model"]["fleet_replicas"]["all"] == 3.0
+    assert snap["gauges"]["fleet_data_workers_desired"] == 4.0
+    assert snap["gauges"]["fleet_training_held"] == 1.0
+    assert snap["gauges"]["fleet_warm_pool"] == 1.0
+    assert snap["counters"]["fleet_scale_decisions_total"] == 4.0
+    text = render_prometheus(snap)
+    assert 'dtpu_fleet_replicas{model="all"}' in text
+    assert 'dtpu_fleet_desired{model="all"}' in text
+    assert "dtpu_fleet_warm_pool" in text
+    assert "# TYPE dtpu_fleet_scale_decisions_total counter" in text
+
+
+def test_controller_from_cfg_gate_and_serve_n_derivation(fresh_cfg, tmp_path):
+    from distribuuuu_tpu.fleet_autoscale import controller_from_cfg
+
+    fresh_cfg.OUT_DIR = str(tmp_path)
+    events = []
+    # disabled (the default): no controller, no scale file
+    assert controller_from_cfg(lambda k, **f: events.append(k)) is None
+    fresh_cfg.FLEET.AUTOSCALE.ENABLE = True
+    fresh_cfg.AGENT.SERVE = True
+    fresh_cfg.AGENT.NPROCS = 2
+    ctl = controller_from_cfg(lambda k, **f: events.append(k))
+    assert ctl is not None and ctl.policy.serve_n == 2
+    assert resilience.read_serve_scale(str(tmp_path)) == {"replicas": 2, "seq": 0}
+    # a training pool (AGENT.SERVE off) arms with serve_n 0: the training
+    # reservoir is the only serving-spike lever
+    fresh_cfg.AGENT.SERVE = False
+    ctl2 = controller_from_cfg(lambda k, **f: events.append(k))
+    assert ctl2 is not None and ctl2.policy.serve_n == 0
+
+
+# ---------------------------------------------------------------------------
+# Chaos tier: spike preempts a real training gang, clears, bitwise resume
+# ---------------------------------------------------------------------------
+
+def _fleet_env(extra=None):
+    env = dict(os.environ)
+    for k in ("DTPU_FLEET_CONTROLLER", "DTPU_FLEET_HOST", "DTPU_FLEET_EPOCH",
+              "DTPU_FLEET_SIGNALS", "DTPU_FAULT_KILL_STEP",
+              "DTPU_TEST_KILL_HOST", "DTPU_TEST_HANG_TIMEOUT_S",
+              "XLA_FLAGS"):
+        env.pop(k, None)
+    env.update(extra or {})
+    return env
+
+
+def _fleet_cmd(out_dir, max_epoch, overrides=()):
+    return [
+        sys.executable, "-m", "distribuuuu_tpu.fleet",
+        "OUT_DIR", str(out_dir),
+        "FLEET.HOSTS", "2",
+        "FLEET.NPROCS_PER_HOST", "1",
+        "FLEET.DRAIN_S", "12",
+        "FLEET.HOST_COOLDOWN_S", "0",
+        "FLEET.BACKOFF_BASE_S", "0.05", "FLEET.BACKOFF_MAX_S", "0.2",
+        "AGENT.CMD", f"{sys.executable} {WORKER} {out_dir} {max_epoch}",
+        "AGENT.CPU_DEVICES_PER_WORKER", "1",
+        "AGENT.PREFLIGHT_DEVICE_PROBE", "False",
+        "AGENT.MIN_FREE_DISK_GB", "0",
+        "AGENT.EXIT_BARRIER_S", "45",
+        *[str(x) for x in overrides],
+    ]
+
+
+def _digests(stdout):
+    return set(re.findall(r"FLEET DIGEST (\w+)", stdout))
+
+
+def _journal(out_dir):
+    return list(read_journal(os.path.join(str(out_dir), "telemetry.jsonl")))
+
+
+def _final_window_losses(out_dir):
+    out = {}
+    for r in _journal(out_dir):
+        if r.get("kind") == "window" and r.get("loss") is not None:
+            out[r["gstep"]] = r["loss"]
+    return out
+
+
+def _inject_slo(out_dir, p99_ms):
+    """Append one schema-valid serve_slo window into the free .part900
+    continuation — the pool's tailer folds it like any replica's rollup,
+    so the alarm engine sees a synthetic traffic spike (or calm)."""
+    rec = {"ts": time.time(), "kind": "serve_slo", "model": "rn",
+           "replica": 9, "window_s": 1.0, "requests": 32, "shed": 0,
+           "qps": 32.0, "p50_ms": p99_ms / 2.0, "p99_ms": p99_ms,
+           "mean_fill": 0.9, "queue_depth": 0, "batches": 8}
+    with open(os.path.join(str(out_dir), "telemetry.jsonl.part900"), "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+@pytest.fixture(scope="module")
+def autoscale_fleet_reference(tmp_path_factory):
+    """Uninterrupted 2-host gang: the bitwise oracle for the preempt test."""
+    out = tmp_path_factory.mktemp("as_ref") / "out"
+    p = subprocess.run(_fleet_cmd(out, max_epoch=2), cwd=REPO,
+                       env=_fleet_env(), capture_output=True, text=True,
+                       timeout=560)
+    assert p.returncode == 0, p.stdout[-4000:] + p.stderr[-2000:]
+    digests = _digests(p.stdout)
+    assert len(digests) == 1, f"hosts disagree on final params: {digests}"
+    return {"digest": digests, "losses": _final_window_losses(out)}
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_spike_preempts_training_and_resume_is_bitwise(
+        autoscale_fleet_reference, tmp_path):
+    """The training-reservoir path end to end on a REAL gang: a pure
+    training pool (no serving tier) with the autoscaler armed gets an
+    injected p99 spike → the policy preempts the running job through the
+    cooperative-stop protocol (``fleet_preempt by=autoscale``, emergency
+    checkpoint, preempted verdict) → the spike clears → after the
+    sustained-health window the job relaunches into elastic resume and
+    finishes with final params and a per-step loss stream bitwise
+    identical to the uninterrupted reference."""
+    out = tmp_path / "out"
+    cmd = _fleet_cmd(out, max_epoch=2, overrides=[
+        "FLEET.AUTOSCALE.ENABLE", "True",
+        "FLEET.AUTOSCALE.COOLDOWN_S", "1.0",
+        "FLEET.AUTOSCALE.DOWN_STABLE_S", "2.0",
+        "OBS.ALARMS", "['p99_breach=serve_p99_ms>250']",
+        "OBS.TAIL_INTERVAL_S", "0.2",
+    ])
+    proc = subprocess.Popen(cmd, cwd=REPO, env=_fleet_env(),
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    out_text = ""
+    try:
+        # wait for real training steps (past compile) before spiking
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                break
+            try:
+                if any(r.get("kind") == "window" for r in _journal(out)):
+                    break
+            except OSError:
+                pass
+            time.sleep(0.3)
+        assert proc.poll() is None, "fleet exited before the spike landed"
+
+        # breach until the policy takes the training reservoir
+        deadline = time.time() + 120
+        preempted = False
+        while time.time() < deadline and proc.poll() is None:
+            _inject_slo(out, p99_ms=900.0)
+            if any(r.get("kind") == "fleet_preempt"
+                   and r.get("by") == "autoscale" for r in _journal(out)):
+                preempted = True
+                break
+            time.sleep(0.25)
+        assert preempted, "spike never preempted training"
+
+        # calm traffic: the alarm clears, the health window elapses, the
+        # parked job elastic-resumes and runs to completion
+        while proc.poll() is None:
+            _inject_slo(out, p99_ms=10.0)
+            time.sleep(0.25)
+        out_text, _ = proc.communicate(timeout=560)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            out_text, _ = proc.communicate()
+    assert proc.returncode == 0, out_text[-4000:]
+
+    recs = _journal(out)
+    assert validate_journal(os.path.join(str(out), "telemetry.jsonl")) == []
+    # the decision trail: preempt + resume as typed fleet_scale records
+    scale = [r for r in recs if r["kind"] == "fleet_scale"]
+    assert any(r["resource"] == "train_jobs" and r["action"] == "preempt"
+               for r in scale), scale
+    assert any(r["resource"] == "train_jobs" and r["action"] == "resume"
+               for r in scale), scale
+    # the alarm fired AND cleared (both relayed as fleet_alarm records)
+    states = {r["state"] for r in recs if r["kind"] == "fleet_alarm"}
+    assert states >= {"fire", "clear"}, states
+    # the job was preempted once and came back clean
+    verdicts = [r["verdict"] for r in recs if r["kind"] == "fleet_verdict"]
+    assert "preempted" in verdicts and verdicts[-1] == "clean", verdicts
+    # bitwise: same final params, same per-step losses as the reference
+    assert _digests(out_text) == autoscale_fleet_reference["digest"]
+    assert _final_window_losses(out) == autoscale_fleet_reference["losses"]

@@ -462,7 +462,8 @@ def test_alarm_streak_resets_on_interleaved_ok():
 
 def test_fleet_alarm_hook_record_is_schema_valid(tmp_path):
     """The fleet controller's hook shape: every fire/clear becomes a typed
-    ``fleet_alarm`` record (the PR-12 autoscaler trigger, no action taken)."""
+    ``fleet_alarm`` record — the transition the FLEET.AUTOSCALE policy
+    (fleet_autoscale.py) consumes to scale capacity."""
     journal = Journal(str(tmp_path / "f.jsonl"))
 
     def hook(transition):
