@@ -12,16 +12,21 @@ Two halves:
 
 * **Static** (`lint_paths`, ``python -m distribuuuu_tpu.analysis`` /
   ``dtpu-lint``): an AST pass with six per-file JAX rules (DT001–DT006, one
-  module each under :mod:`distribuuuu_tpu.analysis.rules`) plus the
+  module each under :mod:`distribuuuu_tpu.analysis.rules`), the
   interprocedural SPMD series (DT101–DT104) backed by the repo-wide
   call-graph/collective-summary index :class:`~.ipa.ProgramIndex`
-  (:mod:`.ipa`), inline ``# dtpu-lint: disable=...`` suppressions, and a
-  committed-baseline mechanism for grandfathered findings
-  (:mod:`.baseline`).
+  (:mod:`.ipa`), and the control-plane concurrency series (DT201–DT204)
+  backed by the thread/lock/journal model
+  :class:`~.concurrency.ConcurrencyIndex` (:mod:`.concurrency`) — plus
+  inline ``# dtpu-lint: disable=...`` suppressions and a committed-baseline
+  mechanism for grandfathered findings (:mod:`.baseline`).
 * **Runtime** (:mod:`.guards`): :class:`CompileGuard` asserts an exact
   compile count over a region (a training epoch must compile its step
-  exactly once) and :class:`TransferGuard` wraps ``jax.transfer_guard`` so
-  tests can pin that host transfers happen only at PRINT_FREQ boundaries.
+  exactly once), :class:`TransferGuard` wraps ``jax.transfer_guard`` so
+  tests can pin that host transfers happen only at PRINT_FREQ boundaries,
+  and :class:`LockOrderGuard` records runtime lock-acquisition order and
+  fails a test run that ever takes two locks in both orders (the dynamic
+  complement of DT202).
 
 See docs/STATIC_ANALYSIS.md for the rule catalog and CI wiring.
 """
@@ -36,9 +41,12 @@ from distribuuuu_tpu.analysis.core import (
     lint_paths,
     lint_sources,
 )
+from distribuuuu_tpu.analysis.concurrency import ConcurrencyIndex
 from distribuuuu_tpu.analysis.guards import (
     CompileGuard,
     CompileGuardError,
+    LockOrderError,
+    LockOrderGuard,
     TransferGuard,
     allow_transfers,
 )
@@ -48,7 +56,10 @@ __all__ = [
     "Baseline",
     "CompileGuard",
     "CompileGuardError",
+    "ConcurrencyIndex",
     "Finding",
+    "LockOrderError",
+    "LockOrderGuard",
     "ProgramIndex",
     "TransferGuard",
     "all_rules",

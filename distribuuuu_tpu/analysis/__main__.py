@@ -8,6 +8,7 @@
     dtpu-lint --format json ...                           # machine-readable
     dtpu-lint --format github ...                         # CI inline annotations
     dtpu-lint --stats ...                                 # per-rule wall time
+    dtpu-lint --diff origin/main ...                      # report changed files only
 
 The baseline file defaults to ``.dtpu-lint-baseline.json`` in the current
 directory when it exists (the committed repo-root convention); pass
@@ -62,7 +63,39 @@ def _build_parser() -> argparse.ArgumentParser:
         help="report per-rule wall time (and the shared parse/model/ipa passes)",
     )
     ap.add_argument("--list-rules", action="store_true", help="print the rule catalog")
+    ap.add_argument(
+        "--diff",
+        metavar="GIT_REF",
+        default=None,
+        help="report findings only in files changed vs GIT_REF (plus "
+        "untracked files); the cross-file passes still index every path "
+        "given, so interprocedural findings stay exact",
+    )
     return ap
+
+
+def _changed_files(ref: str) -> set[str] | None:
+    """Absolute paths changed vs ``ref``, plus untracked files. Returns
+    None when git is unavailable or ``ref`` doesn't resolve."""
+    import subprocess
+
+    out: set[str] = set()
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+        for cmd in (
+            ["git", "diff", "--name-only", ref, "--"],
+            ["git", "ls-files", "--others", "--exclude-standard"],
+        ):
+            res = subprocess.run(
+                cmd, capture_output=True, text=True, check=True, cwd=top
+            )
+            out.update(p for p in res.stdout.splitlines() if p.strip())
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    return {os.path.join(top, p) for p in out}
 
 
 def _gh_escape(s: str) -> str:
@@ -86,6 +119,15 @@ def main(argv: list[str] | None = None) -> int:
 
     if not args.paths:
         print("dtpu-lint: no paths given (try: dtpu-lint distribuuuu_tpu/)", file=sys.stderr)
+        return 2
+
+    if args.diff and args.write_baseline:
+        # a diff-filtered write would drop every unchanged file's entries
+        print(
+            "dtpu-lint: refusing --write-baseline with --diff "
+            "(would discard the unchanged files' baseline entries)",
+            file=sys.stderr,
+        )
         return 2
 
     select = None
@@ -117,6 +159,23 @@ def main(argv: list[str] | None = None) -> int:
     anchor = os.path.dirname(os.path.abspath(baseline_path or DEFAULT_BASELINE))
     findings = normalize_paths(findings, anchor)
 
+    if args.diff:
+        changed = _changed_files(args.diff)
+        if changed is None:
+            print(
+                f"dtpu-lint: --diff {args.diff}: not a git checkout or "
+                "unresolvable ref",
+                file=sys.stderr,
+            )
+            return 2
+        # filter REPORTING only, after the full-index lint: DT005/DT10x/DT2xx
+        # summaries still span every path given, so a change that breaks an
+        # UNCHANGED file still surfaces — at that file — on a full run
+        changed_rel = {
+            os.path.relpath(p, anchor).replace(os.sep, "/") for p in changed
+        }
+        findings = [f for f in findings if f.path in changed_rel]
+
     if stats is not None:
         total = sum(stats.values())
         print(f"dtpu-lint: --stats (total {total * 1000:.0f} ms)", file=sys.stderr)
@@ -144,6 +203,10 @@ def main(argv: list[str] | None = None) -> int:
         except (OSError, ValueError, KeyError) as exc:
             print(f"dtpu-lint: bad baseline {baseline_path}: {exc}", file=sys.stderr)
             return 2
+        if select is not None or args.diff:
+            # staleness is only judgeable on a full-rule full-tree run: a
+            # scoped run trivially leaves every out-of-scope entry unmatched
+            stale = []
 
     if args.format == "github":
         # GitHub Actions workflow commands: each finding becomes an inline

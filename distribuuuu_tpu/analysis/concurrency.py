@@ -1,0 +1,1230 @@
+"""Concurrency model — the substrate under the DT2xx rules.
+
+PRs 8–16 grew a multi-threaded control plane (serve batcher, dataplane
+dispatcher, live aggregator, fleet controller, autoscaler) whose race and
+deadlock bugs were all caught by hand. This module builds, once per lint
+run, the repo-wide picture the DT2xx rules query:
+
+* a **lock census**: every ``threading.Lock/RLock/Condition/Semaphore``
+  bound to an instance attribute, a module global, or a function local,
+  identified by a path-qualified id (``batcher.MicroBatcher._lock``).
+  ``Condition(self._lock)`` aliases to the lock it wraps — acquiring the
+  condition IS acquiring that lock, so no false lock-pair edge appears.
+  Lock *containers* (``self._cond[model] = Condition()``) collapse to one
+  ``attr[*]`` id; self-edges on container ids are exempt (two distinct
+  elements are two distinct locks).
+* a **per-function lexical walk** tracking the ``with``-held lock set:
+  nested acquisitions (DT202 order pairs), calls made while holding
+  (expanded through callee summaries), blocking operations under a held
+  lock (DT203), and every ``self.X`` read/write with the guard set in
+  force at the access (DT201).
+* a **caller-ward fixpoint** (the IPA pattern, :mod:`.ipa`): per-function
+  transitive lock-acquisition and blocking summaries propagate until
+  stable, so ``with A: self._helper()`` sees the ``with B:`` two helpers
+  down. Calls resolve intra-class first (``self.m()`` → this class's
+  ``m``), then by unqualified name repo-wide with ambiguous names dropped
+  — conservative: common method names (``stop``, ``flush``) go dark, a
+  documented blind spot.
+* a **thread-entry model** per class: ``Thread(target=self.m)`` /
+  ``Timer(..., self.m)`` roots (self-concurrent when constructed in a
+  loop or more than once), socketserver/http handler classes, methods
+  escaping as hooks (``self.m`` passed as a value), and the *external*
+  domain (public methods, callable from any thread). DT201 flags state
+  reachable from two domains without a common guard.
+* a **journal part census** (DT204): every ``f"...part{N}"`` namespace
+  claim, resolved to a point or a ``[base, base+999]`` range — through
+  module int constants, ``BASE + var`` arithmetic, and one level of
+  caller argument binding — with overlaps and statically-unboundable
+  claims flagged.
+
+Blind spots (deliberate; docs/STATIC_ANALYSIS.md): dynamic dispatch,
+lock identity through attribute chains (``stream.cond``) and across
+objects, ``acquire()``/``release()`` pairs in try/finally (ordering is
+still recorded; the held region is not), and monotonic bool flags
+(``self._stop = True``), which are exempt from DT201 by design.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from distribuuuu_tpu.analysis.rules.common import RawFinding, call_name
+
+LOCK_CTORS = frozenset(
+    {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+)
+# thread-safe by construction: writes through these are not shared-state races
+_SAFE_CTORS = frozenset(
+    {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue", "Event", "Barrier"}
+)
+_MUTATORS = frozenset(
+    {
+        "append",
+        "appendleft",
+        "extend",
+        "insert",
+        "add",
+        "update",
+        "setdefault",
+        "pop",
+        "popleft",
+        "popitem",
+        "remove",
+        "discard",
+        "clear",
+    }
+)
+_THREAD_CTORS = frozenset({"Thread", "Timer"})
+# io-protocol names whose receivers are overwhelmingly file/stream objects:
+# `self._f.flush()` must not resolve to some class's `flush` method by bare
+# name — a false resolution here fabricates lock-order edges out of thin air
+_IO_GENERIC = frozenset(
+    {"flush", "close", "write", "read", "readline", "seek", "truncate", "fileno"}
+)
+_HANDLER_BASE_RE = re.compile(r"RequestHandler|ThreadingMixIn")
+# receivers whose .wait()/.communicate() is a process wait, not a Condition
+_PROC_RECV_RE = re.compile(r"(^|_)(proc|popen|process|child)", re.IGNORECASE)
+
+_FIXPOINT_ROUNDS = 8  # matches ipa.py: ≥ max helper nesting we see through
+_RANGE_WIDTH = 1000  # `BASE + var` claims own [BASE, BASE+999]
+
+
+def blocking_desc(call: ast.Call) -> str | None:
+    """Human-readable label when this call can block indefinitely, else None.
+
+    The DT203 alphabet: sleeps, socket accept/recv, process waits, untimed
+    ``Queue.get``/``join``, and durability barriers (``commit``/``fsync``
+    — an fsync under a hot lock serializes every other thread behind the
+    disk). ``cond.wait(...)`` is deliberately NOT here: waiting on a
+    Condition releases the lock it wraps.
+    """
+    cn = call_name(call)
+    if cn is None:
+        return None
+    if cn == "sleep":
+        return "sleep()"
+    if cn in {"accept", "recv", "recvfrom", "recv_into"}:
+        return f"socket .{cn}()"
+    if cn in {"commit", "fsync"}:
+        return f".{cn}() durability barrier"
+    recv = call.func.value if isinstance(call.func, ast.Attribute) else None
+    recv_name = None
+    if isinstance(recv, ast.Name):
+        recv_name = recv.id
+    elif isinstance(recv, ast.Attribute):
+        recv_name = recv.attr
+    if cn in {"wait", "communicate"} and recv_name and _PROC_RECV_RE.search(recv_name):
+        return f"process .{cn}()"
+    has_kw = {k.arg for k in call.keywords}
+    if cn == "get" and not call.args and not ({"timeout", "block"} & has_kw):
+        return "untimed Queue.get()"
+    if cn == "join" and not call.args and "timeout" not in has_kw:
+        return "untimed .join()"
+    return None
+
+
+def _is_lock_ctor(expr: ast.AST) -> bool:
+    return isinstance(expr, ast.Call) and call_name(expr) in LOCK_CTORS
+
+
+def _is_safe_ctor(expr: ast.AST) -> bool:
+    return isinstance(expr, ast.Call) and call_name(expr) in _SAFE_CTORS
+
+
+def _self_attr(expr: ast.AST) -> str | None:
+    """``self.X`` / ``cls.X`` → ``X``."""
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id in ("self", "cls")
+    ):
+        return expr.attr
+    return None
+
+
+@dataclass
+class FuncConc:
+    """Concurrency summary for one function/method definition."""
+
+    name: str
+    qual: str
+    path: str
+    stem: str
+    node: ast.AST
+    cls: str | None = None
+    params: tuple = ()
+    # direct facts from the lexical walk
+    acquires: dict = field(default_factory=dict)  # lock id -> first site node
+    order_pairs: list = field(default_factory=list)  # (outer, inner, node)
+    calls: list = field(default_factory=list)  # (held tuple, callee, node, is_self)
+    blocking: dict = field(default_factory=dict)  # desc -> node
+    blocking_under: list = field(default_factory=list)  # (held id, node, desc)
+    self_access: list = field(default_factory=list)  # (attr, write, node, held, value)
+    thread_targets: list = field(default_factory=list)  # (name, in_loop, node, is_self)
+    hook_refs: list = field(default_factory=list)  # (method name, node)
+    global_writes: list = field(default_factory=list)  # (name, node, held)
+    # fixpoint-propagated
+    acquires_trans: dict = field(default_factory=dict)  # lock id -> via tuple
+    blocking_trans: dict = field(default_factory=dict)  # desc -> via tuple
+
+
+@dataclass
+class _ClassConc:
+    name: str
+    path: str
+    stem: str
+    node: ast.AST
+    methods: dict = field(default_factory=dict)  # name -> FuncConc
+    lock_attrs: dict = field(default_factory=dict)  # attr -> lock id
+    container_attrs: dict = field(default_factory=dict)  # attr -> lock id
+    safe_attrs: set = field(default_factory=set)
+    handler: bool = False
+
+
+@dataclass
+class PartClaim:
+    """One ``.partN`` journal-namespace claim site."""
+
+    path: str
+    line: int
+    col: int
+    label: str
+    intervals: tuple | None  # ((lo, hi), ...) or None when unresolvable
+    # the named constant every resolution path went through, when there is
+    # exactly one (``SIDECAR_PART``): claims sharing an origin are ONE
+    # namespace owner referenced from several places, not two writers —
+    # deriving the part from a shared ``*_PART`` constant is precisely the
+    # remediation the overlap finding prescribes, so it must also be the
+    # exemption
+    origin: str | None = None
+
+
+_AMBIGUOUS = object()
+
+
+class ConcurrencyIndex:
+    """Repo-wide thread/lock/journal model, built once per lint run."""
+
+    def __init__(self, trees: dict[str, ast.AST], models: dict | None = None):
+        self._models = models or {}
+        self._tree_path: dict[int, str] = {id(t): p for p, t in trees.items()}
+        self.funcs: list[FuncConc] = []
+        self.classes: list[_ClassConc] = []
+        self._by_name: dict[str, object] = {}  # name -> FuncConc | _AMBIGUOUS
+        self._module_locks: dict[str, dict[str, str]] = {}  # path -> name -> id
+        self._module_consts: dict[str, dict[str, int]] = {}
+        self._part_consts: dict[str, int] = {}  # *_PART ints, repo-wide
+        self.claims: list[PartClaim] = []
+        self._findings: dict[str, dict[str, list[RawFinding]]] = {}
+
+        for path, tree in trees.items():
+            self._scan_module(path, tree)
+        self._fixpoint()
+        self._resolve_claims(trees)
+        for path in trees:
+            self._findings[path] = {
+                "DT201": [],
+                "DT202": [],
+                "DT203": [],
+                "DT204": [],
+            }
+        self._compute_dt201()
+        self._compute_dt202()
+        self._compute_dt203()
+        self._compute_dt204()
+
+    # -- rule-facing query ---------------------------------------------------
+
+    def findings(self, code: str, tree: ast.AST) -> list[RawFinding]:
+        path = self._tree_path.get(id(tree))
+        if path is None:
+            return []
+        return self._findings.get(path, {}).get(code, [])
+
+    # -- module scan ---------------------------------------------------------
+
+    def _nodes_of(self, path: str, tree: ast.AST) -> list:
+        m = self._models.get(path)
+        return m.nodes if m is not None else list(ast.walk(tree))
+
+    @staticmethod
+    def _stem(path: str) -> str:
+        base = path.replace("\\", "/").rsplit("/", 1)[-1]
+        return base[:-3] if base.endswith(".py") else base
+
+    def _scan_module(self, path: str, tree: ast.AST) -> None:
+        stem = self._stem(path)
+        mod_locks: dict[str, str] = {}
+        mod_consts: dict[str, int] = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if not isinstance(t, ast.Name):
+                    continue
+                if _is_lock_ctor(node.value):
+                    mod_locks[t.id] = f"{stem}.{t.id}"
+                elif isinstance(node.value, ast.Constant) and isinstance(
+                    node.value.value, int
+                ) and not isinstance(node.value.value, bool):
+                    mod_consts[t.id] = node.value.value
+                    if t.id.endswith("_PART"):
+                        self._part_consts.setdefault(t.id, node.value.value)
+        self._module_locks[path] = mod_locks
+        self._module_consts[path] = mod_consts
+
+        # classes: direct methods + the lock-attribute census (two passes so
+        # `Condition(self._lock)` can alias to an already-seen plain lock)
+        classes_here: list[_ClassConc] = []
+        for node in self._nodes_of(path, tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cc = _ClassConc(name=node.name, path=path, stem=stem, node=node)
+            cc.handler = any(
+                _HANDLER_BASE_RE.search(ast.unparse(b) if not isinstance(b, ast.Name) else b.id)
+                for b in node.bases
+            )
+            classes_here.append(cc)
+            self.classes.append(cc)
+            method_defs = [
+                n
+                for n in node.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            self._census_lock_attrs(cc, method_defs)
+            for fn in method_defs:
+                fc = self._walk_function(fn, path, stem, cc, qual=f"{node.name}.{fn.name}")
+                cc.methods[fn.name] = fc
+                # nested defs inside methods close over self — they are the
+                # classic Thread(target=_run) bodies; fold them into the class
+                for sub in ast.walk(fn):
+                    if sub is fn or not isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        continue
+                    sfc = self._walk_function(
+                        sub, path, stem, cc, qual=f"{node.name}.{fn.name}.{sub.name}"
+                    )
+                    cc.methods.setdefault(sub.name, sfc)
+
+        # free functions (module level or nested outside classes)
+        class_fn_ids = set()
+        for cc in classes_here:
+            for fc in cc.methods.values():
+                class_fn_ids.add(id(fc.node))
+        for node in self._nodes_of(path, tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and id(node) not in class_fn_ids
+            ):
+                self._walk_function(node, path, stem, None, qual=node.name)
+
+    def _census_lock_attrs(self, cc: _ClassConc, method_defs: list) -> None:
+        assigns = []
+        for fn in method_defs:
+            for n in ast.walk(fn):
+                if isinstance(n, (ast.Assign, ast.AnnAssign)):
+                    assigns.append(n)
+                elif isinstance(n, ast.Call) and call_name(n) == "setdefault":
+                    # self.X.setdefault(k, Condition()) marks X a container
+                    recv = _self_attr(getattr(n.func, "value", None))
+                    if recv and len(n.args) == 2 and _is_lock_ctor(n.args[1]):
+                        cc.container_attrs.setdefault(
+                            recv, f"{cc.stem}.{cc.name}.{recv}[*]"
+                        )
+        # pass 1: plain lock / safe ctors on self attrs
+        for n in assigns:
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            value = n.value
+            if value is None:
+                continue
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    # self.X[k] = Condition() — container element store
+                    if (
+                        isinstance(t, ast.Subscript)
+                        and _self_attr(t.value)
+                        and _is_lock_ctor(value)
+                    ):
+                        a = _self_attr(t.value)
+                        cc.container_attrs.setdefault(
+                            a, f"{cc.stem}.{cc.name}.{a}[*]"
+                        )
+                    continue
+                if _is_lock_ctor(value) and not (
+                    call_name(value) == "Condition"
+                    and value.args
+                    and _self_attr(value.args[0])
+                ):
+                    cc.lock_attrs.setdefault(attr, f"{cc.stem}.{cc.name}.{attr}")
+                elif _is_safe_ctor(value):
+                    cc.safe_attrs.add(attr)
+        # pass 2: Condition(self._lock) aliases the wrapped lock's id — the
+        # condition and the lock are ONE lock, not an ordering pair
+        for n in assigns:
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            value = n.value
+            if not (
+                isinstance(value, ast.Call)
+                and call_name(value) == "Condition"
+                and value.args
+            ):
+                continue
+            wrapped = _self_attr(value.args[0])
+            if wrapped is None or wrapped not in cc.lock_attrs:
+                continue
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    cc.lock_attrs[attr] = cc.lock_attrs[wrapped]
+                elif isinstance(t, ast.Subscript) and _self_attr(t.value):
+                    a = _self_attr(t.value)
+                    cc.container_attrs[a] = cc.lock_attrs[wrapped]
+
+    # -- the per-function lexical walk ---------------------------------------
+
+    def _walk_function(
+        self,
+        fn: ast.AST,
+        path: str,
+        stem: str,
+        cc: _ClassConc | None,
+        qual: str,
+    ) -> FuncConc:
+        a = fn.args
+        params = tuple(p.arg for p in a.posonlyargs + a.args + a.kwonlyargs)
+        fc = FuncConc(
+            name=fn.name,
+            qual=qual,
+            path=path,
+            stem=stem,
+            node=fn,
+            cls=cc.name if cc else None,
+            params=params,
+        )
+        mod_locks = self._module_locks.get(path, {})
+        declared_global: set[str] = set()
+        aliases: dict[str, str] = {}
+
+        # pre-scan (order-insensitive): local lock aliases and globals
+        for n in self._own_nodes(fn):
+            if isinstance(n, ast.Global):
+                declared_global.update(n.names)
+            elif isinstance(n, ast.Assign) and len(n.targets) == 1:
+                t = n.targets[0]
+                if isinstance(t, ast.Name):
+                    lid = self._lock_id(n.value, cc, mod_locks, {})
+                    if lid is not None:
+                        aliases[t.id] = lid
+                    elif _is_lock_ctor(n.value):
+                        aliases[t.id] = f"{stem}.{qual}.{t.id}"
+            elif isinstance(n, ast.For):
+                # for k, cond in self._conds.items(): — cond aliases the container
+                it = n.iter
+                if isinstance(it, ast.Call) and call_name(it) in {"items", "values"}:
+                    src = getattr(it.func, "value", None)
+                    attr = _self_attr(src)
+                    if cc and attr in cc.container_attrs:
+                        names = [
+                            e.id
+                            for e in (
+                                n.target.elts
+                                if isinstance(n.target, ast.Tuple)
+                                else [n.target]
+                            )
+                            if isinstance(e, ast.Name)
+                        ]
+                        if names:
+                            aliases[names[-1]] = cc.container_attrs[attr]
+
+        consumed: set[int] = set()
+
+        def resolve(expr: ast.AST) -> str | None:
+            return self._lock_id(expr, cc, mod_locks, aliases)
+
+        def record_acquire(lid: str, held: tuple, node: ast.AST) -> None:
+            fc.acquires.setdefault(lid, node)
+            for h in held:
+                if h != lid:
+                    fc.order_pairs.append((h, lid, node))
+
+        def record_access(attr: str, write: bool, node, held, value) -> None:
+            if cc is None:
+                return
+            if (
+                attr in cc.lock_attrs
+                or attr in cc.container_attrs
+                or attr in cc.safe_attrs
+            ):
+                return
+            fc.self_access.append((attr, write, node, frozenset(held), value))
+
+        def thread_target_exprs(call: ast.Call):
+            for kw in call.keywords:
+                if kw.arg in ("target", "function"):
+                    yield kw.value
+            cn = call_name(call)
+            if cn == "Timer" and len(call.args) >= 2:
+                yield call.args[1]
+            elif cn == "Thread" and len(call.args) >= 2:
+                yield call.args[1]
+
+        def visit(node: ast.AST, held: tuple, loop: int) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return
+            if isinstance(node, (ast.For, ast.While)):
+                loop += 1
+            if isinstance(node, ast.With):
+                acquired: list[str] = []
+                for item in node.items:
+                    visit(item.context_expr, held + tuple(acquired), loop)
+                    lid = resolve(item.context_expr)
+                    if lid is not None:
+                        record_acquire(lid, held + tuple(acquired), item.context_expr)
+                        acquired.append(lid)
+                for stmt in node.body:
+                    visit(stmt, held + tuple(acquired), loop)
+                return
+            if isinstance(node, ast.Assign):
+                # simple `self.X = value`: record with the value expr so the
+                # bool-flag exemption can see what was stored
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        consumed.add(id(t))
+                        record_access(attr, True, t, held, node.value)
+                    elif isinstance(t, ast.Name) and t.id in declared_global:
+                        fc.global_writes.append((t.id, node, frozenset(held)))
+            elif isinstance(node, ast.AugAssign):
+                attr = _self_attr(node.target)
+                if attr is not None:
+                    consumed.add(id(node.target))
+                    record_access(attr, True, node.target, held, None)
+                elif (
+                    isinstance(node.target, ast.Name)
+                    and node.target.id in declared_global
+                ):
+                    fc.global_writes.append((node.target.id, node, frozenset(held)))
+            elif isinstance(node, ast.Subscript) and isinstance(node.ctx, (ast.Store, ast.Del)):
+                attr = _self_attr(node.value)
+                if attr is not None:
+                    consumed.add(id(node.value))
+                    record_access(attr, True, node.value, held, None)
+            elif isinstance(node, ast.Call):
+                self._handle_call(
+                    fc, cc, node, held, loop, resolve, record_acquire,
+                    record_access, consumed, thread_target_exprs,
+                )
+            elif isinstance(node, ast.Attribute) and id(node) not in consumed:
+                attr = _self_attr(node)
+                if attr is not None:
+                    if isinstance(node.ctx, (ast.Store, ast.Del)):
+                        record_access(attr, True, node, held, None)
+                    elif cc is not None and attr in cc.methods or (
+                        cc is not None
+                        and any(
+                            isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                            and m.name == attr
+                            for m in cc.node.body
+                        )
+                    ):
+                        # bare `self.m` escaping as a value = hook registration
+                        fc.hook_refs.append((attr, node))
+                    else:
+                        record_access(attr, False, node, held, None)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held, loop)
+
+        for stmt in fn.body:
+            visit(stmt, (), 0)
+        self.funcs.append(fc)
+        prev = self._by_name.get(fn.name)
+        if prev is None:
+            self._by_name[fn.name] = fc
+        elif prev is not _AMBIGUOUS and prev.node is not fn:
+            self._by_name[fn.name] = _AMBIGUOUS
+        return fc
+
+    def _handle_call(
+        self, fc, cc, node, held, loop, resolve, record_acquire,
+        record_access, consumed, thread_target_exprs,
+    ) -> None:
+        cn = call_name(node)
+        if cn is None:
+            return
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            consumed.add(id(func))  # `self.m(...)`: the func attr is a call, not a hook
+        if cn in _THREAD_CTORS:
+            for expr in thread_target_exprs(node):
+                attr = _self_attr(expr)
+                if attr is not None:
+                    consumed.add(id(expr))
+                    fc.thread_targets.append((attr, loop > 0, node, True))
+                elif isinstance(expr, ast.Name):
+                    fc.thread_targets.append((expr.id, loop > 0, node, False))
+            return
+        if isinstance(func, ast.Attribute):
+            recv_attr = _self_attr(func)
+            if recv_attr is not None and isinstance(func.ctx, ast.Load):
+                if cc is not None and recv_attr in cc.methods:
+                    fc.calls.append((held, recv_attr, node, True))
+                    return
+            # mutator write through a self attr (or an element of one):
+            # self._buf.append(x) / self._map[k].update(...)
+            if cn in _MUTATORS:
+                target = func.value
+                if isinstance(target, ast.Subscript):
+                    target = target.value
+                attr = _self_attr(target)
+                if attr is not None:
+                    consumed.add(id(target))
+                    record_access(attr, True, target, held, None)
+                # a mutator name on any other receiver is a container
+                # mutation (`batch.append(x)`), never a cross-object call —
+                # resolving it to a same-named method (Journal.append)
+                # fabricates blocking chains
+                return
+            if cn in _IO_GENERIC:
+                return
+            if cn == "acquire":
+                lid = resolve(func.value)
+                if lid is not None:
+                    record_acquire(lid, held, node)
+                return
+            if cn == "release":
+                return
+        desc = blocking_desc(node)
+        if desc is not None:
+            fc.blocking.setdefault(desc, node)
+            if held:
+                fc.blocking_under.append((held[-1], node, desc))
+            return
+        if _is_lock_ctor(node) or _is_safe_ctor(node):
+            return
+        fc.calls.append((held, cn, node, False))
+
+    def _own_nodes(self, fn: ast.AST):
+        """Descendants of ``fn`` excluding nested function bodies."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            yield n
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(n))
+
+    def _lock_id(
+        self, expr: ast.AST, cc: _ClassConc | None, mod_locks: dict, aliases: dict
+    ) -> str | None:
+        attr = _self_attr(expr)
+        if attr is not None and cc is not None:
+            return cc.lock_attrs.get(attr)
+        if isinstance(expr, ast.Subscript) and cc is not None:
+            a = _self_attr(expr.value)
+            if a is not None:
+                return cc.container_attrs.get(a)
+        if (
+            isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Attribute)
+            and expr.func.attr in {"get", "setdefault"}
+            and cc is not None
+        ):
+            # self._cond.get(model) pulls an element out of a lock
+            # container, exactly like self._cond[model]
+            a = _self_attr(expr.func.value)
+            if a is not None:
+                return cc.container_attrs.get(a)
+        if isinstance(expr, ast.Name):
+            return aliases.get(expr.id) or mod_locks.get(expr.id)
+        return None
+
+    # -- fixpoint ------------------------------------------------------------
+
+    def _resolve_call(self, fc: FuncConc, cn: str, is_self: bool) -> FuncConc | None:
+        if is_self and fc.cls is not None:
+            for cc in self.classes:
+                if cc.name == fc.cls and cc.path == fc.path:
+                    return cc.methods.get(cn)
+        target = self._by_name.get(cn)
+        return target if isinstance(target, FuncConc) else None
+
+    def _fixpoint(self) -> None:
+        for _ in range(_FIXPOINT_ROUNDS):
+            changed = False
+            for fc in self.funcs:
+                at = {lid: () for lid in fc.acquires}
+                bt = {d: () for d in fc.blocking}
+                for _held, cn, _node, is_self in fc.calls:
+                    callee = self._resolve_call(fc, cn, is_self)
+                    if callee is None or callee is fc:
+                        continue
+                    for lid, via in callee.acquires_trans.items():
+                        at.setdefault(lid, (cn,) + via)
+                    for d, via in callee.blocking_trans.items():
+                        bt.setdefault(d, (cn,) + via)
+                if at != fc.acquires_trans or bt != fc.blocking_trans:
+                    fc.acquires_trans, fc.blocking_trans = at, bt
+                    changed = True
+            if not changed:
+                break
+
+    # -- DT201: shared mutable state -----------------------------------------
+
+    def _compute_dt201(self) -> None:
+        for cc in self.classes:
+            self._dt201_class(cc)
+        self._dt201_globals()
+
+    def _dt201_class(self, cc: _ClassConc) -> None:
+        # thread/hook entry roots for this class, from every method's walk
+        thread_roots: dict[str, bool] = {}  # method -> self-concurrent
+        hook_roots: set[str] = set()
+        target_counts: dict[str, int] = {}
+        for fc in cc.methods.values():
+            for name, in_loop, _node, is_self in fc.thread_targets:
+                if name in cc.methods:
+                    target_counts[name] = target_counts.get(name, 0) + 1
+                    if in_loop or target_counts[name] > 1:
+                        thread_roots[name] = True
+                    else:
+                        thread_roots.setdefault(name, False)
+            for name, _node in fc.hook_refs:
+                if name in cc.methods and name not in thread_roots:
+                    hook_roots.add(name)
+        if cc.handler:
+            for m in cc.methods:
+                if not m.startswith("_"):
+                    thread_roots[m] = True
+        if not thread_roots and not hook_roots:
+            return  # no inferred foreign-thread entry: nothing to race with
+
+        # intra-class call graph → per-root reachable method sets
+        edges: dict[str, set[str]] = {m: set() for m in cc.methods}
+        for m, fc in cc.methods.items():
+            for _held, cn, _node, is_self in fc.calls:
+                if is_self and cn in cc.methods:
+                    edges[m].add(cn)
+
+        def reach(root: str) -> set[str]:
+            out, todo = {root}, [root]
+            while todo:
+                for nxt in edges.get(todo.pop(), ()):
+                    if nxt not in out:
+                        out.add(nxt)
+                        todo.append(nxt)
+            return out
+
+        public = {
+            m
+            for m in cc.methods
+            if (not m.startswith("_") or m == "__call__")
+            and m not in thread_roots
+            and m not in ("__init__", "__post_init__")
+        }
+        domains: list[tuple[str, bool, set[str]]] = []  # (label, self_conc, members)
+        for r, conc in sorted(thread_roots.items()):
+            domains.append((f"thread:{r}", conc, reach(r)))
+        for r in sorted(hook_roots):
+            domains.append((f"hook:{r}", False, reach(r)))
+        if public:
+            ext: set[str] = set()
+            for m in public:
+                ext |= reach(m)
+            domains.append(("external", False, ext))
+
+        # entry-held locks: a private method ALWAYS called under the lock is
+        # guarded at every access (intersection over intra-class call sites)
+        entry: dict[str, frozenset | None] = {m: None for m in cc.methods}
+        for m in cc.methods:
+            if m in thread_roots or m in hook_roots or m in public or m in (
+                "__init__",
+                "__post_init__",
+            ):
+                entry[m] = frozenset()
+        for _ in range(4):
+            changed = False
+            for m, fc in cc.methods.items():
+                base = entry[m]
+                for held, cn, _node, is_self in fc.calls:
+                    if not (is_self and cn in cc.methods):
+                        continue
+                    site = frozenset(held) | (base or frozenset())
+                    cur = entry[cn]
+                    new = site if cur is None else cur & site
+                    if new != cur:
+                        entry[cn] = new
+                        changed = True
+            if not changed:
+                break
+
+        # per-attribute access census across domains
+        per_attr: dict[str, list] = {}
+        for m, fc in cc.methods.items():
+            if m in ("__init__", "__post_init__"):
+                continue
+            doms = [
+                (label, conc) for label, conc, members in domains if m in members
+            ]
+            if not doms:
+                continue
+            guard_base = entry[m] or frozenset()
+            for attr, write, node, held, value in fc.self_access:
+                per_attr.setdefault(attr, []).append(
+                    (write, node, held | guard_base, doms, value)
+                )
+        for attr, accesses in sorted(per_attr.items()):
+            writes = [a for a in accesses if a[0]]
+            if not writes:
+                continue
+            # monotonic bool/None flags are the sanctioned lock-free idiom
+            if all(
+                isinstance(a[4], ast.Constant) and a[4].value in (True, False, None)
+                for a in writes
+            ):
+                continue
+            all_doms = {d for a in accesses for d, _c in a[3]}
+            self_conc = any(c for a in writes for _d, c in a[3])
+            if len(all_doms) < 2 and not self_conc:
+                continue
+            common = None
+            for a in accesses:
+                common = a[2] if common is None else common & a[2]
+            if common:
+                continue
+            site = min(writes, key=lambda a: (a[1].lineno, a[1].col_offset))
+            doms_str = ", ".join(sorted(all_doms))
+            self._findings[cc.path]["DT201"].append(
+                RawFinding(
+                    site[1].lineno,
+                    site[1].col_offset,
+                    "DT201",
+                    f"`{cc.name}.{attr}` is written here and accessed from "
+                    f"{len(all_doms)} thread entry domain(s) ({doms_str}) "
+                    "with no lock common to every access — torn reads/lost "
+                    "updates under preemption. Guard every access with one "
+                    "lock, or make the handoff immutable (build-then-swap a "
+                    "tuple/dict instead of mutating in place)",
+                )
+            )
+
+    def _dt201_globals(self) -> None:
+        # module globals rebound (via `global`) from a thread-target function
+        # and from any other function, with no common module-lock guard
+        by_mod: dict[str, dict[str, list]] = {}
+        thread_fns: dict[str, set[str]] = {}
+        for fc in self.funcs:
+            for name, _in_loop, _node, is_self in fc.thread_targets:
+                if not is_self:
+                    thread_fns.setdefault(fc.path, set()).add(name)
+            for gname, node, held in fc.global_writes:
+                by_mod.setdefault(fc.path, {}).setdefault(gname, []).append(
+                    (fc, node, held)
+                )
+        for path, globs in by_mod.items():
+            targets = thread_fns.get(path, set())
+            for gname, writes in sorted(globs.items()):
+                fns = {fc.name for fc, _n, _h in writes}
+                if len(fns) < 2 or not (fns & targets):
+                    continue
+                common = None
+                for _fc, _n, held in writes:
+                    common = held if common is None else common & held
+                if common:
+                    continue
+                fc, node, _h = min(
+                    writes, key=lambda w: (w[1].lineno, w[1].col_offset)
+                )
+                self._findings[path]["DT201"].append(
+                    RawFinding(
+                        node.lineno,
+                        node.col_offset,
+                        "DT201",
+                        f"module global `{gname}` is rebound from "
+                        f"{len(fns)} functions including thread target(s) "
+                        f"{sorted(fns & targets)} with no common lock — "
+                        "concurrent rebinds race. Guard the writes with one "
+                        "module lock",
+                    )
+                )
+
+    # -- DT202: lock-ordering cycles -----------------------------------------
+
+    def _compute_dt202(self) -> None:
+        # edge set: each function's locally-visible pairs — direct nested
+        # `with` pairs plus (held lock × callee's transitive acquisitions)
+        edges: dict[tuple[str, str], list] = {}
+        for fc in self.funcs:
+            for outer, inner, node in fc.order_pairs:
+                edges.setdefault((outer, inner), []).append((fc, node, ()))
+            for held, cn, node, is_self in fc.calls:
+                if not held:
+                    continue
+                callee = self._resolve_call(fc, cn, is_self)
+                if callee is None or callee is fc:
+                    continue
+                for lid, via in callee.acquires_trans.items():
+                    for h in held:
+                        if h != lid:
+                            edges.setdefault((h, lid), []).append(
+                                (fc, node, (cn,) + via)
+                            )
+        if not edges:
+            return
+        adj: dict[str, set[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, set()).add(b)
+
+        def reaches(src: str, dst: str) -> bool:
+            seen, todo = {src}, [src]
+            while todo:
+                for nxt in adj.get(todo.pop(), ()):
+                    if nxt == dst:
+                        return True
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        todo.append(nxt)
+            return False
+
+        for (a, b), sites in sorted(edges.items()):
+            if not reaches(b, a):
+                continue
+            for fc, node, via in sites:
+                chain = f" (via {'→'.join(via)})" if via else ""
+                self._findings[fc.path]["DT202"].append(
+                    RawFinding(
+                        node.lineno,
+                        node.col_offset,
+                        "DT202",
+                        f"lock order `{a}` → `{b}` acquired here{chain} "
+                        f"while the reverse order `{b}` → … → `{a}` also "
+                        "exists in this program: two threads taking the "
+                        "ends concurrently deadlock. Pick one global order "
+                        "(document it at the lock definitions) or collapse "
+                        "to one lock",
+                    )
+                )
+
+    # -- DT203: blocking call under a held lock ------------------------------
+
+    def _compute_dt203(self) -> None:
+        for fc in self.funcs:
+            for lid, node, desc in fc.blocking_under:
+                self._findings[fc.path]["DT203"].append(
+                    RawFinding(
+                        node.lineno,
+                        node.col_offset,
+                        "DT203",
+                        f"{desc} inside the `with {lid}:` body — every "
+                        "thread contending for the lock stalls behind this "
+                        "call. Move it outside the critical section "
+                        "(snapshot under the lock, act after release)",
+                    )
+                )
+            for held, cn, node, is_self in fc.calls:
+                if not held:
+                    continue
+                callee = self._resolve_call(fc, cn, is_self)
+                if callee is None or callee is fc or not callee.blocking_trans:
+                    continue
+                desc, via = sorted(callee.blocking_trans.items())[0]
+                chain = "→".join((cn,) + via)
+                self._findings[fc.path]["DT203"].append(
+                    RawFinding(
+                        node.lineno,
+                        node.col_offset,
+                        "DT203",
+                        f"call chain `{chain}` reaches {desc} while "
+                        f"`{held[-1]}` is held — the lock is pinned for the "
+                        "full blocking duration. Hoist the blocking work out "
+                        "of the critical section",
+                    )
+                )
+
+    # -- DT204: journal .partN namespace census ------------------------------
+
+    def _resolve_claims(self, trees: dict[str, ast.AST]) -> None:
+        callers: dict[str, list] = {}
+        for fc in self.funcs:
+            for _held, cn, node, _is_self in fc.calls:
+                callers.setdefault(cn, []).append((fc, node))
+        for path, tree in trees.items():
+            consts = dict(self._part_consts)
+            consts.update(self._module_consts.get(path, {}))
+            model = self._models.get(path)
+            nodes = model.nodes if model is not None else list(ast.walk(tree))
+            for node in nodes:
+                if not isinstance(node, ast.JoinedStr):
+                    continue
+                for i, seg in enumerate(node.values):
+                    if not (
+                        isinstance(seg, ast.Constant)
+                        and isinstance(seg.value, str)
+                        and ".part" in seg.value
+                    ):
+                        continue
+                    # `.part3000` written out literally in the constant
+                    for m in re.finditer(r"\.part(\d+)", seg.value):
+                        n = int(m.group(1))
+                        self.claims.append(
+                            PartClaim(
+                                path, node.lineno, node.col_offset,
+                                ".part" + m.group(1), ((n, n),),
+                            )
+                        )
+                    if not seg.value.endswith(".part"):
+                        continue
+                    if i + 1 >= len(node.values) or not isinstance(
+                        node.values[i + 1], ast.FormattedValue
+                    ):
+                        continue
+                    expr = node.values[i + 1].value
+                    fn = self._enclosing_func(path, node, model)
+                    ivals, label, origin = self._claim_intervals(
+                        expr, fn, consts, callers
+                    )
+                    self.claims.append(
+                        PartClaim(
+                            path, node.lineno, node.col_offset,
+                            label, ivals, origin,
+                        )
+                    )
+
+    def _enclosing_func(self, path: str, node: ast.AST, model) -> FuncConc | None:
+        if model is None:
+            return None
+        fn = model.enclosing_function(node)
+        if fn is None:
+            return None
+        for fc in self.funcs:
+            if fc.node is fn:
+                return fc
+        return None
+
+    def _claim_intervals(
+        self, expr: ast.AST, fn: FuncConc | None, consts: dict, callers: dict
+    ) -> tuple[tuple | None, str, str | None]:
+        """Resolve a ``.part{expr}`` claim to ``(intervals, label, origin)``,
+        through one level of caller argument binding for parameter-carried
+        parts. ``origin`` is the single named constant the value came
+        through, if any (the same-owner overlap exemption)."""
+        v = self._part_value(expr, consts)
+        if isinstance(v, tuple):
+            lo, hi = v
+            return ((lo, hi),), f".part[{lo},{hi}]", self._origin_of(expr, consts)
+        if v == "param" and fn is not None:
+            pname = self._param_name(expr)
+            if pname is None:
+                return None, ast.unparse(expr), None
+            key, ctor = fn.name, False
+            if fn.name == "__init__":
+                # a constructor is never called by its own name — the
+                # claim's callers are the class-name call sites (usable
+                # only while the class name is unique repo-wide)
+                if (
+                    fn.cls is not None
+                    and sum(1 for c in self.classes if c.name == fn.cls) == 1
+                ):
+                    key, ctor = fn.cls, True
+                else:
+                    return None, ast.unparse(expr), None
+            elif self._by_name.get(key) is not fn:
+                return None, ast.unparse(expr), None
+            sites = callers.get(key, [])
+            if not sites:
+                return None, ast.unparse(expr), None
+            try:
+                idx = fn.params.index(pname)
+            except ValueError:
+                return None, ast.unparse(expr), None
+            defaults = self._param_defaults(fn)
+            own_consts = dict(self._part_consts)
+            own_consts.update(self._module_consts.get(fn.path, {}))
+            out: list[tuple[int, int]] = []
+            origins: set[str | None] = set()
+            for caller, call in sites:
+                off = (
+                    1
+                    if fn.params
+                    and fn.params[0] in ("self", "cls")
+                    and (ctor or isinstance(call.func, ast.Attribute))
+                    else 0
+                )
+                arg = None
+                for kw in call.keywords:
+                    if kw.arg == pname:
+                        arg = kw.value
+                pos = idx - off
+                if arg is None and 0 <= pos < len(call.args):
+                    arg = call.args[pos]
+                if arg is None:
+                    d = defaults.get(pname)
+                    if isinstance(d, ast.Constant) and d.value is None:
+                        continue  # defaulted to None: this site claims nothing
+                    arg, consts_for = d, own_consts
+                else:
+                    consts_for = dict(self._part_consts)
+                    consts_for.update(self._module_consts.get(caller.path, {}))
+                av = self._part_value(arg, consts_for) if arg is not None else None
+                if not isinstance(av, tuple):
+                    return None, ast.unparse(expr), None
+                out.append(av)
+                origins.add(self._origin_of(arg, consts_for))
+            if not out:
+                return None, ast.unparse(expr), None
+            origin = origins.pop() if len(origins) == 1 else None
+            return (
+                tuple(sorted(set(out))),
+                f"{pname} from {len(sites)} caller(s)",
+                origin,
+            )
+        return None, ast.unparse(expr), None
+
+    @staticmethod
+    def _param_defaults(fn: FuncConc) -> dict[str, ast.AST]:
+        a = fn.node.args
+        pos = [p.arg for p in (*a.posonlyargs, *a.args)]
+        out: dict[str, ast.AST] = {}
+        for p, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+            out[p] = d
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            if d is not None:
+                out[p.arg] = d
+        return out
+
+    def _origin_of(self, expr: ast.AST, consts: dict) -> str | None:
+        """The constant name a claim value reads from, for Name /
+        ``int(Name)`` shapes only — arithmetic derivations are new
+        namespaces, not references to the constant's own block."""
+        if (
+            isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Name)
+            and expr.func.id == "int"
+            and len(expr.args) == 1
+        ):
+            expr = expr.args[0]
+        if isinstance(expr, ast.Name) and expr.id in consts:
+            return expr.id
+        return None
+
+    def _param_name(self, expr: ast.AST) -> str | None:
+        if (
+            isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Name)
+            and expr.func.id == "int"
+            and len(expr.args) == 1
+        ):
+            expr = expr.args[0]
+        return expr.id if isinstance(expr, ast.Name) else None
+
+    def _part_value(self, expr: ast.AST, consts: dict):
+        """(lo, hi) interval, the string "param", or None (unresolvable)."""
+        if (
+            isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Name)
+            and expr.func.id == "int"
+            and len(expr.args) == 1
+        ):
+            return self._part_value(expr.args[0], consts)
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+            return (expr.value, expr.value)
+        if isinstance(expr, ast.Name):
+            if expr.id in consts:
+                n = consts[expr.id]
+                return (n, n)
+            return "param"
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            left = self._part_value(expr.left, consts)
+            right = self._part_value(expr.right, consts)
+            if isinstance(left, tuple) and isinstance(right, tuple):
+                return (left[0] + right[0], left[1] + right[1])
+            for base, other in ((left, right), (right, left)):
+                if isinstance(base, tuple) and base[0] == base[1]:
+                    # BASE + <dynamic id>: the component owns one block
+                    return (base[0], base[0] + _RANGE_WIDTH - 1)
+            return None
+        if isinstance(expr, ast.IfExp):
+            # `(BASE + h) if h is not None else None`: the None arm claims
+            # nothing (the no-part path); resolve the arms that do claim
+            arms = [
+                self._part_value(b, consts)
+                for b in (expr.body, expr.orelse)
+                if not (isinstance(b, ast.Constant) and b.value is None)
+            ]
+            if len(arms) == 1:
+                return arms[0]
+            if len(arms) == 2 and all(isinstance(a, tuple) for a in arms):
+                return (min(a[0] for a in arms), max(a[1] for a in arms))
+            return None
+        return None
+
+    def _compute_dt204(self) -> None:
+        resolved = [
+            c for c in self.claims if c.intervals and max(hi for _lo, hi in c.intervals) >= 1000
+        ]
+        for c in self.claims:
+            if c.intervals is not None:
+                continue
+            self._findings[c.path]["DT204"].append(
+                RawFinding(
+                    c.line,
+                    c.col,
+                    "DT204",
+                    f"journal `.part{{{c.label}}}` namespace claim cannot be "
+                    "bounded statically — the single-writer census has no way "
+                    "to prove it disjoint from the serve (1000+R), fleet "
+                    "(2000+host) and supervisory (3000+) blocks. Derive the "
+                    "part from a named *_PART constant or a BASE + id "
+                    "expression",
+                )
+            )
+
+        def fmt(c: PartClaim) -> str:
+            return ",".join(f"[{lo},{hi}]" for lo, hi in c.intervals)
+
+        def overlaps(a: PartClaim, b: PartClaim) -> bool:
+            # interval-wise, NOT the hull: a multi-caller claim of
+            # {2000-2999, 4001} must not swallow everything in between
+            return any(
+                alo <= bhi and blo <= ahi
+                for alo, ahi in a.intervals
+                for blo, bhi in b.intervals
+            )
+
+        def is_test(c: PartClaim) -> bool:
+            p = c.path.replace("\\", "/")
+            return "tests/" in p or p.rsplit("/", 1)[-1].startswith("test_")
+
+        for i, a in enumerate(resolved):
+            partners = []
+            for j, b in enumerate(resolved):
+                if i == j:
+                    continue
+                if a.path == b.path and a.intervals == b.intervals:
+                    continue  # one component reopening its own block
+                if a.origin is not None and a.origin == b.origin:
+                    continue  # both read the same *_PART constant: one owner
+                if is_test(b) and not is_test(a):
+                    # tests forge production parts on purpose (replay
+                    # fixtures); the collision is reported at the TEST site
+                    # only, where an inline disable can carry the reasoning
+                    continue
+                if overlaps(a, b):
+                    partners.append(b)
+            if not partners:
+                continue
+            who = "; ".join(
+                f"{fmt(b)} at {b.path}:{b.line}" for b in partners[:3]
+            )
+            self._findings[a.path]["DT204"].append(
+                RawFinding(
+                    a.line,
+                    a.col,
+                    "DT204",
+                    f"journal part namespace {fmt(a)} claimed here "
+                    f"overlaps {who} — two writers appending into one "
+                    ".partN range interleave records and corrupt replay. "
+                    "Give each component a disjoint *_PART block",
+                )
+            )

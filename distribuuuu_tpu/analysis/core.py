@@ -28,6 +28,7 @@ import re
 import time
 from dataclasses import dataclass, field
 
+from distribuuuu_tpu.analysis.concurrency import ConcurrencyIndex
 from distribuuuu_tpu.analysis.ipa import ProgramIndex
 from distribuuuu_tpu.analysis.rules import RULE_MODULES
 from distribuuuu_tpu.analysis.rules.common import ModuleModel
@@ -70,6 +71,9 @@ class LintContext:
     # interprocedural call-graph/summary index (analysis/ipa.py), built once
     # per run after pass 1; the DT10x rules query it per call node
     program: ProgramIndex | None = None
+    # thread/lock/journal model (analysis/concurrency.py), built once per
+    # run after pass 1; the DT2xx rules query it per module tree
+    concurrency: ConcurrencyIndex | None = None
 
 
 def all_rules() -> list[dict]:
@@ -180,6 +184,15 @@ def lint_sources(
             models=models,
         )
         _timed("ipa", t0)
+    # the concurrency model only feeds the DT2xx series — same gate shape
+    _CONC_CODES = ("DT201", "DT202", "DT203", "DT204")
+    if select is None or any(c.startswith(s) for s in select for c in _CONC_CODES):
+        t0 = time.perf_counter()
+        ctx.concurrency = ConcurrencyIndex(
+            {p: t for p, (t, _s, _e) in parsed.items() if t is not None},
+            models=models,
+        )
+        _timed("conc", t0)
 
     findings: list[Finding] = []
     for path, (tree, src, err) in parsed.items():

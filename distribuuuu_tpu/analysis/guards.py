@@ -1,7 +1,7 @@
-"""Runtime guards: pin compile counts and transfer discipline in tests.
+"""Runtime guards: pin compile counts, transfer and locking discipline.
 
-The static rules catch what the AST shows; these two context managers pin
-the *dynamic* invariants the framework's speed rests on:
+The static rules catch what the AST shows; these context managers pin the
+*dynamic* invariants the framework's speed and liveness rest on:
 
 * :class:`CompileGuard` — "one training epoch compiles the step exactly
   once". Two counting modes: given a jitted function it uses the function's
@@ -19,15 +19,21 @@ the *dynamic* invariants the framework's speed rests on:
   ``jax.device_get`` boundary fetches stay legal. ``explicit_also=True``
   escalates to ``"disallow_explicit"`` for regions that must do no
   transfers at all.
+* :class:`LockOrderGuard` — the dynamic complement of DT202: wraps every
+  ``threading.Lock``/``RLock`` created in the region and records per-thread
+  acquisition order; two locks ever taken in both orders is an inversion
+  (a deadlock waiting for the right interleaving) and fails the region.
 
-Both raise on exit (guards must not mask the body's own exception — if the
-body raised, the count check is skipped).
+All raise on exit (guards must not mask the body's own exception — if the
+body raised, the check is skipped).
 """
 
 from __future__ import annotations
 
+import _thread
 import contextlib
 import threading
+import traceback
 
 import jax
 
@@ -159,3 +165,152 @@ def allow_transfers():
     programmatic analog of the PRINT_FREQ boundary."""
     with jax.transfer_guard("allow"):
         yield
+
+
+class LockOrderError(AssertionError):
+    """Two locks were acquired in both orders somewhere in a guarded run."""
+
+
+class _GuardedLock:
+    """Order-tracking proxy around one ``threading.Lock``/``RLock``.
+
+    Everything not instrumented delegates to the inner primitive via
+    ``__getattr__`` — including ``_release_save``/``_acquire_restore``/
+    ``_is_owned`` when the inner is an RLock, so ``threading.Condition``
+    works unchanged (a waiting thread releases the INNER lock directly;
+    its stale entry in the held list is harmless because a waiter acquires
+    nothing until it wakes back through ``_acquire_restore``). With a plain
+    Lock inside, Condition's AttributeError fallback routes through the
+    proxy's own acquire/release, which keeps the held list exact.
+    """
+
+    def __init__(self, inner, label: str, guard: "LockOrderGuard"):
+        self._inner = inner
+        self._label = label
+        self._guard = guard
+
+    def acquire(self, *args, **kwargs):
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            self._guard._note_acquire(self)
+        return got
+
+    def release(self):
+        self._guard._note_release(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+        return False
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class LockOrderGuard:
+    """Observe every lock created in the region; fail on order inversions.
+
+    ``with LockOrderGuard(): ...`` patches the ``threading.Lock`` /
+    ``threading.RLock`` factories so each lock constructed inside the
+    region is wrapped in a :class:`_GuardedLock`. Per thread, the guard
+    keeps the stack of wrapped locks currently held; acquiring ``B`` while
+    holding ``A`` records the edge ``A -> B`` (with the acquiring stack).
+    The first acquisition that completes a reverse edge — some thread
+    observed ``A -> B``, another ``B -> A`` — is an *inversion*: the
+    interleaving where each thread holds one lock and wants the other is a
+    deadlock, whether or not this run happened to schedule it.
+
+    The failure is raised from ``__exit__`` on the test's own thread (the
+    inversion usually happens on a worker thread, where a raise would
+    vanish into a daemon), and never masks an exception from the body.
+    Re-entrant acquisition of a lock already held by the same thread (RLock
+    semantics) records no edge. Only locks *created inside* the region are
+    tracked — wire the guard around the system's construction, not just
+    the contended call.
+    """
+
+    def __init__(self):
+        self.inversions: list[str] = []
+        self._edges: dict[tuple[int, int], tuple[str, str, str]] = {}
+        self._mutex = _thread.allocate_lock()  # never the patched factory
+        self._tls = threading.local()
+        self._orig: tuple | None = None
+
+    # -- bookkeeping (called from _GuardedLock on arbitrary threads) --------
+
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    @staticmethod
+    def _site() -> str:
+        for frame in reversed(traceback.extract_stack(limit=12)[:-2]):
+            if not frame.filename.endswith(("threading.py", "guards.py")):
+                return f"{frame.filename}:{frame.lineno} in {frame.name}"
+        return "<unknown>"
+
+    def _note_acquire(self, lock: _GuardedLock) -> None:
+        held = self._held()
+        if any(h is lock for h in held):  # re-entrant (RLock): no ordering
+            held.append(lock)
+            return
+        if held:
+            stack = self._site()
+            with self._mutex:
+                for h in {id(x): x for x in held}.values():
+                    edge = (id(h), id(lock))
+                    rev = self._edges.get((id(lock), id(h)))
+                    if rev is not None and edge not in self._edges:
+                        self.inversions.append(
+                            f"{h._label} -> {lock._label} at {stack}, but the "
+                            f"reverse order {rev[0]} -> {rev[1]} was taken at "
+                            f"{rev[2]}"
+                        )
+                    self._edges.setdefault(
+                        edge, (h._label, lock._label, stack)
+                    )
+        held.append(lock)
+
+    def _note_release(self, lock: _GuardedLock) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    # -- context manager ----------------------------------------------------
+
+    def __enter__(self) -> "LockOrderGuard":
+        guard = self
+
+        def make(factory, kind):
+            def wrapped(*args, **kwargs):
+                label = f"{kind}@{guard._site()}"
+                return _GuardedLock(factory(*args, **kwargs), label, guard)
+
+            return wrapped
+
+        self._orig = (threading.Lock, threading.RLock)
+        threading.Lock = make(self._orig[0], "Lock")
+        threading.RLock = make(self._orig[1], "RLock")
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._orig is not None:
+            threading.Lock, threading.RLock = self._orig
+            self._orig = None
+        if exc_type is not None:
+            return False  # never mask the body's own failure
+        if self.inversions:
+            detail = "\n  ".join(self.inversions)
+            raise LockOrderError(
+                f"lock-order inversion(s) observed (potential deadlock):\n  "
+                f"{detail}\n(see DT202 in docs/STATIC_ANALYSIS.md)"
+            )
+        return False

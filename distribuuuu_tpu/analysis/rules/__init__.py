@@ -19,6 +19,10 @@ from distribuuuu_tpu.analysis.rules import (
     dt102_axis_validity,
     dt103_spec_shape,
     dt104_precision,
+    dt201_shared_state,
+    dt202_lock_order,
+    dt203_blocking_under_lock,
+    dt204_journal_census,
 )
 
 RULE_MODULES = [
@@ -32,6 +36,10 @@ RULE_MODULES = [
     dt102_axis_validity,
     dt103_spec_shape,
     dt104_precision,
+    dt201_shared_state,
+    dt202_lock_order,
+    dt203_blocking_under_lock,
+    dt204_journal_census,
 ]
 
 __all__ = ["RULE_MODULES"]

@@ -1,0 +1,36 @@
+"""DT201: shared mutable state written across thread entry points unguarded.
+
+The control plane's race bugs (the AlarmEngine double-fire, canary maps
+read by the dispatch thread while a client call mutates them) all share one
+shape: an instance attribute or module global reachable from two *thread
+entry domains* — ``Thread(target=self.m)`` / ``Timer(..., self.m)`` roots,
+socketserver/http handler methods, methods escaping as hooks, and the
+external domain (public methods, callable from any thread) — written
+without a lock common to every access. The :class:`~distribuuuu_tpu.
+analysis.concurrency.ConcurrencyIndex` infers the domains, tracks the
+lexically-held ``with lock:`` set at each ``self.X`` access (plus the
+entry-held set of private methods only ever called under a lock), and this
+rule reports each attribute whose accesses span ≥2 domains (or one
+self-concurrent domain) with an empty guard intersection.
+
+Exempt by design: writes in ``__init__``/``__post_init__`` (happen-before
+thread start), lock/Condition/Queue/Event attributes themselves, and
+monotonic bool/None flags (``self._stop = True`` — the sanctioned
+lock-free shutdown idiom). Blind spots in docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from distribuuuu_tpu.analysis.rules.common import ModuleModel, RawFinding
+
+CODE = "DT201"
+AUTOFIXABLE = False
+
+
+def check(tree: ast.AST, model: ModuleModel, ctx) -> list[RawFinding]:
+    conc = getattr(ctx, "concurrency", None)
+    if conc is None:
+        return []
+    return conc.findings(CODE, tree)

@@ -1,0 +1,35 @@
+"""DT202: lock-ordering cycles — static deadlock detection for threads.
+
+Two threads acquiring the same two locks in opposite orders deadlock the
+moment their critical sections overlap; with the dispatcher's RLock, the
+batcher's per-model conditions and the fleet controller's state lock all
+live in one process, the inversion can span three functions and two
+modules. The :class:`~distribuuuu_tpu.analysis.concurrency.
+ConcurrencyIndex` records every nested ``with`` acquisition pair and every
+call made while holding a lock, propagates per-function lock-acquisition
+summaries caller-ward to a fixpoint (the :mod:`.ipa` pattern), and builds
+the global lock-order graph; every edge that participates in a cycle is a
+finding at its acquisition/call site, with the helper chain (``via``) the
+far lock is reached through.
+
+``Condition(self._lock)`` aliases to the wrapped lock (one lock, no pair);
+container locks (``self._cond[m]``) collapse to one ``attr[*]`` id with
+self-edges exempt (two elements are two locks, and re-entrant RLock
+self-nesting is legal). Blind spots in docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from distribuuuu_tpu.analysis.rules.common import ModuleModel, RawFinding
+
+CODE = "DT202"
+AUTOFIXABLE = False
+
+
+def check(tree: ast.AST, model: ModuleModel, ctx) -> list[RawFinding]:
+    conc = getattr(ctx, "concurrency", None)
+    if conc is None:
+        return []
+    return conc.findings(CODE, tree)

@@ -1,0 +1,38 @@
+"""DT203: blocking call inside a ``with lock:`` body.
+
+A lock held across an indefinitely-blocking operation turns every other
+thread contending for it into a hostage of that operation's worst case —
+the "server wedged" pathology docs/TROUBLESHOOTING.md debugs. Flagged
+directly and through callees (transitive blocking summaries from the
+:class:`~distribuuuu_tpu.analysis.concurrency.ConcurrencyIndex` fixpoint):
+
+* ``sleep()`` — backoff belongs outside the critical section;
+* socket ``accept``/``recv``/``recvfrom``/``recv_into`` — network peers
+  decide how long the lock stays pinned;
+* process ``wait()``/``communicate()`` (receiver named proc/popen/child —
+  ``cond.wait(timeout)`` releases its lock and is NOT flagged);
+* untimed ``Queue.get()`` / untimed ``.join()``;
+* ``commit()``/``fsync()`` durability barriers — a journal commit under a
+  hot lock serializes the control plane behind the disk.
+
+The fix is always the same shape: snapshot state under the lock, perform
+the blocking work after release. Deliberate exceptions (a commit that MUST
+be atomic with the state change) carry an inline
+``# dtpu-lint: disable=DT203`` with the reasoning.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from distribuuuu_tpu.analysis.rules.common import ModuleModel, RawFinding
+
+CODE = "DT203"
+AUTOFIXABLE = False
+
+
+def check(tree: ast.AST, model: ModuleModel, ctx) -> list[RawFinding]:
+    conc = getattr(ctx, "concurrency", None)
+    if conc is None:
+        return []
+    return conc.findings(CODE, tree)

@@ -1,0 +1,37 @@
+"""DT204: journal ``.partN`` single-writer census.
+
+The journal's durability contract assumes ONE writer per ``.partN`` object
+name: serve replicas own ``1000+R``, fleet host agents ``2000+host``, the
+supervisory processes fixed parts ≥3000 (``*_PART`` constants). Two
+components appending into one part interleave records and corrupt replay.
+This rule is the repo-wide map of those namespace claims: every
+``f"...{path}.part{N}"`` site, with ``N`` resolved to a point or a
+``[BASE, BASE+999]`` block through int literals, module constants,
+``BASE + id`` arithmetic, and one level of caller argument binding (a
+helper taking ``part=`` resolves at its call sites via the
+:class:`~distribuuuu_tpu.analysis.concurrency.ConcurrencyIndex`).
+
+Findings: (a) two claim sites whose resolved ranges overlap — reported at
+each site, naming the other; (b) a claim the census cannot bound
+statically (an *unauditable* namespace claim — nothing proves it disjoint
+from the reserved blocks). Claims entirely below part 1000 are out of
+census scope (the crash-continuation probe namespace). Same-module sites
+claiming the identical range are one component reopening its own block
+and are not an overlap.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from distribuuuu_tpu.analysis.rules.common import ModuleModel, RawFinding
+
+CODE = "DT204"
+AUTOFIXABLE = False
+
+
+def check(tree: ast.AST, model: ModuleModel, ctx) -> list[RawFinding]:
+    conc = getattr(ctx, "concurrency", None)
+    if conc is None:
+        return []
+    return conc.findings(CODE, tree)

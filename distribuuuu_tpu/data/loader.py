@@ -175,7 +175,11 @@ class HostDataLoader:
                 f"set_epoch(start_batch={start_batch}) outside this "
                 f"topology's epoch of {self.num_batches} batches"
             )
-        self.epoch = epoch
+        # phase-separated, not racy: set_epoch runs between epochs, and the
+        # producer thread that reads `epoch` is spawned per-__iter__ and
+        # fully drained before the next set_epoch can run — the write and
+        # the thread's reads never overlap in time
+        self.epoch = epoch  # dtpu-lint: disable=DT201
         self.start_batch = start_batch
 
     def __len__(self) -> int:

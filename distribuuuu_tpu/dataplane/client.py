@@ -122,7 +122,10 @@ class ServiceLoader:
                 f"set_epoch(start_batch={start_batch}) outside this "
                 f"topology's epoch of {self.num_batches} batches"
             )
-        self.epoch = int(epoch)
+        # phase-separated like HostDataLoader.set_epoch: the fallback
+        # loader's producer (the only other reader) runs strictly within one
+        # epoch's __iter__, never concurrently with the between-epoch write
+        self.epoch = int(epoch)  # dtpu-lint: disable=DT201
         self.start_batch = int(start_batch)
         if self._local is not None:
             # fallback is per-EPOCH, not per-run: a restarted dispatcher (the

@@ -87,6 +87,12 @@ class DataPlaneService:
         self._event = journal_event or (lambda *a, **k: None)
         self._journal_close = journal_close or (lambda: None)
         self._stop = threading.Event()
+        # worker-process table: written by the monitor thread's restarts and
+        # read by stop()/pids() from the caller's thread. _procs_lock keeps a
+        # restart from registering a fresh worker after stop() snapshotted
+        # the table (a process nothing would ever terminate) — _spawn
+        # re-checks _stop under the lock, stop() sets _stop before snapping.
+        self._procs_lock = threading.Lock()
         self._procs: dict[int, subprocess.Popen] = {}
         self._threads: list[threading.Thread] = []
         self._monitor: threading.Thread | None = None
@@ -137,7 +143,9 @@ class DataPlaneService:
         return self.dispatcher.address
 
     def worker_pids(self) -> list[int]:
-        return [p.pid for p in self._procs.values() if p.poll() is None]
+        with self._procs_lock:
+            procs = list(self._procs.values())
+        return [p.pid for p in procs if p.poll() is None]
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -186,14 +194,19 @@ class DataPlaneService:
             "--threads", str(self.worker_threads),
             *self._worker_argv,
         ]
-        self._procs[slot] = subprocess.Popen(cmd)
+        with self._procs_lock:
+            if self._stop.is_set():  # shutdown won: don't outlive stop()
+                return
+            self._procs[slot] = subprocess.Popen(cmd)
 
     def _monitor_loop(self) -> None:
         """Restart dead worker processes (small fixed backoff — the decode
         tier is stateless, and the lease table already re-queued anything
         the dead worker held when its connection dropped)."""
         while not self._stop.wait(0.2):
-            for slot, proc in list(self._procs.items()):
+            with self._procs_lock:
+                table = list(self._procs.items())
+            for slot, proc in table:
                 code = proc.poll()
                 if code is None:
                     continue
@@ -239,11 +252,13 @@ class DataPlaneService:
         self.journal_stats()
         if self.obs_plane is not None:
             self.obs_plane.stop()
-        for proc in self._procs.values():
+        with self._procs_lock:  # _stop is set: no further spawns can register
+            procs = list(self._procs.values())
+        for proc in procs:
             if proc.poll() is None:
                 proc.terminate()
         deadline = time.monotonic() + 5.0
-        for proc in self._procs.values():
+        for proc in procs:
             try:
                 proc.wait(timeout=max(0.1, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:

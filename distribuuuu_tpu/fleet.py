@@ -109,18 +109,25 @@ def _journal_path(out_dir: str) -> str | None:
         return None
 
 
+#: the controller's supervisory continuation of the pool journal — one block
+#: in the single-writer .partN census (serve replicas 1000+R, host agents
+#: 2000+H, dataplane 3500, obs sidecar 4000/4001); anything forging this
+#: part (tests exercising replay) must reference THIS constant
+FLEET_PART = 3000
+
+
 class FleetJournal(ValidatedJournal):
     """Validated ``fleet_*`` appends into the pool's telemetry journal.
 
-    The controller owns the ``.part3000`` continuation — never the main
-    file, which the global rank-0 worker opens (and torn-tail-heals) at
+    The controller owns the ``.part<FLEET_PART>`` continuation — never the
+    main file, which the global rank-0 worker opens (and torn-tail-heals) at
     every gang launch. `read_journal` reassembles all parts.
     """
 
     def __init__(self, out_dir: str):
         path = _journal_path(out_dir)
         super().__init__(
-            f"{path}.part3000" if path else None, label="fleet journal"
+            f"{path}.part{FLEET_PART}" if path else None, label="fleet journal"
         )
 
 
@@ -1020,6 +1027,12 @@ class FleetQueue:
         self._seq = 0
         self._stop = threading.Event()
         self._stop_signum: int | None = None
+        # the run loop publishes/retires the active gang here while the obs
+        # plane's alarm hook (its tail thread) and the shutdown signal
+        # handler read it to route preemptions. RLock, not Lock: the signal
+        # handler runs ON the main thread, which may already hold the lock
+        # mid-assignment — a plain Lock would self-deadlock the handler.
+        self._active_lock = threading.RLock()
         self._active: GangController | None = None
         self.jobs: list[FleetJob] = []
         specs = list(f.QUEUE)
@@ -1116,7 +1129,8 @@ class FleetQueue:
         def handler(signum, frame):
             self._stop_signum = signum
             self._stop.set()
-            active = self._active
+            with self._active_lock:  # reentrant: see _active_lock comment
+                active = self._active
             if active is not None:
                 active.request_preempt("shutdown")
 
@@ -1160,7 +1174,8 @@ class FleetQueue:
             return None
 
     def _on_alarm(self, transition: dict) -> None:
-        active = self._active
+        with self._active_lock:
+            active = self._active
         fields = {
             "rule": str(transition.get("rule", "?")),
             "metric": str(transition.get("metric", "?")),
@@ -1248,7 +1263,8 @@ class FleetQueue:
                     f"{self.job_id}/{job.name}",
                     self._stop,
                 )
-                self._active = controller
+                with self._active_lock:
+                    self._active = controller
                 holder: dict[str, str] = {}
                 thread = threading.Thread(
                     target=lambda: holder.update(verdict=controller.run()),
@@ -1296,7 +1312,8 @@ class FleetQueue:
                         )
                         controller.request_preempt(by.name)
                     thread.join(0.2)
-                self._active = None
+                with self._active_lock:
+                    self._active = None
                 verdict = holder.get("verdict", "gave_up")
                 if verdict == "preempted" and not self._stop.is_set():
                     # back on the queue: relaunches into elastic resume once

@@ -393,13 +393,29 @@ class AutoscaleController:
     def poll(self, snapshot: dict | None = None, now: float | None = None) -> list[ScaleDecision]:
         """Evaluate the policy and apply every decision it returns."""
         now = time.monotonic() if now is None else now
+        deferred: list[ScaleDecision] = []
         with self._lock:
             decisions = self.policy.poll(snapshot, now)
             for d in decisions:
-                self._apply(d)
+                if self._apply(d):
+                    deferred.append(d)
+        # the dataplane actuator reaps the old service synchronously (up
+        # to the 10 s SIGTERM grace in DataplaneSidecar.scale) — run it
+        # with the controller lock RELEASED so the alarm thread's
+        # on_alarm never stalls behind a process reap
+        for d in deferred:
+            try:
+                self._dataplane.scale(d.to_n)
+            except Exception as exc:  # actuation must not kill the loop
+                logger.warning(f"autoscale: dataplane scale failed: {exc!r}")
         return decisions
 
-    def _apply(self, d: ScaleDecision) -> None:
+    def _apply(self, d: ScaleDecision) -> bool:
+        """Journal + bookkeeping for one decision (caller holds the lock).
+
+        Returns True when the decision still needs the blocking dataplane
+        actuator, which ``poll`` runs after releasing the lock.
+        """
         fields = {}
         if d.rule:
             fields["rule"] = d.rule
@@ -427,10 +443,8 @@ class AutoscaleController:
         elif d.resource == RESOURCE_TRAIN:
             self.training_hold = d.action == "preempt"
         elif d.resource == RESOURCE_DATA and self._dataplane is not None:
-            try:
-                self._dataplane.scale(d.to_n)
-            except Exception as exc:  # actuation must not kill the loop
-                logger.warning(f"autoscale: dataplane scale failed: {exc!r}")
+            return True
+        return False
 
 
 def controller_from_cfg(

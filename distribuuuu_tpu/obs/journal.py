@@ -786,7 +786,11 @@ class Journal:
             self._f.flush()
             if self._fsync and not self._remote:
                 try:
-                    os.fsync(self._f.fileno())
+                    # the fsync MUST be atomic with the write it makes
+                    # durable: releasing the lock between them would let a
+                    # racing append interleave, and "this record survived"
+                    # is exactly what fsync-mode promises per append
+                    os.fsync(self._f.fileno())  # dtpu-lint: disable=DT203
                 except (OSError, io.UnsupportedOperation):
                     pass
 
@@ -804,7 +808,11 @@ class Journal:
             if not self._remote:
                 self._f.flush()
                 try:
-                    os.fsync(self._f.fileno())
+                    # the preemption path's durability barrier: nothing may
+                    # append between the flush and the fsync, or the commit
+                    # would certify bytes it never flushed — the stall is
+                    # the contract (docs/OBSERVABILITY.md)
+                    os.fsync(self._f.fileno())  # dtpu-lint: disable=DT203
                 except (OSError, io.UnsupportedOperation):
                     pass
                 return

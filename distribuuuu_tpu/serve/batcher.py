@@ -134,17 +134,10 @@ class SLOTracker:
                 lat = sorted(self._lat.get(m, []))
                 n = len(lat)
                 batches = self._batches.get(m, 0)
-                depth = {}
-                if self.depth_probe is not None:
-                    try:
-                        depth = {"queue_depth": int(self.depth_probe(m))}
-                    except Exception:  # a probe must never kill the rollup
-                        depth = {}
                 snapshot.append(
                     dict(
                         model=m,
                         **({} if self.replica is None else {"replica": int(self.replica)}),
-                        **depth,
                         window_s=round(window, 3),
                         requests=n,
                         shed=self._shed.get(m, 0),
@@ -166,6 +159,17 @@ class SLOTracker:
             self._fill_sum.clear()
             self._batches.clear()
             self._t0 = time.monotonic()
+        # probe queue depths OUTSIDE the lock: the probe is the batcher's
+        # queue_depth, which takes the model's dispatch condition — calling
+        # it while holding self._lock would order self._lock -> cond against
+        # submit's cond -> self._lock (the shed path), a deadlockable
+        # inversion dtpu-lint DT202 exists to catch
+        if self.depth_probe is not None:
+            for fields in snapshot:
+                try:
+                    fields["queue_depth"] = int(self.depth_probe(fields["model"]))
+                except Exception:  # a probe must never kill the rollup
+                    pass
         for fields in snapshot:  # journal outside the lock
             self._event("serve_slo", **fields)
         if snapshot and self._on_flush is not None:
@@ -209,7 +213,12 @@ class MicroBatcher:
         self._threads: list[threading.Thread] = []
         self._stop = False
         # canary routing state (serve/deploy.py): model -> traffic fraction
-        # for the staged version, plus the deploy manager's latency hook
+        # for the staged version, plus the deploy manager's latency hook.
+        # Guarded by _canary_lock: the deploy manager mutates both dicts from
+        # its own thread while every dispatch loop and submit path reads
+        # them — without the lock a clear_canary can race _version_for into
+        # routing a request to a version whose SLO hook is already gone.
+        self._canary_lock = threading.Lock()
         self._canary: dict[str, float] = {}
         self._canary_hook: dict[str, Callable[[str, float], None]] = {}
         for model in self._ladders:
@@ -258,13 +267,15 @@ class MicroBatcher:
         canary request — the deploy manager's SLO sample stream."""
         if model not in self._ladders:
             raise KeyError(f"unknown model {model!r}")
-        self._canary[model] = min(1.0, max(0.0, float(fraction)))
-        if hook is not None:
-            self._canary_hook[model] = hook
+        with self._canary_lock:
+            if hook is not None:
+                self._canary_hook[model] = hook
+            self._canary[model] = min(1.0, max(0.0, float(fraction)))
 
     def clear_canary(self, model: str) -> None:
-        self._canary.pop(model, None)
-        self._canary_hook.pop(model, None)
+        with self._canary_lock:
+            self._canary.pop(model, None)
+            self._canary_hook.pop(model, None)
 
     def _version_for(
         self, model: str, inputs: np.ndarray, trace_id: str | None
@@ -275,7 +286,8 @@ class MicroBatcher:
         the same version that first served it — a canary-killed replica
         must not flap its own retries onto the incumbent and back), else
         on the request bytes (identical resent payloads still stick)."""
-        fraction = self._canary.get(model, 0.0)
+        with self._canary_lock:
+            fraction = self._canary.get(model, 0.0)
         if fraction <= 0.0:
             return "live"
         if fraction >= 1.0:
@@ -297,7 +309,11 @@ class MicroBatcher:
 
     def queue_depth(self, model: str) -> int:
         """Pending examples queued for one model (the SLO depth probe)."""
-        return self._depth.get(model, 0)
+        cond = self._cond.get(model)
+        if cond is None:
+            return 0
+        with cond:
+            return self._depth.get(model, 0)
 
     def retry_after_s(self, model: str) -> float:
         """How soon a shed request is worth retrying HERE: the estimated
@@ -308,7 +324,7 @@ class MicroBatcher:
         ladder = self._ladders.get(model)
         if not ladder:
             return 0.1
-        rounds = max(1, math.ceil(self._depth.get(model, 0) / ladder[-1]))
+        rounds = max(1, math.ceil(self.queue_depth(model) / ladder[-1]))
         return min(5.0, max(0.05, rounds * self.max_delay_s))
 
     def submit(
@@ -465,7 +481,8 @@ class MicroBatcher:
                     # the deploy manager's canary SLO sample: per-request
                     # enqueue→result wall (the latency the caller felt,
                     # minus frontend overhead — measured, not modeled)
-                    hook = self._canary_hook.get(model)
+                    with self._canary_lock:
+                        hook = self._canary_hook.get(model)
                     if hook is not None:
                         for req in taken:
                             try:

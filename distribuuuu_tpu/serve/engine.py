@@ -34,6 +34,7 @@ warm-vs-cold startup number `obs summarize` renders).
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field, replace
 from typing import Any, Callable
@@ -211,6 +212,12 @@ class InferenceEngine:
             jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
         )
         self.verify_integrity = verify_integrity
+        # the hosted-model registries are mutated by the deploy manager's
+        # rollout thread (stage/promote/discard) while every batcher dispatch
+        # thread resolves names through them — _lock keeps registration and
+        # the promote pop+swap atomic against those lookups (held for dict
+        # ops only, never across a compile or a forward)
+        self._lock = threading.Lock()
         self.models: dict[str, HostedModel] = {}
         # incoming versions under canary (serve/deploy.py): one staged
         # HostedModel per model name, compiled but not yet promoted
@@ -237,10 +244,14 @@ class InferenceEngine:
 
     def load(self, spec: ModelSpec) -> HostedModel:
         """Load one model's weights and AOT-compile its ladder."""
-        if spec.name in self.models:
-            raise ValueError(f"model {spec.name!r} already hosted")
-        hosted = self._build_hosted(spec)
-        self.models[spec.name] = hosted
+        with self._lock:
+            if spec.name in self.models:
+                raise ValueError(f"model {spec.name!r} already hosted")
+        hosted = self._build_hosted(spec)  # slow (compile): outside the lock
+        with self._lock:
+            if spec.name in self.models:
+                raise ValueError(f"model {spec.name!r} already hosted")
+            self.models[spec.name] = hosted
         quant_note = f" [{spec.quant}]" if spec.quant else ""
         logger.info(
             f"serve: hosted {spec.name} ({spec.arch}{quant_note}) from "
@@ -343,8 +354,9 @@ class InferenceEngine:
         Each ladder entry journals its ``serve_compile`` record exactly like
         a startup compile — near-zero walls under the persistent cache."""
         incumbent = self.hosted(name)
-        if name in self.staged:
-            raise ValueError(f"model {name!r} already has a staged version")
+        with self._lock:
+            if name in self.staged:
+                raise ValueError(f"model {name!r} already has a staged version")
         hosted = self._build_hosted(replace(incumbent.spec, weights=str(weights)))
         # warm every staged ladder entry on zeros before it sees a canary
         # request: executable load / lazy backend init must not land on (and
@@ -352,7 +364,10 @@ class InferenceEngine:
         for b, (compiled, sharding) in sorted(hosted.compiled.items()):
             zeros = np.zeros((b, self.im_size, self.im_size, 3), self.input_dtype)
             np.asarray(compiled(*hosted.exec_args, jax.device_put(zeros, sharding)))
-        self.staged[name] = hosted
+        with self._lock:
+            if name in self.staged:
+                raise ValueError(f"model {name!r} already has a staged version")
+            self.staged[name] = hosted
         logger.info(
             f"serve: staged {name} <- {weights} (weights {hosted.load_s:.2f}s, "
             f"ladder {self.batch_sizes} AOT-compiled in {hosted.compile_s:.2f}s; "
@@ -369,12 +384,17 @@ class InferenceEngine:
         Deliberately NOT an in-place clear: a batcher dispatcher thread may
         be mid-``forward`` on the old object, and mutating it under that
         thread would crash the in-flight batch — reference dropping retires
-        it with zero synchronization and zero failed requests."""
-        staged = self.staged.pop(name, None)
-        if staged is None:
-            raise ValueError(f"model {name!r} has no staged version to promote")
-        old = self.models[name]
-        self.models[name] = staged
+        it with zero failed requests. The pop+swap runs under ``_lock`` so a
+        dispatcher resolving the name mid-promote sees either the old or the
+        new registration, never the gap between them."""
+        with self._lock:
+            staged = self.staged.pop(name, None)
+            if staged is None:
+                raise ValueError(
+                    f"model {name!r} has no staged version to promote"
+                )
+            old = self.models[name]
+            self.models[name] = staged
         old_version = dict(old.version)
         logger.info(
             f"serve: promoted {name} -> {staged.version.get('path', '?')} "
@@ -387,7 +407,8 @@ class InferenceEngine:
         stopped serving, and the staged weights/executables free once any
         in-flight canary forward completes (same reference-drop retirement
         as `promote` — never mutated under a dispatcher thread)."""
-        self.staged.pop(name, None)
+        with self._lock:
+            self.staged.pop(name, None)
 
     # -- int8 (dtpu-quant) ---------------------------------------------------
 
@@ -536,7 +557,9 @@ class InferenceEngine:
         """Execute each ladder entry once on zeros: loads executables and
         flushes any lazy backend init off the first request's latency."""
         tic = time.time()
-        for hosted in self.models.values():
+        with self._lock:
+            hosted_snapshot = list(self.models.values())
+        for hosted in hosted_snapshot:
             for b, (compiled, sharding) in sorted(hosted.compiled.items()):
                 zeros = np.zeros(
                     (b, self.im_size, self.im_size, 3), self.input_dtype
@@ -551,11 +574,13 @@ class InferenceEngine:
     # -- inference -----------------------------------------------------------
 
     def hosted(self, name: str) -> HostedModel:
-        try:
-            return self.models[name]
-        except KeyError:
+        with self._lock:
+            try:
+                return self.models[name]
+            except KeyError:
+                hosting = ", ".join(sorted(self.models))
             raise KeyError(
-                f"unknown model {name!r}; hosting: {', '.join(sorted(self.models))}"
+                f"unknown model {name!r}; hosting: {hosting}"
             ) from None
 
     def forward(
@@ -575,7 +600,8 @@ class InferenceEngine:
         """
         hosted = self.hosted(name)
         if version == "canary":
-            staged = self.staged.get(name)
+            with self._lock:
+                staged = self.staged.get(name)
             if staged is not None:
                 hosted = staged
         b = int(batch.shape[0])
@@ -611,9 +637,12 @@ class InferenceEngine:
         """Per-model serving-version report (the /healthz payload), with the
         staged (canary) version alongside while a rollout is in flight."""
         out: dict[str, dict] = {}
-        for name, hosted in self.models.items():
+        with self._lock:
+            hosted_items = list(self.models.items())
+            staged_snapshot = dict(self.staged)
+        for name, hosted in hosted_items:
             v = dict(hosted.version)
-            staged = self.staged.get(name)
+            staged = staged_snapshot.get(name)
             if staged is not None:
                 v["staged"] = dict(staged.version)
             out[name] = v

@@ -36,6 +36,32 @@ ensure_jax_compat()
 
 import pytest  # noqa: E402
 
+# Control-plane test modules that build thread+lock machinery (the serve,
+# fleet, dataplane, autoscale and deploy tiers): under DTPU_LOCK_ORDER=1
+# every test in these files runs inside a LockOrderGuard, so any lock-order
+# inversion the suite's real thread interleavings produce fails the test
+# that produced it (the dynamic complement of dtpu-lint's DT202; CI's lint
+# job sets the variable).
+_LOCK_ORDER_MODULES = (
+    "test_serve",
+    "test_fleet",
+    "test_dataplane",
+    "test_autoscale",
+    "test_deploy",
+)
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_guard(request):
+    mod = os.path.splitext(os.path.basename(str(request.node.fspath)))[0]
+    if os.environ.get("DTPU_LOCK_ORDER") != "1" or mod not in _LOCK_ORDER_MODULES:
+        yield
+        return
+    from distribuuuu_tpu.analysis.guards import LockOrderGuard
+
+    with LockOrderGuard():
+        yield
+
 
 @pytest.fixture()
 def fresh_cfg():

@@ -51,9 +51,11 @@ def _hits(src: str):
 
 def test_rule_catalog_lists_all_rules():
     rules = all_rules()
-    assert [r["code"] for r in rules] == [f"DT00{i}" for i in range(1, 7)] + [
-        f"DT10{i}" for i in range(1, 5)
-    ]
+    assert [r["code"] for r in rules] == (
+        [f"DT00{i}" for i in range(1, 7)]
+        + [f"DT10{i}" for i in range(1, 5)]
+        + [f"DT20{i}" for i in range(1, 5)]
+    )
     assert all(r["summary"] for r in rules)
     assert all(isinstance(r["autofixable"], bool) for r in rules)
 

@@ -385,11 +385,16 @@ def test_read_journal_nested_part_suffixes(tmp_path):
 
     with open(base, "w") as f:
         f.write(rec(0))
-    with open(f"{base}.part2001", "w") as f:
+    from distribuuuu_tpu.fleet import FLEET_PART
+
+    # forging a host agent's .part<2000+h> continuation (and a nested
+    # remote-commit continuation of it) is this test's whole point — the
+    # reader must reassemble namespaces it never writes itself
+    with open(f"{base}.part2001", "w") as f:  # dtpu-lint: disable=DT204
         f.write(rec(1))
-    with open(f"{base}.part2001.part1", "w") as f:
+    with open(f"{base}.part2001.part1", "w") as f:  # dtpu-lint: disable=DT204
         f.write(rec(2))
-    with open(f"{base}.part3000", "w") as f:
+    with open(f"{base}.part{FLEET_PART}", "w") as f:
         f.write(rec(3))
     phases = [r["phase"] for r in read_journal(base)]
     assert phases == ["p0", "p1", "p2", "p3"], phases

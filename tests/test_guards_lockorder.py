@@ -1,0 +1,173 @@
+"""LockOrderGuard — the dynamic complement of dtpu-lint DT202.
+
+The guard patches the ``threading.Lock``/``RLock`` factories for a region,
+tracks per-thread acquisition order over every lock created inside it, and
+fails the region from ``__exit__`` if two locks were ever taken in both
+orders — a deadlock waiting for the right interleaving, whether or not
+this run scheduled it. The serve/fleet/dataplane/autoscale/deploy test
+tiers run under it in CI (``DTPU_LOCK_ORDER=1``, tests/conftest.py), the
+way CompileGuard pins the compile count.
+
+The final test drives the real serve batcher + SLO tracker through the
+depth-probe flush path under the guard — the dynamic regression pin for
+the probe-under-rollup-lock inversion dtpu-lint caught statically in
+serve/batcher.py.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distribuuuu_tpu.analysis import LockOrderError, LockOrderGuard
+
+
+def test_two_thread_inversion_is_detected():
+    with pytest.raises(LockOrderError) as ei:
+        with LockOrderGuard():
+            a = threading.Lock()
+            b = threading.Lock()
+            with a:
+                with b:
+                    pass
+
+            def reverse():
+                with b:
+                    with a:
+                        pass
+
+            t = threading.Thread(target=reverse)
+            t.start()
+            t.join()
+    msg = str(ei.value)
+    assert "inversion" in msg and "DT202" in msg
+
+
+def test_clean_consistent_order_passes():
+    guard = LockOrderGuard()
+    with guard:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+
+        def same_order():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=same_order)
+        t.start()
+        t.join()
+    assert guard.inversions == []
+
+
+def test_reentrant_rlock_records_no_edge():
+    # re-entering a lock the thread already holds is RLock semantics, not
+    # an ordering fact: r->r must not fabricate an edge that later reads
+    # as its own reversal
+    with LockOrderGuard():
+        r = threading.RLock()
+        b = threading.Lock()
+        with r:
+            with r:
+                with b:
+                    pass
+
+        def other():
+            with r:
+                with b:
+                    pass
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+
+
+def test_condition_wait_notify_works_under_the_guard():
+    # Condition() wraps a guarded RLock (delegating _release_save /
+    # _acquire_restore / _is_owned to the inner), Condition(Lock()) takes
+    # the AttributeError fallback through the proxy's own acquire/release —
+    # both must wait and wake normally across threads
+    with LockOrderGuard():
+        for cond in (threading.Condition(), threading.Condition(threading.Lock())):
+            hits: list[int] = []
+
+            def waiter():
+                with cond:
+                    while not hits:
+                        cond.wait(1.0)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            time.sleep(0.05)
+            with cond:
+                hits.append(1)
+                cond.notify_all()
+            t.join(5.0)
+            assert not t.is_alive()
+
+
+def test_body_exception_is_not_masked_by_the_guard():
+    guard = LockOrderGuard()
+    with pytest.raises(ValueError, match="body"):
+        with guard:
+            a = threading.Lock()
+            b = threading.Lock()
+            # the a/b inversion below is this fixture's point: the guard
+            # must record it yet still let the body's ValueError win
+            with a:
+                with b:  # dtpu-lint: disable=DT202 — deliberate inversion fixture
+                    pass
+            with b:
+                with a:  # dtpu-lint: disable=DT202 — deliberate inversion fixture
+                    pass
+            raise ValueError("body")
+    # the inversion was seen, but the body's own failure wins
+    assert guard.inversions
+
+
+def test_lock_factories_are_restored_after_exit():
+    orig = (threading.Lock, threading.RLock)
+    with LockOrderGuard():
+        assert threading.Lock is not orig[0]
+        assert threading.RLock is not orig[1]
+    assert (threading.Lock, threading.RLock) == orig
+
+
+def test_serve_batcher_flush_probe_path_is_inversion_free():
+    """Guard-on smoke over the real serve fixture: SLOTracker.flush probes
+    queue depth (taking the model's dispatch condition) with its rollup
+    lock RELEASED — the fixed ordering. Before the fix the probe ran under
+    the rollup lock against submit's cond→lock shed path, and this exact
+    test would raise LockOrderError at guard exit."""
+    from distribuuuu_tpu.serve.batcher import MicroBatcher, SLOTracker
+
+    events: list[tuple[str, dict]] = []
+    with LockOrderGuard():
+        slo = SLOTracker(
+            lambda kind, **fields: events.append((kind, fields)),
+            window_s=1e9,  # only the explicit flush emits
+        )
+        batcher = MicroBatcher(
+            lambda model, x: x * 2.0,
+            {"m": [1, 2]},
+            max_delay_ms=1.0,
+            max_depth=8,
+            slo=slo,
+        ).start()
+        try:
+            out = batcher.submit(
+                "m", np.ones((1, 2), dtype=np.float32), timeout_s=30.0
+            )
+            assert out.shape == (1, 2)
+            slo.request("m", 1.0)
+            slo.flush()  # rollup, then depth probe -> model cond, lock-free
+        finally:
+            batcher.stop()
+    slos = [fields for kind, fields in events if kind == "serve_slo"]
+    assert slos and "queue_depth" in slos[0]
