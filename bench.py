@@ -302,6 +302,24 @@ def _print_metric(
             }
         )
     )
+    try:
+        # Persist the tag through the perfdb registry so `obs perfdb diff`
+        # can gate regressions against the committed numbers. Best-effort:
+        # the one-JSON-line contract above is the bench's output, and a
+        # registry hiccup (read-only checkout, gs:// auth) must never turn a
+        # measured run into a failure.
+        from distribuuuu_tpu.obs import perfdb
+
+        perfdb.PerfDB().record_bench(
+            f"{kind}:{arch}@{im_size}{_variant_tags()}",
+            value=round(per_chip, 1),
+            unit="images/sec/chip",
+            vs_baseline=round(per_chip / baseline, 3),
+        )
+    except ValueError:
+        pass  # DTPU_PERFDB=0: registry writes explicitly disabled
+    except Exception as exc:
+        print(f"bench: perfdb write skipped ({exc!r})", file=sys.stderr, flush=True)
 
 
 def _eval_bench(

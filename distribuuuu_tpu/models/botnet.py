@@ -139,18 +139,18 @@ class MHSA(nn.Module):
         )
         fuse = self.fuse
         if fuse is None:
-            # Opt-in only: the 2026-07-31 on-chip A/B measured the Pallas
-            # kernel LOSING to XLA's fused attention at BoTNet shapes —
-            # abs-fused 0.77x in the soak, botnet50 end-to-end 1545 vs
-            # 1834 img/s (docs/BENCH_NOTES.md round-5 session #2). XLA's
-            # emitter handles L~196 tiles better than the hand kernel;
-            # DTPU_FUSED_ATTN=1 remains available for re-evaluation on
-            # other topologies/shapes.
-            import os
+            # The 2026-07-31 on-chip A/B measured the Pallas kernel LOSING
+            # to XLA's fused attention at BoTNet shapes — abs-fused 0.77x in
+            # the soak, botnet50 end-to-end 1545 vs 1834 img/s
+            # (docs/BENCH_NOTES.md round-5 session #2); that verdict is
+            # seeded in the perfdb registry as flip=False for the L~196
+            # class. `switch_attention` resolves DTPU_FUSED_ATTN env > the
+            # registry's per-shape-class verdict > off, so a large-L soak
+            # win flips only its own shapes while L~196 stays on XLA.
+            from distribuuuu_tpu.ops.attention import switch_attention
 
-            fuse = (
-                jax.default_backend() == "tpu"
-                and os.environ.get("DTPU_FUSED_ATTN") == "1"
+            fuse = jax.default_backend() == "tpu" and switch_attention(
+                h * w, dqk, dv
             )
         # off-TPU a forced fuse runs the Pallas interpreter (tests; a user
         # setting fuse=True on CPU gets slow-but-correct instead of a crash)

@@ -46,8 +46,12 @@ def peak_flops_per_device(device=None, override_tflops: float = 0.0) -> float | 
     """Peak dense FLOP/s for one JAX device, or None when unknown.
 
     ``override_tflops`` (``cfg.OBS.PEAK_TFLOPS_PER_DEVICE``) wins when > 0;
-    otherwise the ``device_kind`` is looked up (longest matching key, so
-    "TPU v5 lite" resolves before "TPU v5"). CPU/unknown → None.
+    next a perfdb-measured matmul ceiling for this ``device_kind``
+    (`scripts/stage_roofline.py` writes it — MFU on a new chip is then
+    measured rather than fabricated, and on a known chip it is the
+    *achievable* ceiling, not the datasheet number); last the static table
+    (longest matching key, so "TPU v5 lite" resolves before "TPU v5").
+    CPU/unknown → None.
     """
     if override_tflops and override_tflops > 0:
         return float(override_tflops) * 1e12
@@ -55,7 +59,16 @@ def peak_flops_per_device(device=None, override_tflops: float = 0.0) -> float | 
         import jax
 
         device = jax.devices()[0]
-    kind = (getattr(device, "device_kind", "") or "").lower()
+    raw_kind = getattr(device, "device_kind", "") or ""
+    try:  # the registry is optional context, never a failure mode for MFU
+        from distribuuuu_tpu.obs import perfdb
+
+        measured = perfdb.measured_ceiling_tflops(raw_kind)
+    except Exception:
+        measured = None
+    if measured:
+        return float(measured) * 1e12
+    kind = raw_kind.lower()
     best = None
     for key, tflops in _PEAK_BF16_TFLOPS.items():
         if key in kind and (best is None or len(key) > len(best[0])):

@@ -1,0 +1,835 @@
+"""dtpu-perfdb: the persistent kernel-verdict registry.
+
+Measurement becomes machinery (ROADMAP "Raw speed round 3"): the soak and
+bench runs that used to print one-off speedup lines now *write* a
+per-(device_kind, kernel_family, shape-class) registry, and the `switch_*`
+routing sites *read* it at trace time — so a kernel default flips itself on
+a measured on-chip >1× and unflips on a measured regression, with every
+transition journaled as a typed ``kernel_verdict`` record. The empirical-
+autotuner lineage (ATLAS/AutoTVM-style measure-and-cache) applied to the
+three Pallas families docs/PERFORMANCE.md keeps table rows for.
+
+Persistence follows the compile cache (`runtime/compile_cache.py`): one
+small JSON file, repo-local by default (``perfdb/registry.json`` — the
+COMMITTED registry CI diffs against), written atomically through
+`runtime/pathio.write_text` so it is gs://-safe and a reader never sees a
+torn file. Every write is a read-modify-write of the whole file, so two
+soak runs appending different keys merge instead of clobbering. A corrupt
+registry is REFUSED loudly on write (never silently overwritten) and
+treated as absent — with one warning — on trace-time consult: routing must
+never die of observability.
+
+Three consumers:
+
+- **switch sites** (`ops/epilogue.switch_epilogue`, `parallel/moe.switch_moe`,
+  `ops/attention.switch_attention`) call `resolve_switch` — precedence
+  explicit arg > env var > cfg > registry > default.
+- **autotuners** (`ops/attention._pick_block`, the epilogue/MoE block knobs)
+  call `registry_block` for the measured-and-cached winner tiling; the
+  `autotune` helper is the measure-and-cache loop the soak harness drives
+  (a cache hit skips re-measuring).
+- **MFU** (`obs/flops.peak_flops_per_device`) calls
+  `measured_ceiling_tflops` — a `scripts/stage_roofline.py`-measured matmul
+  ceiling beats the static peak-TFLOPs table, so MFU on new chips is
+  measured rather than fabricated.
+
+``DTPU_PERFDB`` points the registry elsewhere (``0``/``off`` disables all
+consults); ``cfg.OBS.PERFDB`` is the trainer-side knob. The CLI lives at
+``python -m distribuuuu_tpu.obs perfdb show|diff`` — ``diff`` is the CI
+perf-regression gate, comparing a candidate registry against the committed
+one with machine-speed calibration on absolute-unit entries.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Any, Callable, Iterable
+
+from distribuuuu_tpu.runtime import pathio
+
+SCHEMA_VERSION = 1
+
+# Machine-speed calibration (the tests/test_analysis_ipa.py pattern): a
+# pinned reference wall time for a fixed synthetic workload; the measured
+# best-of-three over it scales ABSOLUTE-unit tolerances (img/s, ms) on a
+# slower machine. Speedup *ratios* are machine-independent and never scaled.
+_CAL_REF_S = 0.021
+_CAL_SCALE_ENV = "DTPU_PERFDB_CAL_SCALE"
+
+_ENV_PATH = "DTPU_PERFDB"
+
+# kernel families with a registry-consulted routing default; "bench" rows
+# are throughput tags (absolute units, never flip anything)
+FAMILIES = ("attention", "attention_blk", "epilogue", "moe", "bench")
+
+
+class PerfDBError(RuntimeError):
+    """The registry file exists but cannot be trusted (corrupt/invalid)."""
+
+
+# ---------------------------------------------------------------------------
+# Path resolution: env > cfg (set_registry_path) > repo-local default
+# ---------------------------------------------------------------------------
+
+_CFG_PATH: str | None = None
+
+
+def repo_default_path() -> str:
+    """The committed registry: ``<repo>/perfdb/registry.json`` (the
+    compile-cache repo-local-default idiom, `runtime/compile_cache.py`)."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, "perfdb", "registry.json")
+
+
+def set_registry_path(path: str | None) -> None:
+    """Trainer-side override (``cfg.OBS.PERFDB``); None restores the default."""
+    global _CFG_PATH
+    _CFG_PATH = str(path) if path else None
+    _invalidate_cache()
+
+
+def registry_path() -> str | None:
+    """The active registry path, or None when consults are disabled."""
+    env = os.environ.get(_ENV_PATH)
+    if env is not None:
+        if env.strip().lower() in ("", "0", "off", "none"):
+            return None
+        return env
+    return _CFG_PATH or repo_default_path()
+
+
+# ---------------------------------------------------------------------------
+# Shape classes
+# ---------------------------------------------------------------------------
+
+def _bucket(v: int) -> int:
+    """Nearest power of two (≥1): the shape-class coarsening, so a soak at
+    L=196 and a model trace at L=196 (or 224) land in the same class while
+    L=1024 stays a different regime."""
+    v = int(v)
+    if v <= 1:
+        return 1
+    return 1 << round(math.log2(v))
+
+
+def shape_class(**dims: int | None) -> str:
+    """Canonical shape-class string: sorted ``<name><pow2-bucket>`` parts.
+
+    ``shape_class(l=196, d=128, dv=128) == "d128-dv128-l256"`` — both the
+    soak writer and the trace-time consult derive the class through this one
+    function, which is the whole matching contract.
+    """
+    parts = []
+    for name in sorted(dims):
+        if dims[name] is None:
+            continue
+        parts.append(f"{name}{_bucket(int(dims[name]))}")
+    return "-".join(parts)
+
+
+def default_device_kind() -> str:
+    import jax
+
+    return jax.devices()[0].device_kind
+
+
+# ---------------------------------------------------------------------------
+# The registry file
+# ---------------------------------------------------------------------------
+
+def _empty() -> dict:
+    return {"schema": SCHEMA_VERSION, "entries": {}, "ceilings": {}}
+
+
+def validate_data(data: Any) -> list[str]:
+    """Schema errors for a decoded registry ([] when valid) — the hand-rolled
+    journal-SCHEMA convention, no jsonschema dependency."""
+    if not isinstance(data, dict):
+        return [f"registry is {type(data).__name__}, not an object"]
+    errors: list[str] = []
+    if data.get("schema") != SCHEMA_VERSION:
+        errors.append(f"schema is {data.get('schema')!r}, expected {SCHEMA_VERSION}")
+    entries = data.get("entries")
+    if not isinstance(entries, dict):
+        return errors + ["'entries' missing or not an object"]
+    for key, entry in entries.items():
+        if not isinstance(entry, dict):
+            errors.append(f"entry {key!r} is not an object")
+            continue
+        for field, types in (
+            ("device_kind", str),
+            ("kernel_family", str),
+            ("shape_class", str),
+            ("speedup", (int, float)),
+            ("flip", bool),
+            ("source", str),
+        ):
+            if not isinstance(entry.get(field), types):
+                errors.append(f"entry {key!r}: missing/invalid {field!r}")
+    ceilings = data.get("ceilings", {})
+    if not isinstance(ceilings, dict):
+        errors.append("'ceilings' is not an object")
+    else:
+        for kind, c in ceilings.items():
+            if not isinstance(c, dict) or not isinstance(
+                c.get("matmul_tflops"), (int, float)
+            ):
+                errors.append(f"ceiling {kind!r}: missing/invalid 'matmul_tflops'")
+    return errors
+
+
+def load_registry(path: str) -> dict:
+    """Decode + validate one registry file; raises `PerfDBError` on corruption
+    (the refusal contract: a broken registry is never silently clobbered or
+    silently trusted), FileNotFoundError when absent."""
+    try:
+        raw = pathio.read_bytes(path).decode("utf-8")
+    except FileNotFoundError:
+        raise
+    except OSError as exc:
+        raise PerfDBError(f"unreadable registry {path}: {exc!r}") from exc
+    try:
+        data = json.loads(raw)
+    except json.JSONDecodeError as exc:
+        raise PerfDBError(f"corrupt registry {path}: {exc}") from exc
+    errors = validate_data(data)
+    if errors:
+        raise PerfDBError(f"invalid registry {path}: {'; '.join(errors[:5])}")
+    return data
+
+
+def entry_key(device_kind: str, family: str, shape_cls: str) -> str:
+    return f"{device_kind}|{family}|{shape_cls}"
+
+
+class PerfDB:
+    """Writer handle over one registry file (read-modify-write per record).
+
+    Writes are rare (end of a soak/bench/roofline run), so each record
+    re-reads the file, applies one mutation, and saves atomically through
+    `pathio.write_text` — concurrent writers of different keys merge, and a
+    corrupt file makes every write raise instead of destroying history.
+    """
+
+    def __init__(self, path: str | None = None):
+        resolved = str(path) if path else registry_path()
+        if resolved is None:
+            raise ValueError(
+                f"perfdb is disabled ({_ENV_PATH}={os.environ.get(_ENV_PATH)!r}); "
+                "pass an explicit path to write anyway"
+            )
+        self.path = resolved
+
+    @property
+    def journal_path(self) -> str:
+        """Sibling journal of typed ``kernel_verdict`` records — every
+        registry transition lands here (and validates against obs.journal's
+        SCHEMA), so the flip history is greppable like any run journal."""
+        parent = os.path.dirname(self.path)
+        return os.path.join(parent, "verdicts.jsonl") if parent else "verdicts.jsonl"
+
+    def load(self) -> dict:
+        try:
+            return load_registry(self.path)
+        except FileNotFoundError:
+            return _empty()
+
+    def _save(self, data: dict) -> None:
+        parent = os.path.dirname(self.path)
+        if parent:
+            pathio.makedirs(parent)
+        pathio.write_text(self.path, json.dumps(data, indent=1, sort_keys=True) + "\n")
+        _invalidate_cache()
+
+    def _journal_event(self, journal, kind: str, **fields: Any) -> None:
+        """``journal`` is True (default sibling), a path, a ValidatedJournal,
+        or falsy (skip). Short-lived open-append-close per record: verdicts
+        are rare and the writer must not hold the file across soak arms."""
+        if not journal:
+            return
+        from distribuuuu_tpu.obs.journal import ValidatedJournal
+
+        if isinstance(journal, ValidatedJournal):
+            journal.event(kind, **fields)
+            return
+        path = self.journal_path if journal is True else str(journal)
+        vj = ValidatedJournal(path, label="perfdb journal")
+        try:
+            vj.event(kind, **fields)
+        finally:
+            vj.close()
+
+    # -- verdicts ---------------------------------------------------------
+
+    def record_verdict(
+        self,
+        family: str,
+        shape_cls: str,
+        *,
+        speedup: float,
+        device_kind: str | None = None,
+        fused_ms: float | None = None,
+        baseline_ms: float | None = None,
+        interpret: bool = False,
+        trust_interpret: bool = False,
+        numerics: str = "pass",
+        source: str = "api",
+        block: int | None = None,
+        value: float | None = None,
+        unit: str | None = None,
+        journal: Any = True,
+    ) -> dict:
+        """Persist one measured verdict; returns the entry + its transition.
+
+        ``flip`` is computed here, not passed: ON-CHIP (``interpret=False``)
+        a >1× speedup with passing numerics flips the family's routing
+        default for this shape class; anything measured in the Pallas
+        interpreter never flips (``trust_interpret=True`` is the CI/test
+        override that treats interpreter timings as real). The transition
+        (``flip`` / ``unflip`` / ``none``) against the previous entry is
+        journaled as a typed ``kernel_verdict`` record.
+        """
+        device_kind = device_kind or default_device_kind()
+        new_flip = bool(
+            (not interpret or trust_interpret)
+            and float(speedup) > 1.0
+            and numerics == "pass"
+        )
+        data = self.load()
+        key = entry_key(device_kind, family, shape_cls)
+        prev = data["entries"].get(key)
+        prev_flip = bool(prev and prev.get("flip"))
+        if new_flip and not prev_flip:
+            transition = "flip"
+        elif prev_flip and not new_flip:
+            transition = "unflip"
+        else:
+            transition = "none"
+        entry: dict[str, Any] = {
+            "device_kind": device_kind,
+            "kernel_family": family,
+            "shape_class": shape_cls,
+            "speedup": round(float(speedup), 4),
+            "flip": new_flip,
+            "interpret": bool(interpret),
+            "numerics": str(numerics),
+            "source": str(source),
+            "updated": time.strftime("%Y-%m-%d", time.gmtime()),
+            "runs": int(prev.get("runs", 0)) + 1 if prev else 1,
+        }
+        if fused_ms is not None:
+            entry["fused_ms"] = round(float(fused_ms), 3)
+        if baseline_ms is not None:
+            entry["baseline_ms"] = round(float(baseline_ms), 3)
+        if value is not None:
+            entry["value"] = round(float(value), 3)
+        if unit is not None:
+            entry["unit"] = str(unit)
+        if block is not None:
+            entry["block"] = int(block)
+        elif prev and "block" in prev:
+            entry["block"] = prev["block"]  # the autotune winner survives re-verdicts
+        data["entries"][key] = entry
+        self._save(data)
+        fields: dict[str, Any] = dict(
+            kernel_family=family,
+            device_kind=device_kind,
+            shape_class=shape_cls,
+            speedup=float(speedup),
+            flip=new_flip,
+            source=str(source),
+            transition=transition,
+            interpret=bool(interpret),
+            numerics=str(numerics),
+        )
+        if fused_ms is not None:
+            fields["fused_ms"] = float(fused_ms)
+        if baseline_ms is not None:
+            fields["baseline_ms"] = float(baseline_ms)
+        if "block" in entry:
+            fields["block"] = int(entry["block"])
+        self._journal_event(journal, "kernel_verdict", **fields)
+        return {**entry, "transition": transition}
+
+    def record_bench(
+        self,
+        tag: str,
+        *,
+        value: float,
+        unit: str,
+        device_kind: str | None = None,
+        vs_baseline: float | None = None,
+        interpret: bool = False,
+        source: str = "bench",
+        journal: Any = True,
+    ) -> dict:
+        """A bench.py throughput tag as a registry row: family ``bench``,
+        shape_class = the tag string verbatim (tags are already canonical —
+        ``train:resnet50@224 +fused-epi``), ``speedup`` = vs_baseline so the
+        ratio diff works, absolute ``value`` so the calibrated diff works.
+        Bench rows never flip routing (>1× vs the A100 baseline is table
+        stakes, not a kernel verdict)."""
+        device_kind = device_kind or default_device_kind()
+        entry = self.record_verdict(
+            "bench",
+            tag,
+            speedup=float(vs_baseline) if vs_baseline is not None else 0.0,
+            device_kind=device_kind,
+            interpret=True,  # never flips: bench rows gate regressions only
+            trust_interpret=False,
+            numerics="n/a",
+            source=source,
+            value=value,
+            unit=unit,
+            journal=journal,
+        )
+        return entry
+
+    # -- autotune winners -------------------------------------------------
+
+    def record_block(
+        self,
+        family: str,
+        shape_cls: str,
+        block: int,
+        *,
+        ms: float | None = None,
+        device_kind: str | None = None,
+        source: str = "autotune",
+        journal: Any = True,
+    ) -> dict:
+        """Cache a measured winner tiling for (device, family, class). An
+        existing verdict entry keeps its speedup/flip; an autotune-only entry
+        is created flip=False (a tiling winner is not a routing verdict)."""
+        device_kind = device_kind or default_device_kind()
+        data = self.load()
+        key = entry_key(device_kind, family, shape_cls)
+        prev = data["entries"].get(key)
+        if prev is None:
+            entry = {
+                "device_kind": device_kind,
+                "kernel_family": family,
+                "shape_class": shape_cls,
+                "speedup": 0.0,
+                "flip": False,
+                "interpret": False,
+                "numerics": "n/a",
+                "source": str(source),
+                "updated": time.strftime("%Y-%m-%d", time.gmtime()),
+                "runs": 1,
+            }
+        else:
+            entry = dict(prev)
+        entry["block"] = int(block)
+        if ms is not None:
+            entry["block_ms"] = round(float(ms), 3)
+        data["entries"][key] = entry
+        self._save(data)
+        self._journal_event(
+            journal,
+            "kernel_verdict",
+            kernel_family=family,
+            device_kind=device_kind,
+            shape_class=shape_cls,
+            speedup=float(entry.get("speedup", 0.0)),
+            flip=bool(entry.get("flip", False)),
+            source=str(source),
+            transition="none",
+            block=int(block),
+        )
+        return entry
+
+    def lookup(
+        self, family: str, shape_cls: str, device_kind: str | None = None
+    ) -> dict | None:
+        device_kind = device_kind or default_device_kind()
+        return self.load()["entries"].get(entry_key(device_kind, family, shape_cls))
+
+    # -- measured ceilings ------------------------------------------------
+
+    def record_ceiling(
+        self,
+        tflops: float,
+        *,
+        device_kind: str | None = None,
+        source: str = "stage_roofline",
+    ) -> dict:
+        """Persist a measured matmul ceiling (TFLOP/s per device) — the
+        `scripts/stage_roofline.py` number `obs/flops.py` prefers over the
+        static peak table."""
+        device_kind = device_kind or default_device_kind()
+        data = self.load()
+        ceiling = {
+            "matmul_tflops": round(float(tflops), 2),
+            "source": str(source),
+            "updated": time.strftime("%Y-%m-%d", time.gmtime()),
+        }
+        data.setdefault("ceilings", {})[device_kind] = ceiling
+        self._save(data)
+        return ceiling
+
+
+# ---------------------------------------------------------------------------
+# Trace-time consults: cached, never raising
+# ---------------------------------------------------------------------------
+
+# path -> (stat signature, decoded data); stat-keyed so an external write
+# (another process's soak) invalidates without any cross-process signal.
+# Remote (gs://) paths have no cheap stat and cache for the process lifetime.
+_CACHE: dict[str, tuple[Any, dict | None]] = {}
+_WARNED: set[str] = set()
+
+
+def _invalidate_cache() -> None:
+    _CACHE.clear()
+
+
+def _stat_sig(path: str) -> Any:
+    if pathio.is_remote(path):
+        return "remote"
+    try:
+        st = os.stat(path)
+        return (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return "absent"
+
+
+def _consult(path: str | None = None) -> dict | None:
+    """The read side of every trace-time lookup: loads + caches the registry,
+    degrades to None (one warning per path) on anything wrong — routing must
+    never die of observability."""
+    path = path or registry_path()
+    if path is None:
+        return None
+    sig = _stat_sig(path)
+    cached = _CACHE.get(path)
+    if cached is not None and cached[0] == sig:
+        return cached[1]
+    data: dict | None
+    if sig == "absent":
+        data = None
+    else:
+        try:
+            data = load_registry(path)
+        except FileNotFoundError:
+            data = None
+        except PerfDBError as exc:
+            data = None
+            if path not in _WARNED:
+                _WARNED.add(path)
+                from distribuuuu_tpu.logging import logger
+
+                logger.warning(f"perfdb registry ignored: {exc}")
+    _CACHE[path] = (sig, data)
+    return data
+
+
+def lookup_entry(
+    family: str,
+    shape_cls: str | None,
+    device_kind: str | None = None,
+    path: str | None = None,
+) -> dict | None:
+    """The registry entry for (device, family, class), or None. Never raises."""
+    if shape_cls is None:
+        return None
+    data = _consult(path)
+    if data is None:
+        return None
+    try:
+        kind = device_kind or default_device_kind()
+    except Exception:  # no backend yet (early import): no opinion
+        return None
+    return data["entries"].get(entry_key(kind, family, shape_cls))
+
+
+def registry_flip(
+    family: str, shape_cls: str | None, device_kind: str | None = None
+) -> bool | None:
+    """The registry's routing opinion for a switch site: True/False when a
+    verdict exists for this (device, family, class), None when it has none
+    (→ the site's own default applies)."""
+    entry = lookup_entry(family, shape_cls, device_kind)
+    if entry is None:
+        return None
+    return bool(entry.get("flip"))
+
+
+def registry_block(
+    family: str, shape_cls: str | None, device_kind: str | None = None
+) -> int | None:
+    """The measured-and-cached winner tiling for (device, family, class)."""
+    entry = lookup_entry(family, shape_cls, device_kind)
+    if entry is None or "block" not in entry:
+        return None
+    return int(entry["block"])
+
+
+def measured_ceiling_tflops(device_kind: str, path: str | None = None) -> float | None:
+    """A stage_roofline-measured matmul ceiling for this device kind (exact
+    match first, then the flops.py longest-substring convention so
+    "TPU v5 lite" registry rows serve "tpu v5 lite" queries)."""
+    data = _consult(path)
+    if data is None or not device_kind:
+        return None
+    ceilings = data.get("ceilings", {})
+    if device_kind in ceilings:
+        return float(ceilings[device_kind]["matmul_tflops"])
+    kind = device_kind.lower()
+    best = None
+    for key, c in ceilings.items():
+        kl = key.lower()
+        if (kl in kind or kind in kl) and (best is None or len(kl) > best[0]):
+            best = (len(kl), float(c["matmul_tflops"]))
+    return best[1] if best else None
+
+
+# ---------------------------------------------------------------------------
+# The switch-site resolver
+# ---------------------------------------------------------------------------
+
+def resolve_switch(
+    family: str,
+    shape_cls: str | None = None,
+    *,
+    explicit: bool | None = None,
+    env_var: str | None = None,
+    cfg: bool | None = None,
+    default: bool = False,
+) -> tuple[bool, str]:
+    """One precedence chain for every kernel routing default:
+
+        explicit arg > env var > cfg > registry > default
+
+    Returns ``(decision, source)`` with source in
+    ``{"arg", "env", "cfg", "registry", "default"}`` — the source string is
+    what the switch sites log/test against, and what keeps the registry
+    *below* every operator-held override: a measured flip can never beat a
+    human saying otherwise.
+    """
+    if explicit is not None:
+        return bool(explicit), "arg"
+    if env_var:
+        env = os.environ.get(env_var)
+        if env is not None:
+            return env == "1", "env"
+    if cfg is not None:
+        return bool(cfg), "cfg"
+    reg = registry_flip(family, shape_cls)
+    if reg is not None:
+        return reg, "registry"
+    return bool(default), "default"
+
+
+# ---------------------------------------------------------------------------
+# Autotune: measure-and-cache over estimator-priced candidates
+# ---------------------------------------------------------------------------
+
+def autotune(
+    db: PerfDB,
+    family: str,
+    shape_cls: str,
+    candidates: Iterable[int],
+    measure: Callable[[int], float],
+    *,
+    device_kind: str | None = None,
+    retune: bool = False,
+    source: str = "autotune",
+    journal: Any = True,
+) -> tuple[int | None, bool]:
+    """Pick (and cache) the fastest tiling among ``candidates``.
+
+    ``measure(block) -> seconds-or-ms`` (any consistent unit) is driven by
+    the soak harness on-chip; the VMEM-guard estimators already priced the
+    candidate list, so everything offered here compiles. Returns
+    ``(winner, cached)`` — a registry hit whose winner is still a valid
+    candidate SKIPS re-measuring (the cache-hit contract tests pin), and
+    ``retune=True`` forces the sweep. No candidates → ``(None, False)``.
+    """
+    candidates = [int(c) for c in candidates]
+    if not candidates:
+        return None, False
+    device_kind = device_kind or default_device_kind()
+    if not retune:
+        entry = db.lookup(family, shape_cls, device_kind)
+        if entry is not None and int(entry.get("block", -1)) in candidates:
+            return int(entry["block"]), True
+    timings = {c: float(measure(c)) for c in candidates}
+    winner = min(timings, key=lambda c: timings[c])
+    db.record_block(
+        family,
+        shape_cls,
+        winner,
+        ms=timings[winner],
+        device_kind=device_kind,
+        source=source,
+        journal=journal,
+    )
+    return winner, False
+
+
+# ---------------------------------------------------------------------------
+# The CI perf-regression gate
+# ---------------------------------------------------------------------------
+
+def machine_scale(ref_s: float = _CAL_REF_S) -> float:
+    """How much slower this machine is than the reference that recorded the
+    committed absolute-unit numbers (the analyzer's calibration-baseline
+    pattern): best-of-three of a fixed numpy workload over a pinned
+    constant, clamped to [1, 4] — calibration loosens tolerances on slow
+    CI boxes, never tightens them on fast ones. ``DTPU_PERFDB_CAL_SCALE``
+    pins it for deterministic tests."""
+    env = os.environ.get(_CAL_SCALE_ENV)
+    if env:
+        try:
+            return min(4.0, max(1.0, float(env)))
+        except ValueError:
+            pass
+    import numpy as np
+
+    a = np.arange(1, 160_001, dtype=np.float64).reshape(400, 400) / 160_000.0
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        b = a
+        for _ in range(12):
+            b = b @ a
+        float(b.sum())
+        best = min(best, time.perf_counter() - t0)
+    return min(4.0, max(1.0, best / ref_s))
+
+
+def diff_registries(
+    committed: dict,
+    candidate: dict,
+    *,
+    tolerance: float = 0.9,
+    scale: float = 1.0,
+) -> dict:
+    """Compare a run's registry against the committed one.
+
+    Only keys present in BOTH registries are gated (a CPU candidate never
+    regresses a TPU row — device_kind is in the key). Per shared key:
+
+    - entries with an absolute ``value`` (bench tags): regression when
+      ``candidate.value < committed.value * tolerance / scale`` — machine
+      speed scales absolute units only.
+    - kernel verdicts: regression when
+      ``candidate.speedup < committed.speedup * tolerance`` — speedup
+      ratios are machine-independent, no calibration applied. A committed
+      flip=True row whose candidate measured flip=False is a regression
+      regardless of ratio (the default just unflipped).
+
+    Returns ``{regressions, improvements, unchanged, new, missing}`` lists
+    of human-readable findings; the CLI exits nonzero iff regressions.
+    """
+    out: dict[str, list[str]] = {
+        "regressions": [],
+        "improvements": [],
+        "unchanged": [],
+        "new": [],
+        "missing": [],
+    }
+    c_entries = committed.get("entries", {})
+    r_entries = candidate.get("entries", {})
+    for key in sorted(set(c_entries) | set(r_entries)):
+        base, cand = c_entries.get(key), r_entries.get(key)
+        if base is None:
+            out["new"].append(f"{key}: new entry (speedup {cand.get('speedup')})")
+            continue
+        if cand is None:
+            out["missing"].append(f"{key}: not measured by this run")
+            continue
+        if "value" in base and "value" in cand:
+            floor = float(base["value"]) * tolerance / max(scale, 1.0)
+            v = float(cand["value"])
+            line = (
+                f"{key}: {v:.1f} {cand.get('unit', '')} vs committed "
+                f"{float(base['value']):.1f} (floor {floor:.1f}, "
+                f"tolerance {tolerance}, machine scale {scale:.2f})"
+            )
+            if v < floor:
+                out["regressions"].append(line)
+            elif v > float(base["value"]):
+                out["improvements"].append(line)
+            else:
+                out["unchanged"].append(line)
+            continue
+        bs, cs = float(base.get("speedup", 0.0)), float(cand.get("speedup", 0.0))
+        if bool(base.get("flip")) and not bool(cand.get("flip")):
+            out["regressions"].append(
+                f"{key}: default UNFLIPPED — committed {bs:.3f}x (flip), "
+                f"candidate {cs:.3f}x"
+            )
+        elif cs < bs * tolerance:
+            out["regressions"].append(
+                f"{key}: {cs:.3f}x vs committed {bs:.3f}x "
+                f"(floor {bs * tolerance:.3f}x at tolerance {tolerance})"
+            )
+        elif cs > bs:
+            out["improvements"].append(f"{key}: {cs:.3f}x vs committed {bs:.3f}x")
+        else:
+            out["unchanged"].append(f"{key}: {cs:.3f}x (committed {bs:.3f}x)")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rendering (CLI `show`; PERFORMANCE.md's generated table)
+# ---------------------------------------------------------------------------
+
+def render_md(data: dict) -> str:
+    """The registry as a markdown table — what ``obs perfdb show --format md``
+    prints and docs/PERFORMANCE.md's "Measured verdict registry" section
+    regenerates from."""
+    lines = [
+        "| device | family | shape class | speedup | flip | block | source | updated |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for key in sorted(data.get("entries", {})):
+        e = data["entries"][key]
+        speed = (
+            f"{e['value']:g} {e.get('unit', '')}".strip()
+            if "value" in e
+            else f"{e.get('speedup', 0.0):.3f}x"
+        )
+        lines.append(
+            f"| {e['device_kind']} | {e['kernel_family']} | {e['shape_class']} "
+            f"| {speed} | {'ON' if e.get('flip') else 'off'} "
+            f"| {e.get('block', '—')} | {e.get('source', '')} "
+            f"| {e.get('updated', '')} |"
+        )
+    for kind in sorted(data.get("ceilings", {})):
+        c = data["ceilings"][kind]
+        lines.append(
+            f"| {kind} | matmul ceiling | — | {c['matmul_tflops']:g} TFLOP/s | — | — "
+            f"| {c.get('source', '')} | {c.get('updated', '')} |"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def render_text(data: dict) -> str:
+    entries = data.get("entries", {})
+    ceilings = data.get("ceilings", {})
+    lines = [f"perfdb: {len(entries)} entr(y/ies), {len(ceilings)} ceiling(s)"]
+    for key in sorted(entries):
+        e = entries[key]
+        speed = (
+            f"{e['value']:g} {e.get('unit', '')}".strip()
+            if "value" in e
+            else f"{e.get('speedup', 0.0):.3f}x"
+        )
+        block = f" block={e['block']}" if "block" in e else ""
+        lines.append(
+            f"  {key}: {speed} flip={'ON' if e.get('flip') else 'off'}{block} "
+            f"[{e.get('source', '')} {e.get('updated', '')}]"
+        )
+    for kind in sorted(ceilings):
+        c = ceilings[kind]
+        lines.append(
+            f"  ceiling {kind}: {c['matmul_tflops']:g} TFLOP/s "
+            f"[{c.get('source', '')} {c.get('updated', '')}]"
+        )
+    return "\n".join(lines) + "\n"

@@ -202,3 +202,16 @@ class ProfilerWindows:
                 trigger=self._trigger,
                 **table,
             )
+            # the same trace folded into roofline buckets — standing
+            # attribution telemetry beside every profile record
+            from distribuuuu_tpu.obs import attribution
+
+            self._telemetry.event(
+                "step_attribution",
+                **attribution.attribution_record(
+                    str(self._logdir),
+                    self._steps_done,
+                    gstep=self._start_gstep,
+                    trigger=self._trigger,
+                ),
+            )

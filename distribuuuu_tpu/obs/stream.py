@@ -373,6 +373,20 @@ class LiveAggregator:
         elif kind == "alarm_clear":
             self._count("alarms_cleared_total")
             self.active_alarms.discard(self._alarm_key(r))
+        elif kind == "step_attribution":
+            # roofline buckets as standing gauges (dtpu_attr_*): the
+            # 45%-outside-the-matmuls number on the /metrics surface
+            buckets = r.get("buckets")
+            if isinstance(buckets, dict):
+                for bucket, ms in buckets.items():
+                    if isinstance(ms, (int, float)) and not isinstance(ms, bool):
+                        self._gauge(f"attr_{bucket}_ms", float(ms))
+            if isinstance(r.get("matmul_pct"), (int, float)):
+                self._gauge("attr_matmul_pct", float(r["matmul_pct"]))
+        elif kind == "kernel_verdict":
+            self._count("kernel_verdicts_total")
+            if r.get("transition") in ("flip", "unflip"):
+                self._count("kernel_flips_total")
 
     @staticmethod
     def _alarm_key(r: dict) -> str:

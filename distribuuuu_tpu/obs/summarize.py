@@ -706,6 +706,39 @@ def render(records: Iterable[dict]) -> str:
         for op in p.get("top_ops", [])[:10]:
             out(f"  {op['pct']:5.1f}%  {op['ms_per_step']:8.3f} ms  {op['op']}")
 
+    # -- step attribution (roofline) -----------------------------------------
+    if by_kind["step_attribution"]:
+        from distribuuuu_tpu.obs.attribution import render_roofline
+
+        a = by_kind["step_attribution"][-1]
+        out("")
+        head = "step attribution (roofline)"
+        if a.get("gstep") is not None:
+            head += f" @ gstep {a['gstep']}"
+        out(head + ":")
+        for line in render_roofline(a):
+            out(line)
+
+    # -- kernel verdicts (perfdb registry transitions) -----------------------
+    if by_kind["kernel_verdict"]:
+        flips = [
+            r for r in by_kind["kernel_verdict"]
+            if r.get("transition") in ("flip", "unflip")
+        ]
+        out("")
+        out(
+            f"kernel verdicts: {len(by_kind['kernel_verdict'])} recorded, "
+            f"{len(flips)} default transition(s)"
+        )
+        for r in by_kind["kernel_verdict"][-10:]:
+            trans = r.get("transition", "none")
+            mark = {"flip": " → FLIPPED ON", "unflip": " → UNFLIPPED"}.get(trans, "")
+            out(
+                f"  {r['kernel_family']} [{r['shape_class']}] on "
+                f"{r['device_kind']}: {r['speedup']:.3f}x "
+                f"({r.get('source', '?')}){mark}"
+            )
+
     return "\n".join(lines) + "\n"
 
 

@@ -44,12 +44,46 @@ the training loss to keep routing balanced.
 
 from __future__ import annotations
 
-import os
 from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+# cfg.MODEL.FUSED_MOE lands here for the duration of a trainer run
+# (trainer._model_globals_scoped restores it); tri-state like the epilogue
+# default — None means no opinion and the perfdb registry decides
+_CFG_FUSED: bool | None = None
+
+
+def set_fused_moe_default(enabled: bool | None) -> None:
+    global _CFG_FUSED
+    _CFG_FUSED = None if enabled is None else bool(enabled)
+
+
+def get_fused_moe_default() -> bool | None:
+    return _CFG_FUSED
+
+
+def resolve_moe_fused(
+    fused: bool | None, n: int, d: int, e: int, capacity: int
+) -> bool:
+    """The fused-dispatch routing decision for one (tokens, dim, experts,
+    capacity) geometry — precedence explicit arg > ``DTPU_FUSED_MOE`` env >
+    ``MODEL.FUSED_MOE`` cfg > the verdict registry's measured flip for this
+    device and shape class > off (`obs/perfdb.resolve_switch`)."""
+    from distribuuuu_tpu.obs import perfdb
+
+    decision, _source = perfdb.resolve_switch(
+        "moe",
+        perfdb.shape_class(n=n, d=d, e=e, c=capacity),
+        explicit=fused,
+        env_var="DTPU_FUSED_MOE",
+        cfg=_CFG_FUSED,
+        default=False,
+    )
+    return decision
 
 
 def token_slot_positions(onehot_e: jnp.ndarray) -> jnp.ndarray:
@@ -90,9 +124,10 @@ def switch_moe(
       fused: route dispatch/combine through the Pallas kernels in
         `ops/moe_kernel.py` (the ``[n, E, C]`` one-hot mask stays VMEM-
         resident instead of round-tripping HBM twice). ``None`` (default)
-        reads ``DTPU_FUSED_MOE=1`` — the `DTPU_FUSED_ATTN` opt-in
-        convention; oracle equality (fwd + grad, incl. the capacity-drop
-        boundary) is pinned in tests/test_moe_kernel.py.
+        resolves via `resolve_moe_fused` — ``DTPU_FUSED_MOE`` env >
+        ``MODEL.FUSED_MOE`` cfg > the perfdb verdict registry > off;
+        oracle equality (fwd + grad, incl. the capacity-drop boundary) is
+        pinned in tests/test_moe_kernel.py.
       interpret: run the fused kernels in the Pallas interpreter (CPU
         tests); ignored on the einsum path.
 
@@ -116,8 +151,7 @@ def switch_moe(
             f"'{axis_name}' axis has {e} devices (one expert per device); "
             "tokens routed past the axis would be silently dropped"
         )
-    if fused is None:
-        fused = os.environ.get("DTPU_FUSED_MOE", "0") == "1"
+    fused = resolve_moe_fused(fused, n, d, e, capacity)
     if fused:
         from distribuuuu_tpu.ops.moe_kernel import (
             fused_moe_dispatch,

@@ -73,9 +73,11 @@ def main():
     if bytes_acc:
         print(f"  arithmetic intensity:    {flops / bytes_acc:.1f} flops/byte")
     print(f"  (at R img/s/chip, effective TFLOPs/chip = R * {per_img:.3e} / 1e12)")
+    # registry-aware: peak_flops_per_device prefers a perfdb-measured matmul
+    # ceiling (scripts/stage_roofline.py writes it) over the datasheet table
     peak = obs_flops.peak_flops_per_device()
     if peak:
-        print(f"  device peak (table):     {peak / 1e12:.1f} TFLOP/s "
+        print(f"  device peak (measured ceiling or table): {peak / 1e12:.1f} TFLOP/s "
               f"-> MFU = R * {per_img:.3e} / {peak:.3e}")
 
 

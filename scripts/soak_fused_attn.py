@@ -29,6 +29,20 @@ L, where the small-L measured loss no longer applies), plus ring vs
 Ulysses vs dense attention over a seq mesh. Emits ONE JSON verdict line
 (docs/PERFORMANCE.md "Large-L kernels"); off-TPU the timings are
 interpreter/CPU noise and the verdict field says so.
+
+Every mode now WRITES its verdict through the perfdb registry
+(obs/perfdb.py) as well as printing it: one typed ``kernel_verdict``
+journal record per measurement, keyed (device_kind, family, shape-class)
+— this is how switch_* defaults flip themselves on a measured on-chip
+>1× and unflip on regression, instead of a human copying JSON off
+stdout. ``--registry``/``--journal`` redirect the writes (ALWAYS point
+them at /tmp for experimental runs — the default path is the committed
+registry), ``--no-registry`` restores print-only behavior,
+``--trust-interpret`` lets interpreter timings count as flips (CI
+fixtures only — never trust interpreter speed), and ``--autotune``
+additionally sweeps the estimator-priced candidate tilings and caches
+the measured winner in the registry (attention-blockwise under --seq,
+epilogue row tiles under --epilogue).
 """
 
 import argparse
@@ -39,7 +53,81 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main():
+def _registry_db(args):
+    """The PerfDB writer the flags select, or None for print-only runs."""
+    if args.no_registry:
+        return None
+    from distribuuuu_tpu.obs import perfdb
+
+    try:
+        return perfdb.PerfDB(args.registry)
+    except ValueError:  # DTPU_PERFDB=0 and no explicit --registry
+        print("(perfdb disabled: verdict printed only)", flush=True)
+        return None
+
+
+def _write_verdict(args, family, dims, *, speedup, fused_ms, baseline_ms,
+                   interpret, numerics, block=None, extra=None):
+    """Print one JSON verdict line AND persist it through the registry.
+
+    The printed line carries the same device_kind/shape_class key the
+    registry entry is stored under, so a human and the machinery read the
+    same verdict. Returns the registry entry (with its flip/unflip
+    transition) or None when the registry is off.
+    """
+    import json
+
+    import jax
+
+    from distribuuuu_tpu.obs import perfdb
+
+    device_kind = jax.devices()[0].device_kind
+    shape_cls = perfdb.shape_class(**dims)
+    line = {
+        "metric": "kernel_verdict",
+        "kernel_family": family,
+        "device_kind": device_kind,
+        "shape_class": shape_cls,
+        "speedup": round(float(speedup), 3),
+        "fused_ms": round(float(fused_ms), 3),
+        "baseline_ms": round(float(baseline_ms), 3),
+        "interpret": bool(interpret),
+        "numerics": numerics,
+    }
+    if block is not None:
+        line["block"] = int(block)
+    if extra:
+        line.update(extra)
+    entry = None
+    db = _registry_db(args)
+    if db is not None:
+        entry = db.record_verdict(
+            family,
+            shape_cls,
+            speedup=float(speedup),
+            device_kind=device_kind,
+            fused_ms=float(fused_ms),
+            baseline_ms=float(baseline_ms),
+            interpret=bool(interpret),
+            trust_interpret=args.trust_interpret,
+            numerics=numerics,
+            source="soak",
+            block=block,
+            journal=args.journal if args.journal else True,
+        )
+        line["flip"] = entry["flip"]
+        line["transition"] = entry["transition"]
+    else:
+        line["flip"] = bool(
+            (not interpret or args.trust_interpret)
+            and float(speedup) > 1.0
+            and numerics == "pass"
+        )
+    print(json.dumps(line), flush=True)
+    return entry
+
+
+def main(args):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -133,6 +221,13 @@ def main():
     )
 
     ok = fwd_diff < 0.1 and grad_diff < 1.0 and abs_fwd_rel < 0.02 and abs_grad_diff < 1.0
+    interpret = jax.devices()[0].platform != "tpu"
+    _write_verdict(
+        args, "attention", {"l": L, "d": D, "dv": D},
+        speedup=abs_ms["abs-xla"] / abs_ms["abs-fused"],
+        fused_ms=abs_ms["abs-fused"], baseline_ms=abs_ms["abs-xla"],
+        interpret=interpret, numerics="pass" if ok else "fail",
+    )
     print(
         "SOAK",
         "PASS (numerics hold; see the speedup line for the flip/keep verdict)"
@@ -143,7 +238,7 @@ def main():
     sys.exit(0 if ok else 1)
 
 
-def main_moe():
+def main_moe(args):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -237,12 +332,18 @@ def main_moe():
     )
 
     ok = send_diff < 1e-4 and meta_ok and w_diff < 1e-6 and out_diff < 1e-4 and grad_diff < 1e-3
+    _write_verdict(
+        args, "moe", {"n": N, "d": D, "e": E, "c": C},
+        speedup=ms["einsum"] / ms["fused"],
+        fused_ms=ms["fused"], baseline_ms=ms["einsum"],
+        interpret=interpret, numerics="pass" if ok else "fail",
+    )
     print("SOAK", "PASS (numerics hold; see the speedup line for the "
           "flip/keep verdict)" if ok else "FAIL", flush=True)
     sys.exit(0 if ok else 1)
 
 
-def main_epilogue():
+def main_epilogue(args):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -321,18 +422,60 @@ def main_epilogue():
     )
 
     ok = fwd_diff < 0.05 and grad_diff < 1.0
+    rows = B * H * H
+    best_rows = None
+    if args.autotune:
+        # sweep the estimator-priced row tiles on this device and cache the
+        # winner; each candidate is a distinct static block_rows, so one jit
+        # bind per candidate (not jit-then-call per tick — dtpu-lint DT003)
+        from distribuuuu_tpu.obs import perfdb
+        from distribuuuu_tpu.ops.epilogue import candidate_block_rows
+
+        itemsize = np.dtype(jnp.bfloat16).itemsize
+        cands = candidate_block_rows(rows, C, itemsize, itemsize, itemsize)
+        db = _registry_db(args)
+
+        def measure(t):
+            f = jax.jit(
+                jax.grad(loss(lambda *a: fused_conv_epilogue(
+                    *a, relu=True, bn_dtype=bn_dtype, block_rows=t,
+                    interpret=interpret,
+                )))
+            )
+            jax.device_get(f(x, mean, mul, bias, identity))
+            t0 = time.perf_counter()
+            for _ in range(5):
+                jax.device_get(f(x, mean, mul, bias, identity))
+            return (time.perf_counter() - t0) / 5 * 1000
+
+        if db is not None and cands:
+            best_rows, cached = perfdb.autotune(
+                db, "epilogue", perfdb.shape_class(r=rows, c=C), cands, measure,
+                journal=args.journal if args.journal else True,
+            )
+            print(
+                f"autotune block_rows: winner {best_rows} over {cands}"
+                f"{' (registry cache hit)' if cached else ''}",
+                flush=True,
+            )
+    _write_verdict(
+        args, "epilogue", {"r": rows, "c": C},
+        speedup=ms["unfused"] / ms["fused"],
+        fused_ms=ms["fused"], baseline_ms=ms["unfused"],
+        interpret=interpret, numerics="pass" if ok else "fail",
+        block=best_rows,
+    )
     print("SOAK", "PASS (numerics hold; see the speedup line for the "
           "flip/keep verdict)" if ok else "FAIL", flush=True)
     sys.exit(0 if ok else 1)
 
 
-def main_seq():
+def main_seq(args):
     """--seq: the large-L verdict. Blockwise fused attention vs XLA at
     L=1024 (numerics + fwd+bwd microbench) and ring/Ulysses/dense attention
     over a seq mesh. Prints one JSON verdict line; `flip` is meaningful
     ON-CHIP only (the `interpret` field marks CPU runs)."""
     import functools
-    import json
 
     import jax
     import jax.numpy as jnp
@@ -431,24 +574,62 @@ def main_seq():
     tol = 0.05 if dt == jnp.bfloat16 else 1e-3
     ok = fwd_diff < tol and grad_diff < (1.0 if dt == jnp.bfloat16 else 0.05)
     speedup = ms["xla"] / ms["fused"]
-    verdict = {
-        "metric": "seq_soak",
-        "l": L,
-        "heads": N,
-        "batch": B,
-        "fused_ms": round(ms["fused"], 2),
-        "xla_ms": round(ms["xla"], 2),
-        "fused_speedup": round(speedup, 3),
-        # flip DTPU_FUSED_ATTN's large-L default only on an on-chip >1x win
-        "flip": bool(not interpret and speedup > 1.0),
-        "interpret": interpret,
-        "seq": p,
-        "fwd_maxdiff": round(fwd_diff, 5),
-        "grad_maxdiff": round(grad_diff, 5),
-        "numerics": "pass" if ok else "fail",
-        **seq_ms,
-    }
-    print(json.dumps(verdict), flush=True)
+
+    best_blk = None
+    if args.autotune:
+        # sweep the estimator-priced blockwise window sizes and cache the
+        # measured winner under family "attention_blk" — _pick_block consults
+        # it before its own largest-fits heuristic. One jit bind per
+        # candidate block (static nondiff arg), not per tick — dtpu-lint DT003
+        from distribuuuu_tpu.obs import perfdb
+
+        cands = att.candidate_blocks(L, D, D, q.dtype.itemsize, True)
+        db = _registry_db(args)
+
+        def measure(blk):
+            f = jax.jit(jax.grad(loss(functools.partial(
+                att._fused_attention_blk, block=blk, interpret=interpret))))
+            jax.device_get(f(q, k, v, bias))
+            reps = 2 if interpret else 5
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                jax.device_get(f(q, k, v, bias))
+            return (time.perf_counter() - t0) / reps * 1000
+
+        if db is not None and cands:
+            best_blk, cached = perfdb.autotune(
+                db, "attention_blk", perfdb.shape_class(l=L, d=D, dv=D),
+                cands, measure,
+                journal=args.journal if args.journal else True,
+            )
+            print(
+                f"autotune block: winner {best_blk} over {cands}"
+                f"{' (registry cache hit)' if cached else ''}",
+                flush=True,
+            )
+
+    # one JSON verdict line — the registry write and the printed line share
+    # the (device_kind, family, shape_class) key; `metric`/`fused_speedup`
+    # stay for the docs/PERFORMANCE.md "Large-L kernels" contract
+    _write_verdict(
+        args, "attention", {"l": L, "d": D, "dv": D},
+        speedup=speedup,
+        fused_ms=ms["fused"], baseline_ms=ms["xla"],
+        interpret=interpret, numerics="pass" if ok else "fail",
+        block=best_blk,
+        extra={
+            "metric": "seq_soak",
+            "l": L,
+            "heads": N,
+            "batch": B,
+            "xla_ms": round(ms["xla"], 2),
+            "fused_speedup": round(speedup, 3),
+            "seq": p,
+            "fwd_maxdiff": round(fwd_diff, 5),
+            "grad_maxdiff": round(grad_diff, 5),
+            **seq_ms,
+        },
+    )
     sys.exit(0 if ok else 1)
 
 
@@ -468,12 +649,36 @@ if __name__ == "__main__":
         help="soak the large-L blockwise attention + ring/Ulysses arms; "
         "emits the flip/keep verdict JSON",
     )
+    parser.add_argument(
+        "--registry", default=None,
+        help="perfdb registry path to write the verdict into (default: the "
+        "committed perfdb/registry.json — point at /tmp for experiments)",
+    )
+    parser.add_argument(
+        "--journal", default=None,
+        help="journal path for the kernel_verdict record (default: "
+        "verdicts.jsonl next to the registry)",
+    )
+    parser.add_argument(
+        "--no-registry", action="store_true",
+        help="print the verdict only; do not touch any registry",
+    )
+    parser.add_argument(
+        "--trust-interpret", action="store_true",
+        help="let interpreter timings count toward the flip decision "
+        "(CI fixtures only — interpreter speed is not chip speed)",
+    )
+    parser.add_argument(
+        "--autotune", action="store_true",
+        help="also sweep candidate tilings and cache the measured winner "
+        "(--seq: attention block; --epilogue: block_rows)",
+    )
     args = parser.parse_args()
     if args.moe:
-        main_moe()
+        main_moe(args)
     elif args.epilogue:
-        main_epilogue()
+        main_epilogue(args)
     elif args.seq:
-        main_seq()
+        main_seq(args)
     else:
-        main()
+        main(args)

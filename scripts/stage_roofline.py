@@ -87,6 +87,12 @@ def main():
         "--stage", default=None,
         help="stem|s1|s2|s3|s4 | mm | strided | step | all (default all)",
     )
+    ap.add_argument(
+        "--no-registry", action="store_true",
+        help="do not write the measured matmul ceiling into the perfdb "
+        "registry (the default write is what makes MFU use the achievable "
+        "ceiling instead of the datasheet peak — obs/flops.py)",
+    )
     args = ap.parse_args()
 
     # Inventory sanity line: 3x-fwd over all rows should land ~24.7 GF/img —
@@ -168,6 +174,20 @@ def main():
         dt = timed(mm, a)
         mm_tf = 2 * M**3 / dt / 1e12
         print(f"matmul ceiling: bf16 {M}^3 = {mm_tf:.1f} TFLOPs ({dt*1e3:.2f} ms)\n", flush=True)
+        if not args.no_registry and jax.devices()[0].platform == "tpu":
+            # persist the achievable ceiling for this device_kind; MFU and
+            # the summarize roofline prefer it over the datasheet peak. CPU
+            # runs never write — a host "ceiling" would poison every MFU.
+            try:
+                from distribuuuu_tpu.obs import perfdb
+
+                perfdb.PerfDB().record_ceiling(mm_tf, source="stage_roofline")
+                print(f"(perfdb: recorded {mm_tf:.1f} TF ceiling for "
+                      f"{jax.devices()[0].device_kind})", flush=True)
+            except ValueError:
+                pass  # DTPU_PERFDB=0: registry disabled
+            except Exception as e:
+                print(f"(perfdb ceiling write skipped: {e!r})", flush=True)
 
     # --- per-shape conv microbench ----------------------------------------
     rows = []
@@ -335,7 +355,16 @@ def main():
 
     # --- attribution -------------------------------------------------------
     if rows and step_ms:
-        conv_ms = sum(c * dt_fb for _, _, c, _, dt_fb, _, _ in rows) * 1e3
+        # the share arithmetic goes through obs/attribution.py so this
+        # script's by-name buckets and the trace-walking step_attribution
+        # records classify with the same markers and cannot drift apart
+        from distribuuuu_tpu.obs.attribution import attribute_parts
+
+        buckets = attribute_parts({
+            f"conv {stage} {label}": c * dt_fb * 1e3
+            for stage, label, c, _, dt_fb, _, _ in rows
+        })
+        conv_ms = buckets["matmul"]
         total_gf = sum(3 * c * f for _, _, c, _, _, _, f in rows) / 1e9
         print(f"\nconv-only (sum count x f+b ms): {conv_ms:.1f} ms "
               f"({total_gf/ (conv_ms/1e3) / 1e3:.1f} TF achieved on convs alone)")

@@ -242,10 +242,13 @@ def test_mfu_arithmetic_hand_computed_resnet_case():
     assert obs_flops.mfu(1e9, 0.1, 0, 197e12) is None
 
 
-def test_peak_flops_table_and_override():
+def test_peak_flops_table_and_override(monkeypatch):
     class _Dev:
         device_kind = "TPU v5 lite"
 
+    # the committed perfdb registry carries a measured v5e ceiling that
+    # (by design) beats the datasheet table — disable it to pin the table
+    monkeypatch.setenv("DTPU_PERFDB", "0")
     assert obs_flops.peak_flops_per_device(_Dev()) == pytest.approx(197e12)
     _Dev.device_kind = "TPU v4"
     assert obs_flops.peak_flops_per_device(_Dev()) == pytest.approx(275e12)
@@ -253,6 +256,25 @@ def test_peak_flops_table_and_override():
     assert obs_flops.peak_flops_per_device(_Dev()) is None
     # explicit override beats the table and unknown hardware
     assert obs_flops.peak_flops_per_device(_Dev(), override_tflops=1.5) == pytest.approx(1.5e12)
+
+
+def test_peak_flops_prefers_measured_ceiling(tmp_path, monkeypatch):
+    """A perfdb-measured matmul ceiling for the device_kind beats the static
+    table (MFU then uses the achievable number), and the cfg override still
+    beats the registry."""
+    from distribuuuu_tpu.obs import perfdb
+
+    reg = tmp_path / "registry.json"
+    monkeypatch.setenv("DTPU_PERFDB", str(reg))
+    perfdb.PerfDB().record_ceiling(
+        111.0, device_kind="TPU v5 lite", source="test")
+
+    class _Dev:
+        device_kind = "TPU v5 lite"
+
+    assert obs_flops.peak_flops_per_device(_Dev()) == pytest.approx(111e12)
+    assert obs_flops.peak_flops_per_device(
+        _Dev(), override_tflops=1.5) == pytest.approx(1.5e12)
 
 
 def test_lowered_step_cost_dense_hand_computed():

@@ -1,0 +1,492 @@
+"""dtpu-perfdb: the kernel-verdict registry + attribution plane (ISSUE 18).
+
+Coverage map (the acceptance list):
+
+- registry roundtrip, read-modify-write merge across writer handles, and
+  the corrupt-file refusal contract (writes raise, consults degrade to
+  None with one warning, history is never clobbered);
+- flip/unflip transitions with typed ``kernel_verdict`` journal records,
+  and the full precedence chain (arg > env > cfg > registry > default) at
+  each switch site: `switch_epilogue`, `resolve_moe_fused`,
+  `switch_attention` + `_pick_block`'s registry winner;
+- autotune measure-and-cache: a registry hit skips re-measuring;
+- step-time attribution goldens against the checked-in trace fixture
+  (tests/fixtures/attribution_trace), `attribute_parts` classification
+  parity, and the ``step_attribution`` journal schema;
+- summarize sections (present + omitted-when-absent), LiveAggregator
+  ``attr_*`` gauges and verdict counters;
+- the CI gate: ``obs perfdb show/diff`` exit codes, calibrated value
+  regressions, uncalibrated ratio regressions, and the unflip rule;
+- the COMMITTED seed registry stays valid and keeps the measured
+  small-L attention verdict un-flipped.
+
+Everything runs on CPU; flips are exercised with ``trust_interpret`` /
+direct ``record_verdict`` writes into tmp registries (``DTPU_PERFDB``
+isolates every test from the committed file).
+"""
+
+import json
+import os
+
+import pytest
+
+from distribuuuu_tpu.obs import attribution, perfdb
+from distribuuuu_tpu.obs.__main__ import main as obs_cli
+from distribuuuu_tpu.obs.journal import read_journal, validate_record
+from distribuuuu_tpu.obs.summarize import render
+
+FIXTURE_TRACE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fixtures", "attribution_trace"
+)
+
+
+@pytest.fixture()
+def tmp_registry(tmp_path, monkeypatch):
+    """An isolated registry path, active for both writes and consults."""
+    path = str(tmp_path / "registry.json")
+    monkeypatch.setenv("DTPU_PERFDB", path)
+    return path
+
+
+def _kind():
+    return perfdb.default_device_kind()
+
+
+# ---------------------------------------------------------------------------
+# Shape classes
+# ---------------------------------------------------------------------------
+
+def test_shape_class_pow2_buckets():
+    # the soak's L=196 and a 224px model trace land in the same class
+    assert perfdb.shape_class(l=196, d=128, dv=128) == "d128-dv128-l256"
+    assert perfdb.shape_class(l=224, d=128, dv=128) == "d128-dv128-l256"
+    # L=1024 is a different regime — the large-L win must not leak small
+    assert perfdb.shape_class(l=1024, d=64, dv=64) == "d64-dv64-l1024"
+    # epilogue rows: 64*14*14 buckets to 16384; capacity 1280 down to 1024
+    assert perfdb.shape_class(r=12544, c=1024) == "c1024-r16384"
+    assert perfdb._bucket(1280) == 1024
+    # None dims are skipped, keys sorted
+    assert perfdb.shape_class(b=None, a=4) == "a4"
+
+
+# ---------------------------------------------------------------------------
+# Registry file: roundtrip, merge, refusal
+# ---------------------------------------------------------------------------
+
+def test_roundtrip_and_rmw_merge(tmp_registry):
+    a = perfdb.PerfDB()
+    a.record_verdict("epilogue", "c1024-r16384", speedup=1.4,
+                     numerics="pass", journal=False)
+    # a SECOND handle (another soak process) writes a different key: both
+    # survive — read-modify-write merges instead of clobbering
+    b = perfdb.PerfDB()
+    b.record_verdict("moe", "c1024-d128-e8-n8192", speedup=0.9,
+                     journal=False)
+    data = perfdb.load_registry(tmp_registry)
+    assert len(data["entries"]) == 2
+    assert perfdb.validate_data(data) == []
+    e = a.lookup("epilogue", "c1024-r16384")
+    assert e["speedup"] == 1.4 and e["flip"] is True and e["runs"] == 1
+    # re-verdict bumps runs
+    a.record_verdict("epilogue", "c1024-r16384", speedup=1.3, journal=False)
+    assert a.lookup("epilogue", "c1024-r16384")["runs"] == 2
+
+
+def test_corrupt_registry_refused_never_clobbered(tmp_registry):
+    with open(tmp_registry, "w") as f:
+        f.write("{ this is not json")
+    db = perfdb.PerfDB()
+    with pytest.raises(perfdb.PerfDBError):
+        db.record_verdict("epilogue", "c1024-r16384", speedup=2.0,
+                          journal=False)
+    # the corrupt bytes are still there — history is never destroyed
+    assert open(tmp_registry).read() == "{ this is not json"
+    # trace-time consults degrade to None instead of raising
+    assert perfdb.registry_flip("epilogue", "c1024-r16384") is None
+    assert perfdb.registry_block("epilogue", "c1024-r16384") is None
+    assert perfdb.measured_ceiling_tflops("TPU v5 lite", tmp_registry) is None
+    # schema-invalid (valid JSON, wrong shape) is refused the same way
+    with open(tmp_registry, "w") as f:
+        json.dump({"schema": 1, "entries": {"k": {"speedup": "fast"}}}, f)
+    with pytest.raises(perfdb.PerfDBError):
+        perfdb.load_registry(tmp_registry)
+
+
+def test_disabled_registry(tmp_registry, monkeypatch):
+    monkeypatch.setenv("DTPU_PERFDB", "0")
+    assert perfdb.registry_path() is None
+    with pytest.raises(ValueError):
+        perfdb.PerfDB()
+    assert perfdb.registry_flip("epilogue", "c1024-r16384") is None
+    # an explicit path still writes (the soak's --registry flag)
+    perfdb.PerfDB(tmp_registry).record_verdict(
+        "epilogue", "c1024-r16384", speedup=1.2, journal=False)
+    assert len(perfdb.load_registry(tmp_registry)["entries"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# Flip/unflip transitions + journal
+# ---------------------------------------------------------------------------
+
+def test_flip_then_unflip_journaled(tmp_registry, tmp_path):
+    jpath = str(tmp_path / "verdicts.jsonl")
+    db = perfdb.PerfDB()
+    e1 = db.record_verdict("epilogue", "c1024-r16384", speedup=1.3,
+                           fused_ms=1.0, baseline_ms=1.3, journal=jpath)
+    assert (e1["flip"], e1["transition"]) == (True, "flip")
+    e2 = db.record_verdict("epilogue", "c1024-r16384", speedup=0.8,
+                           fused_ms=1.3, baseline_ms=1.04, journal=jpath)
+    assert (e2["flip"], e2["transition"]) == (False, "unflip")
+    recs = list(read_journal(jpath))
+    assert [r["transition"] for r in recs] == ["flip", "unflip"]
+    assert all(r["kind"] == "kernel_verdict" for r in recs)
+    assert [e for r in recs for e in validate_record(r)] == []
+
+
+def test_interpreter_timings_never_flip(tmp_registry):
+    db = perfdb.PerfDB()
+    e = db.record_verdict("moe", "x1", speedup=5.0, interpret=True,
+                          journal=False)
+    assert e["flip"] is False
+    # the CI/test override treats interpreter time as real
+    e = db.record_verdict("moe", "x1", speedup=5.0, interpret=True,
+                          trust_interpret=True, journal=False)
+    assert (e["flip"], e["transition"]) == (True, "flip")
+    # failing numerics can never flip, whatever the speedup
+    e = db.record_verdict("moe", "x2", speedup=5.0, numerics="fail",
+                          journal=False)
+    assert e["flip"] is False
+
+
+# ---------------------------------------------------------------------------
+# resolve_switch precedence + the three switch sites
+# ---------------------------------------------------------------------------
+
+def test_resolve_switch_precedence(tmp_registry, monkeypatch):
+    cls = "c1024-r16384"
+    perfdb.PerfDB().record_verdict("epilogue", cls, speedup=1.5,
+                                   journal=False)
+    # registry beats the default...
+    assert perfdb.resolve_switch("epilogue", cls) == (True, "registry")
+    # ...but only for the EXACT class (no wildcard matching)
+    assert perfdb.resolve_switch("epilogue", "c512-r16384") == (False, "default")
+    assert perfdb.resolve_switch("epilogue", None) == (False, "default")
+    # cfg beats registry
+    assert perfdb.resolve_switch("epilogue", cls, cfg=False) == (False, "cfg")
+    # env beats cfg and registry
+    monkeypatch.setenv("DTPU_FUSED_EPILOGUE", "0")
+    assert perfdb.resolve_switch(
+        "epilogue", cls, env_var="DTPU_FUSED_EPILOGUE", cfg=True
+    ) == (False, "env")
+    # explicit arg beats everything
+    assert perfdb.resolve_switch(
+        "epilogue", cls, explicit=True, env_var="DTPU_FUSED_EPILOGUE",
+        cfg=False,
+    ) == (True, "arg")
+
+
+def test_switch_epilogue_flip_loop(tmp_registry, monkeypatch):
+    """The end-to-end acceptance loop at the epilogue site: a measured >1×
+    flips the trace-time default, a later <1× unflips it, and the operator
+    env var beats the registry throughout."""
+    from distribuuuu_tpu.ops.epilogue import switch_epilogue
+
+    monkeypatch.delenv("DTPU_FUSED_EPILOGUE", raising=False)
+    rows, ch = 12544, 1024
+    assert switch_epilogue(rows=rows, channels=ch) is False  # no verdict yet
+    db = perfdb.PerfDB()
+    db.record_verdict("epilogue", perfdb.shape_class(r=rows, c=ch),
+                      speedup=1.4, journal=False)
+    assert switch_epilogue(rows=rows, channels=ch) is True  # flipped
+    monkeypatch.setenv("DTPU_FUSED_EPILOGUE", "0")
+    assert switch_epilogue(rows=rows, channels=ch) is False  # env wins
+    monkeypatch.delenv("DTPU_FUSED_EPILOGUE", raising=False)
+    db.record_verdict("epilogue", perfdb.shape_class(r=rows, c=ch),
+                      speedup=0.8, journal=False)  # regression measured
+    assert switch_epilogue(rows=rows, channels=ch) is False  # unflipped
+    assert switch_epilogue(True, rows=rows, channels=ch) is True  # arg wins
+
+
+def test_switch_moe_site(tmp_registry, monkeypatch):
+    from distribuuuu_tpu.parallel.moe import (
+        resolve_moe_fused,
+        set_fused_moe_default,
+    )
+
+    monkeypatch.delenv("DTPU_FUSED_MOE", raising=False)
+    n, d, e, c = 8192, 128, 8, 1280
+    assert resolve_moe_fused(None, n, d, e, c) is False
+    perfdb.PerfDB().record_verdict(
+        "moe", perfdb.shape_class(n=n, d=d, e=e, c=c), speedup=1.2,
+        journal=False)
+    assert resolve_moe_fused(None, n, d, e, c) is True
+    # cfg (MODEL.FUSED_MOE) beats the registry; restore afterwards
+    set_fused_moe_default(False)
+    try:
+        assert resolve_moe_fused(None, n, d, e, c) is False
+    finally:
+        set_fused_moe_default(None)
+    assert resolve_moe_fused(False, n, d, e, c) is False  # arg wins
+
+
+def test_switch_attention_and_pick_block(tmp_registry, monkeypatch):
+    from distribuuuu_tpu.ops import attention as att
+
+    monkeypatch.delenv("DTPU_FUSED_ATTN", raising=False)
+    assert att.switch_attention(1024, 64, 64) is False
+    db = perfdb.PerfDB()
+    db.record_verdict("attention", perfdb.shape_class(l=1024, d=64, dv=64),
+                      speedup=1.3, journal=False)
+    assert att.switch_attention(1024, 64, 64) is True
+    monkeypatch.setenv("DTPU_FUSED_ATTN", "0")
+    assert att.switch_attention(1024, 64, 64) is False
+    monkeypatch.delenv("DTPU_FUSED_ATTN", raising=False)
+
+    # _pick_block prefers the registry's measured winner over largest-fits
+    cands = att.candidate_blocks(1024, 64, 64, 2, True)
+    assert len(cands) >= 2 and cands == sorted(cands, reverse=True)
+    default = att._pick_block(1024, 64, 64, 2, True)
+    assert default == cands[0]
+    winner = cands[1]  # a smaller-than-greedy measured winner
+    db.record_block("attention_blk", perfdb.shape_class(l=1024, d=64, dv=64),
+                    winner, journal=False)
+    assert att._pick_block(1024, 64, 64, 2, True) == winner
+    # a stale winner that no longer divides L is re-validated away
+    db.record_block("attention_blk", perfdb.shape_class(l=1000, d=64, dv=64),
+                    48, journal=False)
+    assert att._pick_block(1000, 64, 64, 2, True) != 48
+
+
+# ---------------------------------------------------------------------------
+# Autotune: measure-and-cache
+# ---------------------------------------------------------------------------
+
+def test_autotune_cache_hit_skips_measure(tmp_registry):
+    db = perfdb.PerfDB()
+    calls = []
+
+    def measure(block):
+        calls.append(block)
+        return {128: 3.0, 64: 1.0, 32: 2.0}[block]
+
+    winner, cached = perfdb.autotune(db, "epilogue", "c1024-r16384",
+                                     [128, 64, 32], measure, journal=False)
+    assert (winner, cached) == (64, False)
+    assert calls == [128, 64, 32]
+    # second sweep: registry hit, measure never called
+    calls.clear()
+    winner, cached = perfdb.autotune(db, "epilogue", "c1024-r16384",
+                                     [128, 64, 32], measure, journal=False)
+    assert (winner, cached) == (64, True) and calls == []
+    # the cached winner leaving the candidate list forces a re-sweep
+    winner, cached = perfdb.autotune(db, "epilogue", "c1024-r16384",
+                                     [128, 32], measure, journal=False)
+    assert (winner, cached) == (32, False) and calls == [128, 32]
+    # retune forces even on a hit
+    calls.clear()
+    winner, cached = perfdb.autotune(db, "epilogue", "c1024-r16384",
+                                     [128, 32], measure, retune=True,
+                                     journal=False)
+    assert cached is False and calls == [128, 32]
+    assert perfdb.autotune(db, "epilogue", "x", [], measure) == (None, False)
+    # an autotune-only entry never flips routing
+    assert perfdb.registry_flip("epilogue", "c1024-r16384") is False
+
+
+def test_verdict_preserves_autotune_winner(tmp_registry):
+    db = perfdb.PerfDB()
+    db.record_block("epilogue", "c1024-r16384", 64, journal=False)
+    db.record_verdict("epilogue", "c1024-r16384", speedup=1.2, journal=False)
+    e = db.lookup("epilogue", "c1024-r16384")
+    assert e["block"] == 64 and e["flip"] is True
+
+
+# ---------------------------------------------------------------------------
+# Attribution
+# ---------------------------------------------------------------------------
+
+def test_classify_op_and_parts():
+    assert attribution.classify_op("convolution.42") == "matmul"
+    assert attribution.classify_op("dot_general") == "matmul"
+    assert attribution.classify_op("all-reduce.1") == "collective"
+    assert attribution.classify_op("infeed") == "infeed"
+    assert attribution.classify_op("fusion.7") == "vector"
+    parts = attribution.attribute_parts(
+        {"conv s1 3x3": 10.0, "conv s2 1x1": 5.0, "bn+relu": 3.0})
+    assert parts["matmul"] == 15.0 and parts["vector"] == 3.0
+
+
+def test_attribution_goldens_from_fixture_trace():
+    """Hand-computed goldens for the checked-in 2-step trace: device ops are
+    8000µs convolution + 3000 fusion + 1000 all-reduce + 500 infeed (the
+    jit_ envelope and step-marker tracks excluded), host transfer 800µs."""
+    rec = attribution.attribute_logdir(FIXTURE_TRACE, steps=2)
+    assert rec["device_ms_per_step"] == pytest.approx(6.25)
+    assert rec["buckets"] == {
+        "matmul": 4.0, "vector": 1.5, "collective": 0.5,
+        "infeed": 0.25, "host": 0.4,
+    }
+    assert rec["matmul_pct"] == pytest.approx(64.0)
+    assert rec["host_ms"] == pytest.approx(0.4)
+
+
+def test_attribution_missing_trace_degrades():
+    rec = attribution.attribute_logdir("/nonexistent/logdir", steps=5)
+    assert rec["device_ms_per_step"] is None
+    assert rec["matmul_pct"] is None
+    assert set(rec["buckets"]) == set(attribution.BUCKETS)
+
+
+def test_step_attribution_journal_schema(tmp_registry, tmp_path):
+    from distribuuuu_tpu.obs.journal import ValidatedJournal
+
+    rec = attribution.attribution_record(FIXTURE_TRACE, 2, gstep=30,
+                                         trigger="at_steps")
+    path = str(tmp_path / "run.jsonl")
+    j = ValidatedJournal(path, label="test")
+    j.event("step_attribution", **rec)
+    j.close()
+    recs = list(read_journal(path))
+    assert [e for r in recs for e in validate_record(r)] == []
+    assert recs[0]["buckets"]["matmul"] == 4.0
+
+
+def test_summarize_and_aggregator(tmp_registry):
+    from distribuuuu_tpu.obs.stream import LiveAggregator
+
+    rec = attribution.attribution_record(FIXTURE_TRACE, 2, gstep=30)
+    verdict = {
+        "ts": 1.0, "kind": "kernel_verdict", "kernel_family": "epilogue",
+        "device_kind": _kind(), "shape_class": "c1024-r16384",
+        "speedup": 1.4, "flip": True, "source": "soak", "transition": "flip",
+    }
+    text = render([{"ts": 1.0, "kind": "step_attribution", **rec}, verdict])
+    assert "step attribution (roofline) @ gstep 30" in text
+    assert "outside-the-matmuls: 36.0%" in text
+    assert "kernel verdicts: 1 recorded, 1 default transition(s)" in text
+    assert "FLIPPED ON" in text
+    # omitted-when-absent
+    clean = render([{"ts": 1.0, "kind": "run_start", "argv": [], "devices": 1,
+                     "device_kind": "cpu", "gstep": 0}])
+    assert "attribution" not in clean and "kernel verdicts" not in clean
+
+    agg = LiveAggregator()
+    agg.ingest({"ts": 1.0, "kind": "step_attribution", **rec})
+    agg.ingest(verdict)
+    assert agg.gauges["attr_matmul_ms"] == 4.0
+    assert agg.gauges["attr_matmul_pct"] == pytest.approx(64.0)
+    assert agg.counters["kernel_verdicts_total"] == 1
+    assert agg.counters["kernel_flips_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# The CI gate: perfdb show / diff
+# ---------------------------------------------------------------------------
+
+def _write_reg(path, value=2355.3, speedup=0.771, flip=False):
+    db = perfdb.PerfDB(str(path))
+    db.record_verdict("attention", "d128-dv128-l256", speedup=speedup,
+                      device_kind="TPU v5 lite", journal=False)
+    if flip:
+        db.record_verdict("attention", "d128-dv128-l256", speedup=1.2,
+                          device_kind="TPU v5 lite", journal=False)
+    db.record_bench("train:resnet50@224", value=value,
+                    unit="images/sec/chip", device_kind="TPU v5 lite",
+                    vs_baseline=value / 400.0, journal=False)
+    return str(path)
+
+
+def test_perfdb_show_cli(tmp_registry, capsys):
+    _write_reg(tmp_registry)
+    assert obs_cli(["perfdb", "show", "--registry", tmp_registry]) == 0
+    assert "2 entr" in capsys.readouterr().out
+    assert obs_cli(["perfdb", "show", "--registry", tmp_registry,
+                    "--format", "md"]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("| device | family | shape class |")
+    assert "| 2355.3 images/sec/chip |" in out
+    assert obs_cli(["perfdb", "show", "--registry",
+                    tmp_registry + ".missing"]) == 1
+
+
+def test_perfdb_diff_gate(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("DTPU_PERFDB_CAL_SCALE", "1.0")
+    committed = _write_reg(tmp_path / "committed.json")
+    # identical candidate: gate passes
+    same = _write_reg(tmp_path / "same.json")
+    assert obs_cli(["perfdb", "diff", same, "--against", committed]) == 0
+    assert "perfdb diff OK" in capsys.readouterr().out
+    # synthetic slowdown beyond tolerance: gate fails with the reason
+    slow = _write_reg(tmp_path / "slow.json", value=1500.0)
+    assert obs_cli(["perfdb", "diff", slow, "--against", committed]) == 1
+    err = capsys.readouterr().err
+    assert "REGRESSION" in err and "1500.0" in err
+    # within tolerance (0.9 default): 2200 > 2355.3 * 0.9 → passes
+    near = _write_reg(tmp_path / "near.json", value=2200.0)
+    assert obs_cli(["perfdb", "diff", near, "--against", committed]) == 0
+    capsys.readouterr()
+
+
+def test_diff_calibration_and_unflip_rule(tmp_path, monkeypatch):
+    committed = perfdb.load_registry(_write_reg(tmp_path / "c.json"))
+    # a slow CI box (scale 1.5) loosens ABSOLUTE floors: 1700 img/s would
+    # regress at scale 1 (floor 2119.8) but passes calibrated (floor 1413.2)
+    cand = perfdb.load_registry(_write_reg(tmp_path / "r.json", value=1700.0))
+    assert perfdb.diff_registries(committed, cand, scale=1.0)["regressions"]
+    assert not perfdb.diff_registries(committed, cand, scale=1.5)["regressions"]
+    # ...but speedup RATIOS are never calibrated: a 0.6x vs committed 0.771x
+    # kernel row regresses at any machine scale
+    worse = perfdb.load_registry(
+        _write_reg(tmp_path / "w.json", value=2355.3, speedup=0.6))
+    assert perfdb.diff_registries(committed, worse, scale=4.0)["regressions"]
+    # a committed flip=True whose candidate unflipped is a regression even
+    # when the ratio change alone is within tolerance
+    flipped = perfdb.load_registry(
+        _write_reg(tmp_path / "f.json", flip=True))
+    # candidate measured 1.1x (within 0.9 tolerance of the committed 1.2x)
+    # but in the interpreter, so its flip is False → still a regression
+    u = perfdb.PerfDB(str(tmp_path / "u.json"))
+    u.record_verdict("attention", "d128-dv128-l256", speedup=1.1,
+                     device_kind="TPU v5 lite", interpret=True, journal=False)
+    u.record_bench("train:resnet50@224", value=2355.3,
+                   unit="images/sec/chip", device_kind="TPU v5 lite",
+                   vs_baseline=5.888, journal=False)
+    unflipped = perfdb.load_registry(str(tmp_path / "u.json"))
+    res = perfdb.diff_registries(flipped, unflipped)
+    assert any("UNFLIPPED" in r for r in res["regressions"])
+    # disjoint device kinds never gate (a CPU run can't regress a TPU row)
+    cpu = {"schema": 1, "entries": {}, "ceilings": {}}
+    res = perfdb.diff_registries(committed, cpu)
+    assert not res["regressions"] and len(res["missing"]) == 2
+
+
+def test_machine_scale_env_pin(monkeypatch):
+    monkeypatch.setenv("DTPU_PERFDB_CAL_SCALE", "2.5")
+    assert perfdb.machine_scale() == 2.5
+    monkeypatch.setenv("DTPU_PERFDB_CAL_SCALE", "9")
+    assert perfdb.machine_scale() == 4.0  # clamped
+    monkeypatch.setenv("DTPU_PERFDB_CAL_SCALE", "0.1")
+    assert perfdb.machine_scale() == 1.0  # never tightens
+
+
+# ---------------------------------------------------------------------------
+# The committed seed registry
+# ---------------------------------------------------------------------------
+
+def test_committed_registry_valid_and_unflipped():
+    data = perfdb.load_registry(perfdb.repo_default_path())
+    assert perfdb.validate_data(data) == []
+    att = data["entries"]["TPU v5 lite|attention|d128-dv128-l256"]
+    # the 2026-07-31 measured small-L LOSS: flip must stay off until a chip
+    # soak measures otherwise (docs/PERFORMANCE.md attention row)
+    assert att["flip"] is False and att["speedup"] == pytest.approx(0.771)
+    assert data["ceilings"]["TPU v5 lite"]["matmul_tflops"] == pytest.approx(107.0)
+
+
+def test_measured_ceiling_substring_match(tmp_registry):
+    db = perfdb.PerfDB()
+    db.record_ceiling(107.0, device_kind="TPU v5 lite", source="test")
+    assert perfdb.measured_ceiling_tflops("TPU v5 lite") == 107.0
+    # the flops.py lowercase query resolves against the registry row
+    assert perfdb.measured_ceiling_tflops("tpu v5 lite") == 107.0
+    assert perfdb.measured_ceiling_tflops("TPU v4") is None
