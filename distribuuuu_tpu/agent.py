@@ -162,6 +162,7 @@ _OUTCOME_PRECEDENCE = (
     resilience.EXIT_CRASH,
     resilience.EXIT_HANG,
     resilience.EXIT_RESIZE,
+    resilience.EXIT_DEMOTED,
     resilience.EXIT_PREEMPTED,
     resilience.EXIT_CLEAN,
 )

@@ -533,6 +533,70 @@ _C.SERVE.DEPLOY.MAX_STRIKES = 2
 # dead and its lease taken over.
 _C.SERVE.DEPLOY.LOCK_LEASE_S = 600.0
 
+# Global serving front door (dtpu-ingress, serve/ingress.py; docs/SERVING.md
+# "Global ingress"). A router process in front of N replica pools:
+# discovery by /healthz + /metrics polling, least-loaded routing with
+# trace-id stickiness inside the home pool, spillover to secondary pools
+# before shedding, per-tenant token-bucket admission, and an active/standby
+# router pair over a stale-takeover lease file.
+_C.SERVE.INGRESS = CN()
+# Replica pools behind the router: "pool=host:port,host:port,..." entries
+# (a bare port means 127.0.0.1). The FIRST entry is the home pool; a
+# saturated or dark home pool spills to the remaining pools in listed
+# order. Empty disables the router entirely.
+_C.SERVE.INGRESS.POOLS = []
+# Router bind address. PORT 0 picks a free ephemeral port; the
+# DTPU_INGRESS_PORT env var overrides (how the fleet sidecar hands each
+# router of an active/standby pair its own port).
+_C.SERVE.INGRESS.HOST = "127.0.0.1"
+_C.SERVE.INGRESS.PORT = 0
+# Discovery cadence: every PROBE_S each configured replica is polled
+# (/healthz for liveness+readiness+models, /metrics for the queue-depth /
+# p99 / fill gauges its routing weight derives from). A replica that fails
+# a probe is quarantined for QUARANTINE_S, then re-probed — late-appearing
+# replicas join the pool live through the same loop.
+_C.SERVE.INGRESS.PROBE_S = 1.0
+_C.SERVE.INGRESS.PROBE_TIMEOUT_S = 2.0
+_C.SERVE.INGRESS.QUARANTINE_S = 5.0
+# Routing: requests go least-loaded within the home pool, but a request
+# carrying a trace id prefers its rendezvous-hashed replica (retries land
+# on the same machine — warm caches, coherent spans) until that replica's
+# load exceeds the pool minimum by STICKY_SLACK examples.
+_C.SERVE.INGRESS.STICKY_SLACK = 8.0
+# Per-request candidates tried per pool before moving to the next pool.
+_C.SERVE.INGRESS.ATTEMPTS_PER_POOL = 2
+# Upstream predict timeout per attempt (seconds).
+_C.SERVE.INGRESS.TIMEOUT_S = 30.0
+# Tenancy: "name=key:rps[:burst[:weight]]" entries. A non-empty list makes
+# the x-dtpu-api-key header mandatory on /v1/predict (unknown key -> 401).
+# Each tenant's token bucket refills at `rps` examples/second with `burst`
+# capacity (default 2x rps); quota exhaustion sheds 429 + Retry-After
+# sized to the bucket's refill, never a silent drop. `weight` (default 1)
+# sets the tenant's share of router capacity under saturation.
+_C.SERVE.INGRESS.TENANTS = []
+# Weighted-fair admission: once the router's total in-flight examples
+# reach MAX_INFLIGHT, a tenant holding more than
+# weight/sum(weights) * MAX_INFLIGHT of them is shed (429) until it
+# drains — one tenant's burst degrades that tenant, never a sibling's SLO.
+_C.SERVE.INGRESS.MAX_INFLIGHT = 64
+# Active/standby failover: both routers of a pair run the same config with
+# DTPU_INGRESS_INSTANCE 0/1; whoever holds the lease file
+# (OUT_DIR/ingress/router.lock, the deploy rollout-lease protocol) serves,
+# the other answers 503 "standby" (retryable) and probes for takeover. A
+# holder silent for LEASE_S is presumed dead; the standby promotes within
+# about one lease interval.
+_C.SERVE.INGRESS.LEASE_S = 2.0
+# Per-tenant rollup cadence (ingress_tenant records) and per-request
+# journaling (ingress_route; heavy — same class as SERVE.JOURNAL_REQUESTS).
+_C.SERVE.INGRESS.ROLLUP_S = 10.0
+_C.SERVE.INGRESS.JOURNAL_REQUESTS = True
+# Fleet co-scheduling: FLEET True makes the dtpu-fleet controller spawn
+# REPLICAS router process(es) beside its gangs (the DataplaneSidecar
+# pattern — restart-on-death under the fleet restart budget; 2 = an
+# active/standby pair on PORT, PORT+1).
+_C.SERVE.INGRESS.FLEET = False
+_C.SERVE.INGRESS.REPLICAS = 1
+
 # Post-training int8 quantization (dtpu-quant; docs/PERFORMANCE.md,
 # docs/SERVING.md "Serving int8"). A hosted model opts in per entry:
 # SERVE.MODELS "name=arch@weights:int8" quantizes that model's conv/dense

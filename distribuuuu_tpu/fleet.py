@@ -461,6 +461,149 @@ class DataplaneSidecar:
 
 
 # ---------------------------------------------------------------------------
+# Co-scheduled ingress routers (SERVE.INGRESS.FLEET; docs/SERVING.md)
+# ---------------------------------------------------------------------------
+
+class IngressSidecar:
+    """The dtpu-ingress router pair the controller runs beside its gangs.
+
+    ``SERVE.INGRESS.FLEET True`` (with a non-empty ``POOLS``) means "the
+    pool owns its front door": the controller spawns ``REPLICAS`` router
+    processes of `serve.ingress` — instance 0 on the derived base port,
+    instance 1 (the standby) on base+1 — exports the address list as
+    ``DTPU_INGRESS_ADDR`` (the client router mode's discovery override),
+    and restarts the dead ones under the same sliding-window budget as the
+    dataplane sidecar. Two exit codes are deliberate, not crashes, and
+    restart WITHOUT spending budget: ``DEMOTED_EXIT_CODE`` (a router lost
+    the lease to its peer and must come back as the standby) and the
+    preemption codes (128+SIGTERM/SIGINT). Ports are *derived*
+    (`runtime/dist.derive_ingress_port` reserves base AND base+1), so
+    controller, routers and clients agree without parsing output."""
+
+    def __init__(self, journal: FleetJournal, argv: list[str]):
+        from distribuuuu_tpu.runtime.dist import derive_ingress_port
+
+        self._journal = journal
+        self._argv = list(argv)
+        s = cfg.SERVE.INGRESS
+        self.replicas = max(1, int(s.REPLICAS))
+        base = int(s.PORT) or derive_ingress_port(
+            os.path.abspath(str(cfg.OUT_DIR))
+        )
+        self._base_port = base
+        advertise = str(s.HOST).strip() or "127.0.0.1"
+        if advertise in ("0.0.0.0", "::"):
+            # same wildcard hazard as the dataplane sidecar: a bind-all
+            # address is not a connect address
+            import socket as _socket
+
+            try:
+                advertise = _socket.gethostbyname(_socket.gethostname())
+            except OSError:
+                advertise = "127.0.0.1"
+        self.addresses = ",".join(
+            f"{advertise}:{base + i}" for i in range(self.replicas)
+        )
+        self._workers: list[Worker | None] = [None] * self.replicas
+        self._restarts = [0] * self.replicas
+        # per-instance budgets: a crash-looping standby must not starve the
+        # healthy active of its own restarts
+        self._budgets = [
+            RestartBudget(
+                int(cfg.FLEET.MAX_GANG_RESTARTS), float(cfg.FLEET.RESTART_WINDOW_S)
+            )
+            for _ in range(self.replicas)
+        ]
+        self._next_spawn = [0.0] * self.replicas
+        self._gave_up = [False] * self.replicas
+
+    def _spawn(self, i: int) -> None:
+        cmd = [
+            sys.executable, "-m", "distribuuuu_tpu.serve.ingress",
+            *self._argv,
+            "OUT_DIR", str(cfg.OUT_DIR),
+        ]
+        env = dict(os.environ)
+        env["DTPU_INGRESS_INSTANCE"] = str(i)
+        env["DTPU_INGRESS_PORT"] = str(self._base_port + i)
+        self._workers[i] = Worker(
+            i, cmd, env,
+            os.path.join(str(cfg.OUT_DIR), "fleet", f"ingress{i}.log"),
+            label="ingress", new_session=True,
+        )
+
+    def start(self) -> None:
+        for i in range(self.replicas):
+            self._spawn(i)
+        os.environ["DTPU_INGRESS_ADDR"] = self.addresses  # clients inherit
+        logger.info(
+            f"fleet: co-scheduled {self.replicas} ingress router(s) at "
+            f"{self.addresses}"
+        )
+
+    def poll(self) -> None:
+        """Reap and restart dead routers. A demoted or preempted exit is a
+        planned relaunch (free); a crash spends the instance's budget."""
+        from distribuuuu_tpu.resilience import DEMOTED_EXIT_CODE, PREEMPT_EXIT_CODES
+
+        for i in range(self.replicas):
+            if self._gave_up[i]:
+                continue
+            w = self._workers[i]
+            if w is not None:
+                if w.returncode is None:
+                    continue
+                code = w.returncode
+                w.finish()
+                self._workers[i] = None
+                self._restarts[i] += 1
+                planned = code in (DEMOTED_EXIT_CODE, *PREEMPT_EXIT_CODES)
+                self._journal.event(
+                    "ingress_failover", action="restart", instance=i,
+                    code=int(code), restarts=self._restarts[i],
+                )
+                if not planned and not self._budgets[i].try_spend():
+                    self._gave_up[i] = True
+                    self._journal.event(
+                        "ingress_failover", action="gave_up", instance=i,
+                        code=int(code), restarts=self._restarts[i],
+                    )
+                    logger.error(
+                        f"fleet: ingress router {i} keeps dying with the "
+                        f"restart budget exhausted; its peer carries the "
+                        f"traffic alone"
+                    )
+                    continue
+                delay = 0.0 if planned else backoff_delay(
+                    self._budgets[i].in_window(),
+                    float(cfg.FLEET.BACKOFF_BASE_S), float(cfg.FLEET.BACKOFF_MAX_S),
+                )
+                self._next_spawn[i] = time.monotonic() + delay
+                logger.warning(
+                    f"fleet: ingress router {i} exited {code} "
+                    f"({'planned relaunch' if planned else 'crash'}); "
+                    f"restarting in {delay:.1f}s"
+                )
+                continue
+            if time.monotonic() >= self._next_spawn[i]:
+                self._spawn(i)
+
+    def stop(self) -> None:
+        os.environ.pop("DTPU_INGRESS_ADDR", None)
+        for i, w in enumerate(self._workers):
+            if w is None:
+                continue
+            w.signal(signal.SIGTERM)
+            deadline = time.monotonic() + 10.0
+            while w.returncode is None and time.monotonic() < deadline:
+                time.sleep(0.1)
+            if w.returncode is None:
+                w.signal_group(signal.SIGKILL)
+            w.finish()
+            self._workers[i] = None
+
+
+# ---------------------------------------------------------------------------
 # Jobs and the host pool
 # ---------------------------------------------------------------------------
 
@@ -1217,6 +1360,13 @@ class FleetQueue:
         if "DATA" in cfg and str(cfg.DATA.SERVICE).strip().lower() == "fleet":
             dataplane = DataplaneSidecar(self.journal, self._argv)
             dataplane.start()
+        ingress: IngressSidecar | None = None
+        if (
+            "SERVE" in cfg and "INGRESS" in cfg.SERVE
+            and bool(cfg.SERVE.INGRESS.FLEET) and list(cfg.SERVE.INGRESS.POOLS)
+        ):
+            ingress = IngressSidecar(self.journal, self._argv)
+            ingress.start()
         # SLO autoscaler (fleet_autoscale.py, FLEET.AUTOSCALE.ENABLE): the
         # alarm hook above feeds it transitions; _poll_autoscale applies its
         # decisions (serve scale file / training hold / dataplane respawn)
@@ -1243,6 +1393,8 @@ class FleetQueue:
                 self._poll_queue()
                 if dataplane is not None:
                     dataplane.poll()
+                if ingress is not None:
+                    ingress.poll()
                 self._poll_autoscale(obs_plane)
                 if self._autoscaler is not None and self._autoscaler.training_hold:
                     # a traffic spike holds training preempted: the queued
@@ -1276,6 +1428,8 @@ class FleetQueue:
                     self._poll_queue()
                     if dataplane is not None:
                         dataplane.poll()
+                    if ingress is not None:
+                        ingress.poll()
                     self._poll_autoscale(obs_plane)
                     if (
                         self._autoscaler is not None
@@ -1322,6 +1476,8 @@ class FleetQueue:
                 elif verdict != "clean":
                     rc = 1
         finally:
+            if ingress is not None:
+                ingress.stop()
             if dataplane is not None:
                 dataplane.stop()
             if obs_plane is not None:

@@ -80,11 +80,24 @@ def render_prometheus(snapshot: dict) -> str:
     for metric in sorted(snapshot.get("per_model", {})):
         kind = "counter" if metric.endswith("_total") else "gauge"
         typed(metric, kind)
+        # ingress metrics reuse the per-model label slot for a different
+        # dimension: the tenant name, the pool name, or the shed reason
+        # (obs/stream.py ingress_* folds) — rename the label key so PromQL
+        # reads `dtpu_ingress_tenant_qps{tenant="teamA"}` rather than a
+        # lying model="teamA"
+        if metric.startswith("ingress_tenant"):
+            label_key = "tenant"
+        elif metric.startswith(("ingress_pool", "ingress_requests")):
+            label_key = "pool"
+        elif metric.startswith("ingress_sheds_by_reason"):
+            label_key = "reason"
+        else:
+            label_key = "model"
         for model, value in sorted(snapshot["per_model"][metric].items()):
             # "model#rN" labels (replica-stamped serve_slo rollups) split
             # into separate model/replica label pairs
             base, sep, rep = model.partition("#r")
-            labels = {"model": base}
+            labels = {label_key: base}
             if sep and rep.isdigit():
                 labels["replica"] = rep
             out.append(_line(metric, value, labels))

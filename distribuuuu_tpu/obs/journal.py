@@ -351,6 +351,59 @@ SCHEMA: dict[str, tuple[dict[str, tuple], dict[str, tuple]]] = {
         {"model": _STR, "batch_size": _INT, "wall_s": _NUM},
         {"quant": _STR},
     ),
+    # global ingress router (dtpu-ingress, serve/ingress.py; docs/SERVING.md
+    # "Global ingress"). The router is a supervisory writer — its records
+    # land on the .part<5000+instance> continuation. ------------------------
+    # router came up: bound port, the pool map it will probe, and which
+    # side of the active/standby pair this process started as
+    "ingress_start": (
+        {"port": _INT, "pools": _DICT, "role": _STR},
+        {"instance": _INT, "tenants": _INT, "host": _STR},
+    ),
+    # one routed request (SERVE.INGRESS.JOURNAL_REQUESTS): which pool and
+    # replica served it, end-to-end latency as the router saw it, whether
+    # it left the home pool (spilled), and how many upstream attempts it
+    # took. The per-tenant p99 the isolation guarantee is audited from.
+    "ingress_route": (
+        {"model": _STR, "pool": _STR, "replica": _STR, "n": _INT,
+         "latency_ms": _NUM, "ok": _BOOL},
+        {"tenant": _STR, "attempts": _INT, "spilled": _BOOL,
+         "trace_id": _STR, "status": _INT},
+    ),
+    # the router refused a request: reason is quota (tenant token bucket
+    # empty) | fair_share (saturated router, tenant over its weighted
+    # share) | saturated (every pool shed; retry_after_s carries the
+    # LARGEST surviving pool's drain estimate) | no_replica (every pool
+    # dark) | standby (this router does not hold the lease)
+    "ingress_shed": (
+        {"reason": _STR},
+        {"model": _STR, "tenant": _STR, "retry_after_s": _NUM,
+         "pools_tried": _INT, "n": _INT, "trace_id": _STR},
+    ),
+    # per-tenant admission rollup every SERVE.INGRESS.ROLLUP_S
+    "ingress_tenant": (
+        {"tenant": _STR, "window_s": _NUM, "requests": _INT, "shed": _INT},
+        {"examples": _INT, "qps": _NUM, "p50_ms": _NUM, "p99_ms": _NUM,
+         "quota_rps": _NUM},
+    ),
+    # role transitions of the active/standby pair (and the fleet sidecar's
+    # restart bookkeeping): action is start | promote (took the lease) |
+    # demote (lost the lease to a peer; the process exits DEMOTED) |
+    # restart | gave_up (sidecar restart budget exhausted)
+    "ingress_failover": (
+        {"action": _STR},
+        {"role": _STR, "holder": _STR, "instance": _INT,
+         "lease_age_s": _NUM, "code": _INT, "restarts": _INT,
+         "wall_s": _NUM},
+    ),
+    # discovery transitions: event is join (first healthy probe) |
+    # quarantine (probe failed; cooldown + re-probe) | rejoin (came back
+    # after quarantine) | eject (alive but unready — version swap in
+    # flight) | ready (readiness restored)
+    "ingress_replica": (
+        {"pool": _STR, "replica": _STR, "event": _STR},
+        {"healthy_n": _INT, "detail": _STR},
+    ),
     # the int8 quality gate's measurement vs the fp32 engine on fixture
     # inputs (quant/gate.py): passed False means the model REFUSED to serve
     "quant_quality": (
@@ -612,7 +665,8 @@ def _journal_parts(path: str) -> list[str]:
     (``.part2001`` for fleet host 1, ``.part3000`` for the controller,
     ``.part3100`` for the standalone autoscaler, ``.part1000+R`` for
     serve replicas, ``.part4000`` for the export
-    sidecar's alarm records), and on a remote OUT_DIR its own
+    sidecar's alarm records, ``.part<5000+I>`` for ingress routers), and
+    on a remote OUT_DIR its own
     commit/reopen continuations land at ``.part2001.part1``, ``...part2``
     (object stores have no append — `Journal` opens the next part). Each
     dot-separated number chain sorts as a tuple, so nested continuations

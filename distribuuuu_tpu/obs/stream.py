@@ -387,6 +387,54 @@ class LiveAggregator:
             self._count("kernel_verdicts_total")
             if r.get("transition") in ("flip", "unflip"):
                 self._count("kernel_flips_total")
+        elif kind == "ingress_start":
+            # the router's own birth record (serve/ingress.py): role as a
+            # gauge so dtpu_ingress_role flips 1→0 on a demotion
+            self._gauge("ingress_port", float(r.get("port", 0)))
+            self._gauge("ingress_role", 1.0 if r.get("role") == "active" else 0.0)
+        elif kind == "ingress_route":
+            # per-POOL request accounting (the "model" label slot carries
+            # the pool here; the exporter renders it as pool="...")
+            self._model_count("ingress_requests_total", r.get("pool") or "?", 1.0)
+            if r.get("spilled"):
+                self._count("ingress_spillovers_total")
+            if not r.get("ok", True):
+                self._count("ingress_errors_total")
+        elif kind == "ingress_shed":
+            self._count("ingress_sheds_total")
+            self._model_count(
+                "ingress_sheds_by_reason_total", str(r.get("reason", "?")), 1.0
+            )
+        elif kind == "ingress_tenant":
+            # per-tenant rollup window → standing gauges + running counters
+            # (label slot carries the tenant name)
+            t = str(r.get("tenant") or "anonymous")
+            self._model("ingress_tenant_qps", t, float(r.get("qps", 0.0)))
+            if isinstance(r.get("p50_ms"), (int, float)):
+                self._model("ingress_tenant_p50_ms", t, float(r["p50_ms"]))
+            if isinstance(r.get("p99_ms"), (int, float)):
+                self._model("ingress_tenant_p99_ms", t, float(r["p99_ms"]))
+            self._model_count("ingress_tenant_requests_total", t, float(r["requests"]))
+            self._model_count("ingress_tenant_shed_total", t, float(r["shed"]))
+        elif kind == "ingress_failover":
+            action = str(r.get("action", "?"))
+            if action in ("promote", "demote"):
+                self._count("ingress_failovers_total")
+                self._gauge("ingress_role", 1.0 if action == "promote" else 0.0)
+            elif action == "start":
+                self._gauge("ingress_role", 1.0 if r.get("role") == "active" else 0.0)
+            elif action in ("restart", "gave_up"):
+                self._count("ingress_router_restarts_total")
+        elif kind == "ingress_replica":
+            # standing per-pool healthy-replica gauge: dtpu_ingress_pool_healthy
+            # hitting 0 is the "pool went dark" page
+            if isinstance(r.get("healthy_n"), (int, float)):
+                self._model(
+                    "ingress_pool_healthy", str(r.get("pool", "?")),
+                    float(r["healthy_n"]),
+                )
+            if r.get("event") == "quarantine":
+                self._count("ingress_quarantines_total")
 
     @staticmethod
     def _alarm_key(r: dict) -> str:

@@ -563,6 +563,82 @@ def render(records: Iterable[dict]) -> str:
                     f"(strike {r.get('strikes', '?')})"
                 )
 
+    # -- ingress (dtpu-ingress, serve/ingress.py) ---------------------------
+    # the front-door story: routed/spilled/shed volumes per pool, the
+    # per-tenant quota ledger, replica churn and router failovers. Omitted
+    # when no ingress records exist, so non-routed reports are unchanged.
+    ingress_kinds = (
+        "ingress_start", "ingress_route", "ingress_shed", "ingress_tenant",
+        "ingress_failover", "ingress_replica",
+    )
+    if any(by_kind[k] for k in ingress_kinds):
+        out("")
+        routes = by_kind["ingress_route"]
+        sheds = by_kind["ingress_shed"]
+        spilled = sum(1 for r in routes if r.get("spilled"))
+        out(
+            f"ingress: {len(routes)} routed ({spilled} spilled), "
+            f"{len(sheds)} shed, {len(by_kind['ingress_start'])} router "
+            f"start(s)"
+        )
+        by_pool: dict[str, list[dict]] = defaultdict(list)
+        for r in routes:
+            by_pool[r.get("pool", "?")].append(r)
+        for pool in sorted(by_pool):
+            recs = by_pool[pool]
+            lat = sorted(float(r.get("latency_ms", 0.0)) for r in recs)
+            errs = sum(1 for r in recs if not r.get("ok", True))
+            out(
+                f"  pool[{pool}]: {len(recs)} request(s), "
+                f"p50 {_median(lat):.1f}ms / max {lat[-1]:.1f}ms"
+                + (f", {errs} error(s)" if errs else "")
+            )
+        shed_reasons: dict[str, int] = defaultdict(int)
+        for r in sheds:
+            shed_reasons[r.get("reason", "?")] += 1
+        if shed_reasons:
+            out(
+                "  sheds: "
+                + ", ".join(f"{k}={v}" for k, v in sorted(shed_reasons.items()))
+            )
+        # per-tenant ledger from the rollup windows (requests-weighted, same
+        # aggregation contract as the serve_slo section)
+        tenant_rolls: dict[str, list[dict]] = defaultdict(list)
+        for r in by_kind["ingress_tenant"]:
+            tenant_rolls[str(r.get("tenant") or "anonymous")].append(r)
+        for tenant in sorted(tenant_rolls):
+            rolls = tenant_rolls[tenant]
+            n_req = sum(r.get("requests", 0) for r in rolls)
+            n_shed = sum(r.get("shed", 0) for r in rolls)
+            p99 = max([r.get("p99_ms", 0.0) for r in rolls], default=0.0)
+            quota = next(
+                (r["quota_rps"] for r in rolls if r.get("quota_rps")), 0.0
+            )
+            out(
+                f"  tenant[{tenant}]: {n_req} admitted, {n_shed} shed, "
+                f"p99 {p99:.1f}ms"
+                + (f", quota {quota:g}/s" if quota else "")
+            )
+        churn: dict[str, int] = defaultdict(int)
+        for r in by_kind["ingress_replica"]:
+            churn[r.get("event", "?")] += 1
+        if churn:
+            out(
+                "  replicas: "
+                + ", ".join(f"{k}={v}" for k, v in sorted(churn.items()))
+            )
+        for r in by_kind["ingress_failover"]:
+            action = r.get("action", "?")
+            if action in ("promote", "demote", "gave_up"):
+                out(
+                    f"  failover: instance {r.get('instance', '?')} {action}"
+                    + (
+                        f" (lease age {r.get('lease_age_s'):.1f}s)"
+                        if isinstance(r.get("lease_age_s"), (int, float))
+                        else ""
+                    )
+                )
+
     # -- tracing (dtpu-obs v2: span records) --------------------------------
     # per-phase totals plus the critical path of the slowest traces — the
     # "where did the milliseconds go" view, reconstructed from the journal

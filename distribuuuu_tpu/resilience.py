@@ -380,6 +380,14 @@ RESIZE_EXIT_CODE = 118
 # a positive exit code to ride a process boundary).
 KILLED_EXIT_CODE = 137
 
+# An ingress router that lost its lease to a peer (a healed partition, an
+# operator starting a second active) exits with this code: not a crash —
+# the supervisor relaunches it immediately and it comes back as the
+# standby. Same "restart is free" contract as a preemption, but the
+# sidecar must tell the two apart: a demotion means a LIVE peer holds the
+# lease, so the relaunch must not race to re-acquire it.
+DEMOTED_EXIT_CODE = 119
+
 # Graceful-preemption exits (Preempted): 128+SIGTERM from the scheduler,
 # 128+SIGINT from an operator. Both mean "the run checkpointed and stopped
 # on purpose" — a supervisor restart resumes exactly where it left off.
@@ -388,6 +396,7 @@ PREEMPT_EXIT_CODES = (143, 130)
 # classify_exit_code verdicts, in escalation order for the agent's policy.
 EXIT_CLEAN = "clean"
 EXIT_PREEMPTED = "preempted"
+EXIT_DEMOTED = "demoted"
 EXIT_RESIZE = "resize"
 EXIT_HANG = "hang"
 EXIT_POISON = "poison"
@@ -400,6 +409,7 @@ EXIT_CRASH = "crash"
 _OUTCOME_EXIT_CODES = {
     EXIT_CLEAN: 0,
     EXIT_PREEMPTED: 143,
+    EXIT_DEMOTED: DEMOTED_EXIT_CODE,
     EXIT_RESIZE: RESIZE_EXIT_CODE,
     EXIT_HANG: HANG_EXIT_CODE,
     EXIT_POISON: POISON_EXIT_CODE,
@@ -433,6 +443,8 @@ def classify_exit_code(code: int | None) -> str:
         return EXIT_POISON
     if code == RESIZE_EXIT_CODE:
         return EXIT_RESIZE
+    if code == DEMOTED_EXIT_CODE:
+        return EXIT_DEMOTED
     if code in PREEMPT_EXIT_CODES:
         return EXIT_PREEMPTED
     return EXIT_CRASH

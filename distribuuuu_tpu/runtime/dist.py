@@ -154,6 +154,30 @@ def derive_dataplane_port(job_id: str, *, exclude: "Iterable[int]" = ()) -> int:
     return derive_rendezvous_port(f"dataplane:{job_id}", exclude=exclude)
 
 
+def derive_ingress_port(job_id: str, *, exclude: "Iterable[int]" = ()) -> int:
+    """The ingress router's base port derived deterministically from a job
+    id (OUT_DIR) — third disjoint hash namespace beside rendezvous and
+    dataplane, so the fleet sidecar and the serve clients it advertises to
+    agree on the router address without parsing each other's output. An
+    active/standby pair binds ``port`` and ``port + 1``."""
+    # exclude port+1's namespace collision too: the standby needs base+1
+    port = derive_rendezvous_port(f"ingress:{job_id}", exclude=set(exclude))
+    if not port_is_free(port + 1):
+        port = derive_rendezvous_port(
+            f"ingress:{job_id}", exclude=set(exclude) | {port}
+        )
+    return port
+
+
+def ingress_port_in_play() -> int | None:
+    """The co-scheduled ingress router's base port, when a supervisor
+    exported it (``DTPU_INGRESS_ADDR=host:port``) — excluded below for the
+    same reason the dataplane's is."""
+    addr = os.environ.get("DTPU_INGRESS_ADDR", "")
+    _, _, port = addr.rpartition(":")
+    return int(port) if port.isdigit() else None
+
+
 def dataplane_port_in_play() -> int | None:
     """The co-scheduled dataplane's port, when a supervisor exported its
     address (``DTPU_DATA_SERVICE=host:port``) — part of the exclusion set
@@ -177,6 +201,9 @@ def rendezvous_ports_in_play() -> set[int]:
     dp = dataplane_port_in_play()
     if dp is not None:
         ports.add(dp)
+    ip = ingress_port_in_play()
+    if ip is not None:
+        ports.update((ip, ip + 1))  # the standby binds base + 1
     return ports
 
 

@@ -27,6 +27,14 @@ model, canaried on a sticky fraction of live traffic, promoted only past
 SLO + quality gates, rolled back automatically (with persisted strike
 escalation) otherwise.
 
+A fifth layer fronts the whole fleet (`serve.ingress`, docs/SERVING.md
+"Global ingress", ``dtpu-ingress``): a router that discovers per-model
+replica pools by probing ``/healthz``+``/metrics``, routes least-loaded
+with trace-id stickiness, spills to secondary pools before shedding with
+the largest surviving pool's own ``Retry-After``, meters tenants with
+token-bucket quotas + weighted-fair admission, and fails over
+active/standby on the deploy tier's stale-takeover lease.
+
 Every request/batch/SLO window flows typed records (``serve_request``,
 ``serve_batch``, ``serve_slo``, ``serve_shed``) through the obs journal —
 deployments add ``deploy_watch/stage/canary/promote/rollback`` —
@@ -51,4 +59,9 @@ from distribuuuu_tpu.serve.engine import (  # noqa: F401
     InferenceEngine,
     ModelSpec,
     parse_model_specs,
+)
+from distribuuuu_tpu.serve.ingress import (  # noqa: F401
+    AdmissionController,
+    IngressRouter,
+    PoolManager,
 )

@@ -9,6 +9,15 @@ window covers the restart gap — a replica SIGKILL is invisible to callers
 (pinned by the chaos tier in tests/test_serve.py: kill a replica mid-load,
 every in-flight and subsequent request still completes).
 
+Endpoints are RE-RESOLVED, not just round-robined: once every known
+endpoint has failed at the connection level in a row, the client re-probes
+the configured set's ``/healthz`` and rebuilds its rotation from whoever
+answers — so a router failover (the promoted standby now holds the traffic,
+serve/ingress.py) or a replaced replica is discovered mid-request instead
+of the client spinning its whole deadline on cached dead sockets. Pointed
+at an ingress pair (`for_router`), the standby's retryable 503 "standby"
+plus this re-resolution make an active-router SIGKILL client-invisible.
+
 Stdlib-only (urllib), so operators can lift it into any client codebase.
 """
 
@@ -67,18 +76,61 @@ class ServeClient:
         backoff_base_s: float = 0.05,
         backoff_max_s: float = 1.0,
         timeout_s: float = 30.0,
+        api_key: str = "",
     ):
         if not ports:
             raise ValueError("ServeClient needs at least one replica port")
         self.urls = [f"http://{host}:{int(p)}" for p in ports]
+        # the full configured set, kept verbatim: re-resolution filters the
+        # ROTATION down to live endpoints but never forgets a configured one
+        # (a dark endpoint that comes back — the restarted router, the
+        # redeployed replica — rejoins at the next refresh)
+        self._configured_urls = list(self.urls)
         self.deadline_s = float(deadline_s)
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_max_s = float(backoff_max_s)
         self.timeout_s = float(timeout_s)
+        # tenant credential for an ingress front door with admission control
+        # (SERVE.INGRESS.TENANTS); sent as x-dtpu-api-key on every predict.
+        # Empty = anonymous (fine against bare replicas or an open router)
+        self.api_key = str(api_key)
         self.retries = 0  # total retry attempts across the client's lifetime
+        self.refreshes = 0  # endpoint re-resolution sweeps performed
         self.last_trace_id = ""  # the id the most recent predict() carried
         self._next = 0
+        self._conn_fails = 0  # consecutive connection-level failures
         self._rng = random.Random(0x5E17E)
+
+    @classmethod
+    def for_router(cls, addresses: str | list[str] | None = None, **kwargs) -> "ServeClient":
+        """A client pointed at the ingress router pair (serve/ingress.py)
+        instead of at replicas directly. ``addresses`` is
+        ``"host:port,host:port"`` (active first, standby second) or a list;
+        None reads ``DTPU_INGRESS_ADDR`` — the address list the fleet
+        controller exports when it co-schedules the routers. The standby
+        answers 503 "standby" (retryable), so the rotation lands on the
+        active within one retry; a killed active is then covered by the
+        connection-failure re-resolution above."""
+        import os
+
+        if addresses is None:
+            addresses = os.environ.get("DTPU_INGRESS_ADDR", "")
+        if isinstance(addresses, str):
+            addresses = [a for a in addresses.split(",") if a.strip()]
+        if not addresses:
+            raise ValueError(
+                "for_router needs addresses (or DTPU_INGRESS_ADDR set)"
+            )
+        hosts_ports = []
+        for addr in addresses:
+            host, _, port = str(addr).strip().rpartition(":")
+            if not port.isdigit():
+                raise ValueError(f"router address {addr!r} is not host:port")
+            hosts_ports.append((host or "127.0.0.1", int(port)))
+        client = cls([p for _, p in hosts_ports], host=hosts_ports[0][0], **kwargs)
+        client.urls = [f"http://{h}:{p}" for h, p in hosts_ports]
+        client._configured_urls = list(client.urls)
+        return client
 
     # -- health --------------------------------------------------------------
 
@@ -91,6 +143,30 @@ class ServeClient:
                 return json.loads(resp.read())
         except (urllib.error.URLError, OSError, json.JSONDecodeError, TimeoutError):
             return None
+
+    def _refresh_endpoints(self) -> None:
+        """Rebuild the rotation from whoever in the CONFIGURED set answers
+        ``/healthz`` right now (configured order preserved — against an
+        ingress pair that keeps the active first). An all-dark probe keeps
+        the full configured list: the retry loop then continues to knock on
+        every door until the deadline, which is exactly the restart-gap
+        behaviour the chaos tier pins."""
+        self.refreshes += 1
+        self._conn_fails = 0
+        alive = []
+        for url in self._configured_urls:
+            try:
+                with urllib.request.urlopen(
+                    f"{url}/healthz", timeout=min(2.0, self.timeout_s)
+                ) as resp:
+                    resp.read()
+                alive.append(url)
+            except (urllib.error.HTTPError,):
+                alive.append(url)  # an HTTP error is still a live listener
+            except (urllib.error.URLError, OSError, TimeoutError):
+                continue
+        self.urls = alive or list(self._configured_urls)
+        self._next = 0
 
     def wait_ready(self, deadline_s: float = 120.0) -> dict:
         """Block until every replica answers /healthz (startup gate)."""
@@ -142,16 +218,19 @@ class ServeClient:
             url = self.urls[self._next % len(self.urls)]
             self._next += 1
             retry_after: float | None = None
-            req = urllib.request.Request(
-                f"{url}/v1/predict",
-                data=body,
-                headers={"Content-Type": "application/json", TRACE_HEADER: trace_id},
-            )
+            headers = {"Content-Type": "application/json", TRACE_HEADER: trace_id}
+            if self.api_key:
+                headers["x-dtpu-api-key"] = self.api_key
+            req = urllib.request.Request(f"{url}/v1/predict", data=body, headers=headers)
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
                     payload = json.loads(resp.read())
+                self._conn_fails = 0
                 return np.asarray(payload["logits"], dtype=np.float32)
             except urllib.error.HTTPError as exc:
+                # ANY HTTP status proves the endpoint is alive — only
+                # connection-level failures count toward re-resolution
+                self._conn_fails = 0
                 if 400 <= exc.code < 500 and exc.code != 429:
                     detail = ""
                     try:
@@ -163,6 +242,13 @@ class ServeClient:
                 retry_after = _parse_retry_after(exc.headers.get("Retry-After"))
             except (urllib.error.URLError, OSError, TimeoutError, json.JSONDecodeError) as exc:
                 last_err = exc  # replica down / mid-kill: retryable
+                self._conn_fails += 1
+                if self._conn_fails >= len(self.urls):
+                    # every endpoint in the rotation failed to even connect:
+                    # stop grinding the cached list and re-resolve from the
+                    # configured set (the failover case — the standby's port
+                    # answers while the dead active's never will again)
+                    self._refresh_endpoints()
             attempt += 1
             self.retries += 1
             if retry_after is not None:

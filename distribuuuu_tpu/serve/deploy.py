@@ -192,10 +192,17 @@ class RolloutLease:
     exclusion (a pathological tie can admit two rollouts, which costs one
     redundant canary, never correctness), plus stale-holder takeover so a
     SIGKILLed replica mid-rollout cannot wedge the fleet's deploys forever.
+
+    ``path`` overrides the lease file location: the ingress router's
+    active/standby pair (serve/ingress.py) rides the same protocol over
+    ``OUT_DIR/ingress/router.lock`` with a seconds-scale lease — there a
+    "pathological tie" costs one redundant active for one settle window,
+    which the replica-side idempotent predict absorbs.
     """
 
-    def __init__(self, out_dir: str, holder: str, lease_s: float):
-        self.path = pathio.join(deploy_dir(out_dir), "rollout.lock")
+    def __init__(self, out_dir: str, holder: str, lease_s: float,
+                 *, path: str | None = None):
+        self.path = path or pathio.join(deploy_dir(out_dir), "rollout.lock")
         self.holder = str(holder)
         self.lease_s = float(lease_s)
         self._last_refresh = 0.0
@@ -228,15 +235,28 @@ class RolloutLease:
             logger.warning(f"deploy: lease acquire failed: {exc!r}")
             return False
 
-    def refresh(self) -> None:
+    def holder_state(self) -> tuple[str | None, float]:
+        """(current holder, record age in seconds); (None, 0.0) when the
+        lease file is absent/unreadable. How the ingress active detects it
+        LOST the lease to a peer (a healed partition) — it must demote
+        rather than refresh-stomp the new holder's claim."""
+        current = self._read()
+        if current is None:
+            return None, 0.0
+        return current.get("holder"), time.time() - float(current.get("ts", 0.0))
+
+    def refresh(self, *, force: bool = False) -> None:
         """Re-stamp the lease so a long rollout phase isn't 'stale'.
 
         Throttled to a tenth of the lease (floored at 1 s): callers invoke
         this freely from tight wait loops, and an un-throttled refresh would
         be ~10 writes/s against a possibly-remote OUT_DIR for a lease whose
-        staleness threshold is minutes — same liveness, ~1/100th the I/O."""
+        staleness threshold is minutes — same liveness, ~1/100th the I/O.
+        ``force`` skips the throttle: the ingress router's seconds-scale
+        lease lives on a local OUT_DIR and refreshes at its own paced loop —
+        the 1 s floor would let a 2 s lease go stale under a LIVE holder."""
         now = time.monotonic()
-        if now - self._last_refresh < max(1.0, self.lease_s / 10.0):
+        if not force and now - self._last_refresh < max(1.0, self.lease_s / 10.0):
             return
         self._last_refresh = now
         try:

@@ -1,0 +1,1050 @@
+"""dtpu-ingress: the global serving front door (docs/SERVING.md "Global
+ingress").
+
+One router process in front of N replica pools — the tier that turns "the
+retrying client happens to round-robin past the dead replica" into routed
+graceful degradation:
+
+- **Discovery**: every ``SERVE.INGRESS.PROBE_S`` each configured replica is
+  polled — ``/healthz`` for liveness, readiness and the hosted-model list,
+  ``/metrics`` for the queue-depth / p99 gauges its routing weight derives
+  from. A replica failing its probe is quarantined for ``QUARANTINE_S``
+  and re-probed (late-appearing replicas join live through the same loop);
+  a replica that answers but reports ``ready: false`` (a deploy version
+  swap in flight) is ejected from routing without quarantine.
+- **Routing**: ``POST /v1/predict`` goes least-loaded within the home pool
+  (the first ``POOLS`` entry). A request carrying a trace id prefers its
+  rendezvous-hashed replica until that replica's load exceeds the pool
+  minimum by ``STICKY_SLACK`` — retries land on the same machine, and the
+  client's ``x-dtpu-trace-id`` header is forwarded verbatim, so the
+  batcher's sticky canary hash (serve/batcher.py ``_version_for``) decides
+  identically wherever the request lands: the canary contract holds
+  end-to-end through the router.
+- **Spillover before shedding**: a saturated or dark home pool spills to
+  the remaining pools in listed order; only when EVERY pool shed does the
+  router answer 503 — with the LARGEST surviving pool's own ``Retry-After``
+  drain estimate, because the client's best move is to wait for the
+  deepest-capacity pool, not for whichever replica happened to answer
+  first.
+- **Tenancy**: ``TENANTS`` entries arm per-tenant API keys
+  (``x-dtpu-api-key``) with token-bucket quotas and weighted-fair admission
+  under saturation — one tenant's burst is answered with that tenant's
+  429/``Retry-After``, never a sibling's latency and never a silent drop.
+- **Failover**: an active/standby pair shares the deploy tier's
+  stale-takeover lease file (serve/deploy.RolloutLease over
+  ``OUT_DIR/ingress/router.lock``). The standby serves 503 "standby"
+  (retryable — the client's router mode re-resolves) while probing the
+  lease; a SIGKILLed active stops refreshing and the standby promotes
+  within about one lease interval. An active that finds a PEER on the
+  lease demotes and exits ``DEMOTED_EXIT_CODE`` (resilience.py) so its
+  supervisor relaunches it as the new standby.
+
+Same config contract as every other entry point (``--cfg config/x.yaml
+KEY VALUE ...``; ``dtpu-ingress`` console script / ``python -m
+distribuuuu_tpu.serve.ingress``). The router is jax-free by construction —
+it moves JSON bytes, never tensors. Typed ``ingress_*`` records land on
+the journal's ``.part<5000+instance>`` supervisory continuation and fold
+into an in-process aggregator for ``GET /metrics``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from distribuuuu_tpu.config import cfg, load_cfg_fom_args
+from distribuuuu_tpu.logging import logger, setup_logger
+from distribuuuu_tpu.obs.exporter import PROM_CONTENT_TYPE, render_prometheus
+from distribuuuu_tpu.obs.journal import ValidatedJournal
+from distribuuuu_tpu.obs.stream import LiveAggregator
+from distribuuuu_tpu.obs.trace import TRACE_HEADER, ensure_trace_id
+from distribuuuu_tpu.resilience import DEMOTED_EXIT_CODE
+
+# the tenant-key header; absent/unknown keys 401 once TENANTS is non-empty
+API_KEY_HEADER = "x-dtpu-api-key"
+
+# supervisory journal-part block (DT204 census: INGRESS_PART + instance,
+# disjoint from serve replicas' 1000+R, fleet hosts' 2000+H, the
+# controller's 3000/3100/3500 and the obs sidecar's 4000 family)
+INGRESS_PART = 5000
+
+
+# ---------------------------------------------------------------------------
+# Config parsing
+# ---------------------------------------------------------------------------
+
+def parse_pools(entries: list[str], default_host: str = "127.0.0.1") -> dict[str, list[str]]:
+    """``"pool=host:port,port,..."`` entries → ordered ``{pool: [url, ...]}``
+    (first entry = the home pool; bare ports mean ``default_host``)."""
+    pools: dict[str, list[str]] = {}
+    for entry in entries:
+        name, sep, members = str(entry).partition("=")
+        name = name.strip()
+        if not sep or not name or not members.strip():
+            raise ValueError(
+                f"SERVE.INGRESS.POOLS entry {entry!r} is not 'pool=host:port,...'"
+            )
+        urls = []
+        for member in members.split(","):
+            member = member.strip()
+            if not member:
+                continue
+            host, _, port = member.rpartition(":")
+            if not port.isdigit():
+                if member.isdigit():  # a bare port
+                    host, port = "", member
+                else:
+                    raise ValueError(
+                        f"SERVE.INGRESS.POOLS member {member!r} is not host:port"
+                    )
+            urls.append(f"http://{host or default_host}:{int(port)}")
+        if not urls:
+            raise ValueError(f"SERVE.INGRESS.POOLS entry {entry!r} lists no replicas")
+        if name in pools:
+            raise ValueError(f"SERVE.INGRESS.POOLS pool {name!r} listed twice")
+        pools[name] = urls
+    return pools
+
+
+def parse_tenants(entries: list[str]) -> list["Tenant"]:
+    """``"name=key:rps[:burst[:weight]]"`` entries → tenants. ``rps`` meters
+    EXAMPLES per second (a batch of 32 spends 32 tokens — per-request
+    metering would let one tenant smuggle arbitrary load in big batches)."""
+    tenants = []
+    seen_keys: set[str] = set()
+    for entry in entries:
+        name, sep, spec = str(entry).partition("=")
+        parts = spec.split(":")
+        if not sep or not name.strip() or len(parts) < 2 or not parts[0]:
+            raise ValueError(
+                f"SERVE.INGRESS.TENANTS entry {entry!r} is not "
+                f"'name=key:rps[:burst[:weight]]'"
+            )
+        key = parts[0]
+        if key in seen_keys:
+            raise ValueError(f"SERVE.INGRESS.TENANTS key {key!r} used twice")
+        seen_keys.add(key)
+        rate = float(parts[1])
+        burst = float(parts[2]) if len(parts) > 2 and parts[2] else 2.0 * rate
+        weight = float(parts[3]) if len(parts) > 3 and parts[3] else 1.0
+        if rate <= 0 or burst <= 0 or weight <= 0:
+            raise ValueError(f"SERVE.INGRESS.TENANTS entry {entry!r}: rps/burst/weight must be > 0")
+        tenants.append(Tenant(name.strip(), key, rate=rate, burst=burst, weight=weight))
+    return tenants
+
+
+def _pctl(vals: list[float], q: float) -> float:
+    """Nearest-rank percentile (the serve tier's convention)."""
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    return s[min(len(s) - 1, max(0, int(round(q * len(s) + 0.5)) - 1))]
+
+
+# ---------------------------------------------------------------------------
+# Journal glue
+# ---------------------------------------------------------------------------
+
+class IngressJournal(ValidatedJournal):
+    """Validated ``ingress_*`` appends on the router's own supervisory
+    ``.part<5000+instance>`` continuation — the router must never co-write
+    the main journal file an agent/trainer owns, and the two routers of an
+    active/standby pair must not co-write each other's part."""
+
+    def __init__(self, out_dir: str, instance: int):
+        try:
+            from distribuuuu_tpu.obs.telemetry import journal_path
+
+            path = f"{journal_path(out_dir)}.part{INGRESS_PART + int(instance)}"
+        except Exception as exc:  # pragma: no cover - defensive
+            logger.warning(f"ingress journal unavailable: {exc!r}")
+            path = None
+        super().__init__(path, label="ingress journal")
+
+
+# ---------------------------------------------------------------------------
+# Discovery: replica pools, probing, quarantine
+# ---------------------------------------------------------------------------
+
+class ReplicaState:
+    """One upstream replica as the router sees it. Mutable fields are only
+    ever touched under the owning `PoolManager`'s lock."""
+
+    def __init__(self, url: str, pool: str):
+        self.url = url
+        self.pool = pool
+        self.healthy = False          # answered its last probe
+        self.ready = True             # /healthz ready flag (deploy swap gate)
+        self.ever_joined = False
+        self.models: set[str] = set()
+        self.versions: dict = {}
+        self.queue_depth = 0.0        # polled dtpu_serve_queue_depth sum
+        self.p99_ms = 0.0
+        self.inflight = 0             # router-local in-flight examples
+        self.quarantined_until = 0.0
+
+    def load(self) -> float:
+        """Routing weight: examples ahead of a new arrival. The router-local
+        in-flight count is fresher than the polled queue depth (probe lag is
+        up to PROBE_S); p99 breaks ties toward the faster replica."""
+        return self.inflight + self.queue_depth + self.p99_ms / 1000.0
+
+
+def parse_gauge(metrics_text: str, metric: str) -> float:
+    """Sum of one gauge's samples across labels from Prometheus exposition
+    text (the replica /metrics surface, obs/exporter.py)."""
+    total = 0.0
+    prefix = f"dtpu_{metric}"
+    for line in metrics_text.splitlines():
+        if not line.startswith(prefix) or line.startswith("#"):
+            continue
+        name = line.split("{", 1)[0].split(" ", 1)[0]
+        if name != prefix:
+            continue
+        try:
+            total += float(line.rsplit(" ", 1)[1])
+        except (ValueError, IndexError):
+            continue
+    return total
+
+
+class PoolManager:
+    """Owns every `ReplicaState` plus the probe loop. One lock guards the
+    whole table; all network I/O happens OUTSIDE it (probe results are
+    gathered first, applied under the lock after — DT203)."""
+
+    def __init__(
+        self,
+        pools: dict[str, list[str]],
+        *,
+        probe_s: float,
+        probe_timeout_s: float,
+        quarantine_s: float,
+        journal_event,
+    ):
+        self._lock = threading.Lock()
+        self._order = list(pools)
+        self._replicas: dict[str, ReplicaState] = {}
+        for pool, urls in pools.items():
+            for url in urls:
+                self._replicas[url] = ReplicaState(url, pool)
+        self.probe_s = float(probe_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.quarantine_s = float(quarantine_s)
+        self._journal_event = journal_event
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    @property
+    def home_pool(self) -> str:
+        return self._order[0]
+
+    # -- probing -------------------------------------------------------------
+
+    def start(self) -> "PoolManager":
+        self.probe_once()  # synchronous first sweep: route from the start
+        self._thread = threading.Thread(
+            target=self._probe_loop, daemon=True, name="dtpu-ingress-probe"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.probe_timeout_s + 1.0)
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_s):
+            try:
+                self.probe_once()
+            except Exception as exc:  # pragma: no cover - loop must survive
+                logger.error(f"ingress: probe sweep failed: {exc!r}")
+
+    def probe_once(self) -> None:
+        """One discovery sweep: probe every non-quarantined replica (and
+        every quarantined one whose cooldown expired — the re-probe that
+        rejoins it), then apply the results and journal the transitions."""
+        now = time.monotonic()
+        with self._lock:
+            due = [r.url for r in self._replicas.values() if r.quarantined_until <= now]
+        results = {url: self._probe_one(url) for url in due}
+        events = []
+        with self._lock:
+            for url, result in results.items():
+                events.extend(self._apply(self._replicas[url], result))
+            healthy_n = {
+                pool: sum(
+                    1 for r in self._replicas.values()
+                    if r.pool == pool and r.healthy and r.ready
+                )
+                for pool in self._order
+            }
+        for ev in events:  # journal OUTSIDE the table lock
+            self._journal_event(
+                "ingress_replica", healthy_n=healthy_n[ev["pool"]], **ev
+            )
+
+    def _probe_one(self, url: str) -> dict | None:
+        """``/healthz`` + ``/metrics`` of one replica (no locks held)."""
+        try:
+            with urllib.request.urlopen(
+                f"{url}/healthz", timeout=self.probe_timeout_s
+            ) as resp:
+                health = json.loads(resp.read())
+        except (urllib.error.URLError, OSError, json.JSONDecodeError, TimeoutError):
+            return None
+        out = {
+            "ready": bool(health.get("ready", True)),
+            "models": {str(m) for m in health.get("models", []) or []},
+            "versions": health.get("versions") or {},
+            "queue_depth": 0.0,
+            "p99_ms": 0.0,
+        }
+        try:  # weight gauges are best-effort: a replica without /metrics routes
+            with urllib.request.urlopen(
+                f"{url}/metrics", timeout=self.probe_timeout_s
+            ) as resp:
+                text = resp.read().decode("utf-8", errors="replace")
+            out["queue_depth"] = parse_gauge(text, "serve_queue_depth")
+            out["p99_ms"] = parse_gauge(text, "serve_p99_ms")
+        except (urllib.error.URLError, OSError, TimeoutError):
+            pass
+        return out
+
+    def _apply(self, r: ReplicaState, result: dict | None) -> list[dict]:
+        """Fold one probe result into the table (lock held); returns the
+        transition events to journal."""
+        events = []
+        if result is None:
+            if r.healthy or not r.ever_joined:
+                events.append({"pool": r.pool, "replica": r.url, "event": "quarantine"})
+            r.healthy = False
+            r.quarantined_until = time.monotonic() + self.quarantine_s
+            return events if r.ever_joined else []  # a never-seen replica failing is not news
+        was_healthy, was_ready = r.healthy, r.ready
+        r.healthy = True
+        r.quarantined_until = 0.0
+        r.ready = result["ready"]
+        r.models = result["models"]
+        r.versions = result["versions"]
+        r.queue_depth = float(result["queue_depth"])
+        r.p99_ms = float(result["p99_ms"])
+        if not r.ever_joined:
+            r.ever_joined = True
+            events.append({"pool": r.pool, "replica": r.url, "event": "join"})
+        elif not was_healthy:
+            events.append({"pool": r.pool, "replica": r.url, "event": "rejoin"})
+        if was_ready and not r.ready:
+            events.append({
+                "pool": r.pool, "replica": r.url, "event": "eject",
+                "detail": "unready (version swap in flight)",
+            })
+        elif not was_ready and r.ready and (was_healthy or not events):
+            events.append({"pool": r.pool, "replica": r.url, "event": "ready"})
+        return events
+
+    # -- routing -------------------------------------------------------------
+
+    def candidates(
+        self, model: str, trace_id: str, *, sticky_slack: float, per_pool: int
+    ) -> list[tuple[str, list[str]]]:
+        """Routable replicas per pool, home pool first, each pool's list
+        ordered best-first and capped at ``per_pool``."""
+        out = []
+        with self._lock:
+            for pool in self._order:
+                eligible = [
+                    r for r in self._replicas.values()
+                    if r.pool == pool and r.healthy and r.ready
+                    and (not r.models or model in r.models)
+                ]
+                if not eligible:
+                    continue
+                eligible.sort(key=lambda r: (r.load(), r.url))
+                if trace_id and len(eligible) > 1:
+                    # rendezvous-hash stickiness: the trace id names ONE
+                    # preferred replica; it goes first while its load is
+                    # within sticky_slack of the pool minimum, so retries
+                    # revisit a warm machine but a hot-spot key cannot
+                    # melt it
+                    preferred = max(
+                        eligible,
+                        key=lambda r: zlib.crc32(f"{trace_id}|{r.url}".encode()),
+                    )
+                    if preferred.load() <= eligible[0].load() + sticky_slack:
+                        eligible.remove(preferred)
+                        eligible.insert(0, preferred)
+                out.append((pool, [r.url for r in eligible[:per_pool]]))
+        return out
+
+    def begin(self, url: str, n: int) -> None:
+        with self._lock:
+            r = self._replicas.get(url)
+            if r is not None:
+                r.inflight += int(n)
+
+    def end(self, url: str, n: int) -> None:
+        with self._lock:
+            r = self._replicas.get(url)
+            if r is not None:
+                r.inflight = max(0, r.inflight - int(n))
+
+    def mark_dead(self, url: str) -> dict | None:
+        """A forward attempt hit a connection failure: quarantine NOW (the
+        probe loop re-probes after cooldown). Returns the event to journal
+        (caller journals outside the lock), or None if already quarantined."""
+        with self._lock:
+            r = self._replicas.get(url)
+            if r is None or not r.healthy:
+                return None
+            r.healthy = False
+            r.quarantined_until = time.monotonic() + self.quarantine_s
+            healthy_n = sum(
+                1 for x in self._replicas.values()
+                if x.pool == r.pool and x.healthy and x.ready
+            )
+        return {
+            "pool": r.pool, "replica": url, "event": "quarantine",
+            "healthy_n": healthy_n, "detail": "connect failure on forward",
+        }
+
+    def health(self) -> dict:
+        """Per-pool health for the router's own /healthz."""
+        with self._lock:
+            return {
+                pool: {
+                    "replicas": sum(1 for r in self._replicas.values() if r.pool == pool),
+                    "healthy": sum(
+                        1 for r in self._replicas.values()
+                        if r.pool == pool and r.healthy and r.ready
+                    ),
+                }
+                for pool in self._order
+            }
+
+
+# ---------------------------------------------------------------------------
+# Tenancy: API keys, token buckets, weighted-fair admission
+# ---------------------------------------------------------------------------
+
+class Tenant:
+    """One tenant's quota state. Mutable fields are only touched under the
+    owning `AdmissionController`'s lock."""
+
+    def __init__(self, name: str, key: str, *, rate: float, burst: float, weight: float):
+        self.name = name
+        self.key = key
+        self.rate = float(rate)      # examples/second; <= 0 means unmetered
+        self.burst = float(burst)
+        self.weight = float(weight)
+        self.tokens = float(burst)
+        self.refilled = time.monotonic()
+        self.inflight = 0
+        # rollup window
+        self.requests = 0
+        self.shed = 0
+        self.examples = 0
+        self.latencies: list[float] = []
+
+    def take(self, n: int, now: float) -> float:
+        """0.0 and spend on success; else the refill wait for ``n`` tokens
+        (the quota shed's Retry-After — the bucket knows its own drain)."""
+        if self.rate <= 0:
+            return 0.0
+        elapsed = max(0.0, now - self.refilled)  # robust to a caller's clock
+        self.tokens = min(self.burst, self.tokens + elapsed * self.rate)
+        self.refilled = now
+        if self.tokens >= n:
+            self.tokens -= n
+            return 0.0
+        return (n - self.tokens) / self.rate
+
+
+class AdmissionController:
+    """Per-tenant admission: token buckets always, weighted-fair shares once
+    the router's total in-flight examples reach ``max_inflight`` — the
+    existing shed machinery (429 + Retry-After, typed journal record, the
+    retrying client absorbs it) scoped to the bursting tenant."""
+
+    def __init__(self, tenants: list[Tenant], *, max_inflight: int):
+        self._lock = threading.Lock()
+        self.open = not tenants  # no TENANTS configured: unauthenticated mode
+        self._anon = Tenant("", "", rate=0.0, burst=1.0, weight=1.0)
+        self._by_key = {t.key: t for t in tenants}
+        self._tenants = tenants or [self._anon]
+        self._total_weight = sum(t.weight for t in self._tenants)
+        self.max_inflight = int(max_inflight)
+        self._inflight_total = 0
+        self._window_started = time.time()
+
+    def authenticate(self, key: str | None) -> Tenant | None:
+        """The tenant for an API key; None = reject (401). Unauthenticated
+        mode admits everyone as the anonymous tenant."""
+        if self.open:
+            return self._anon
+        return self._by_key.get(key or "")
+
+    def admit(self, tenant: Tenant, n: int) -> tuple[str, float]:
+        """("", 0) admits ``n`` examples; else (shed reason, retry_after_s).
+        Admitted examples MUST be released via `release`."""
+        now = time.monotonic()
+        with self._lock:
+            wait = tenant.take(n, now)
+            if wait > 0.0:
+                tenant.shed += 1
+                return "quota", max(0.05, wait)
+            if self._inflight_total + n > self.max_inflight:
+                # saturated: weighted-fair — a tenant within its share is
+                # still admitted (the pools themselves backpressure via
+                # 503), one above it is shed until its own load drains
+                share = tenant.weight / self._total_weight * self.max_inflight
+                if tenant.inflight + n > share:
+                    tenant.shed += 1
+                    reason = "fair_share"
+                    # drain estimate: the tenant's own overage at its rate
+                    overage = tenant.inflight + n - share
+                    wait = overage / tenant.rate if tenant.rate > 0 else 0.25
+                    return reason, max(0.05, min(5.0, wait))
+            tenant.inflight += n
+            self._inflight_total += n
+            tenant.requests += 1
+            tenant.examples += n
+        return "", 0.0
+
+    def release(self, tenant: Tenant, n: int, latency_ms: float) -> None:
+        with self._lock:
+            tenant.inflight = max(0, tenant.inflight - n)
+            self._inflight_total = max(0, self._inflight_total - n)
+            if len(tenant.latencies) < 4096:  # bounded window memory
+                tenant.latencies.append(float(latency_ms))
+
+    def inflight_total(self) -> int:
+        with self._lock:
+            return self._inflight_total
+
+    def rollup(self) -> list[dict]:
+        """Drain the window into ``ingress_tenant`` record field dicts
+        (caller journals them outside the lock)."""
+        now = time.time()
+        records = []
+        with self._lock:
+            window_s = max(1e-6, now - self._window_started)
+            self._window_started = now
+            for t in self._tenants:
+                if not t.requests and not t.shed:
+                    continue
+                records.append({
+                    "tenant": t.name,
+                    "window_s": round(window_s, 3),
+                    "requests": t.requests,
+                    "shed": t.shed,
+                    "examples": t.examples,
+                    "qps": round(t.requests / window_s, 3),
+                    "p50_ms": round(_pctl(t.latencies, 0.50), 3),
+                    "p99_ms": round(_pctl(t.latencies, 0.99), 3),
+                    "quota_rps": t.rate,
+                })
+                t.requests = t.shed = t.examples = 0
+                t.latencies = []
+        return records
+
+
+# ---------------------------------------------------------------------------
+# The router
+# ---------------------------------------------------------------------------
+
+class RouteResult:
+    """Outcome of one routed request (the handler renders it)."""
+
+    def __init__(self, status: int, body: bytes, *, pool: str = "", replica: str = "",
+                 attempts: int = 0, spilled: bool = False,
+                 retry_after_s: float | None = None, reason: str = "",
+                 pools_tried: int = 0):
+        self.status = status
+        self.body = body
+        self.pool = pool
+        self.replica = replica
+        self.attempts = attempts
+        self.spilled = spilled
+        self.retry_after_s = retry_after_s
+        self.reason = reason          # set on router-originated 503 sheds
+        self.pools_tried = pools_tried
+
+
+class IngressRouter:
+    """Discovery + routing + admission + the active/standby role machine,
+    wired to one journal/aggregator pair."""
+
+    def __init__(self, out_dir: str):
+        s = cfg.SERVE.INGRESS
+        self.instance = int(os.environ.get("DTPU_INGRESS_INSTANCE", "0"))
+        self.journal = IngressJournal(out_dir, self.instance)
+        self.aggregator = LiveAggregator()
+        self.journal_requests = bool(s.JOURNAL_REQUESTS)
+        self.sticky_slack = float(s.STICKY_SLACK)
+        self.attempts_per_pool = max(1, int(s.ATTEMPTS_PER_POOL))
+        self.timeout_s = float(s.TIMEOUT_S)
+        self.lease_s = float(s.LEASE_S)
+        self.rollup_s = float(s.ROLLUP_S)
+        self.pool_map = parse_pools(list(s.POOLS), default_host=str(s.HOST))
+        if not self.pool_map:
+            raise ValueError("SERVE.INGRESS.POOLS is empty — nothing to route to")
+        self.pools = PoolManager(
+            self.pool_map,
+            probe_s=float(s.PROBE_S),
+            probe_timeout_s=float(s.PROBE_TIMEOUT_S),
+            quarantine_s=float(s.QUARANTINE_S),
+            journal_event=self.journal_event,
+        )
+        self.admission = AdmissionController(
+            parse_tenants(list(s.TENANTS)), max_inflight=int(s.MAX_INFLIGHT)
+        )
+        from distribuuuu_tpu.runtime import pathio
+        from distribuuuu_tpu.serve.deploy import RolloutLease
+
+        self.lease = RolloutLease(
+            out_dir,
+            holder=f"ingress-{self.instance}-{os.getpid()}",
+            lease_s=self.lease_s,
+            path=pathio.join(str(out_dir), "ingress", "router.lock"),
+        )
+        self._active = threading.Event()
+        self._demoted = threading.Event()
+        self._stop = threading.Event()
+        self._role_thread: threading.Thread | None = None
+        self.port = 0
+
+    # -- journal -------------------------------------------------------------
+
+    def journal_event(self, kind: str, **fields) -> None:
+        """Journal one typed record AND fold it into the live aggregator
+        (the frontend.ServeReplica pattern). Never called with the pool or
+        admission lock held."""
+        self.journal.event(kind, **fields)
+        try:
+            self.aggregator.ingest({"ts": time.time(), "kind": kind, **fields})
+        except Exception:  # pragma: no cover - the fold is already defensive
+            pass
+
+    # -- role machine --------------------------------------------------------
+
+    @property
+    def active(self) -> bool:
+        return self._active.is_set()
+
+    @property
+    def demoted(self) -> bool:
+        return self._demoted.is_set()
+
+    def start(self) -> "IngressRouter":
+        self.pools.start()
+        # first claim decides the initial role; the loop re-decides forever
+        if self.lease.try_acquire():
+            self._active.set()
+        self.journal_event(
+            "ingress_failover", action="start",
+            role="active" if self.active else "standby",
+            holder=self.lease.holder, instance=self.instance,
+        )
+        self._role_thread = threading.Thread(
+            target=self._role_loop, daemon=True, name="dtpu-ingress-role"
+        )
+        self._role_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.pools.stop()
+        if self._role_thread is not None:
+            self._role_thread.join(timeout=2.0)
+        for rec in self.admission.rollup():  # final window flush
+            self.journal_event("ingress_tenant", **rec)
+        if self.active:
+            self.lease.release()
+        self.journal.close()
+
+    def _role_loop(self) -> None:
+        """Active: refresh the lease, demote if a peer took it. Standby:
+        probe for takeover every quarter-lease — a dead active goes stale
+        after LEASE_S, so promotion lands within ~1.25 lease intervals of
+        the staleness threshold."""
+        poll = max(0.05, self.lease_s / 4.0)
+        last_rollup = time.monotonic()
+        while not self._stop.wait(poll):
+            try:
+                if self._active.is_set():
+                    holder, age = self.lease.holder_state()
+                    if holder is not None and holder != self.lease.holder:
+                        self._active.clear()
+                        self._demoted.set()
+                        self.journal_event(
+                            "ingress_failover", action="demote", role="standby",
+                            holder=str(holder), instance=self.instance,
+                            lease_age_s=round(age, 3),
+                        )
+                        logger.warning(
+                            f"ingress[{self.instance}]: lease taken by "
+                            f"{holder!r} — demoting (exit {DEMOTED_EXIT_CODE})"
+                        )
+                        self._stop.set()
+                        return
+                    self.lease.refresh(force=True)
+                elif self.lease.try_acquire():
+                    self._active.set()
+                    self.journal_event(
+                        "ingress_failover", action="promote", role="active",
+                        holder=self.lease.holder, instance=self.instance,
+                    )
+                    logger.info(f"ingress[{self.instance}]: promoted to active")
+                if time.monotonic() - last_rollup >= self.rollup_s:
+                    last_rollup = time.monotonic()
+                    for rec in self.admission.rollup():
+                        self.journal_event("ingress_tenant", **rec)
+            except Exception as exc:  # pragma: no cover - loop must survive
+                logger.error(f"ingress: role loop error: {exc!r}")
+
+    # -- routing -------------------------------------------------------------
+
+    def _forward(self, url: str, body: bytes, trace_id: str) -> tuple[int, bytes, float | None]:
+        """One upstream attempt → (status, response bytes, retry_after_s).
+        The trace id header is forwarded VERBATIM — the replica batcher's
+        sticky canary hash must see exactly what the client minted.
+        Connection-level failures raise OSError."""
+        req = urllib.request.Request(
+            f"{url}/v1/predict",
+            data=body,
+            headers={"Content-Type": "application/json", TRACE_HEADER: trace_id},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                return resp.status, resp.read(), None
+        except urllib.error.HTTPError as exc:
+            payload = b""
+            try:
+                payload = exc.read()
+            except OSError:
+                pass
+            retry_after = None
+            try:
+                retry_after = float(exc.headers.get("Retry-After", ""))
+            except (TypeError, ValueError):
+                pass
+            return exc.code, payload, retry_after
+        except urllib.error.URLError as exc:
+            raise OSError(str(exc.reason)) from exc
+
+    def route(self, model: str, n: int, body: bytes, trace_id: str) -> RouteResult:
+        """Route one admitted request: least-loaded + sticky within the home
+        pool, spill to secondaries, shed only when every pool did."""
+        home = self.pools.home_pool
+        candidates = self.pools.candidates(
+            model, trace_id, sticky_slack=self.sticky_slack,
+            per_pool=self.attempts_per_pool,
+        )
+        attempts = 0
+        retry_afters: list[float] = []
+        pools_tried = 0
+        for pool, urls in candidates:
+            pools_tried += 1
+            for url in urls:
+                attempts += 1
+                self.pools.begin(url, n)
+                try:
+                    status, payload, retry_after = self._forward(url, body, trace_id)
+                except OSError:
+                    # replica dark mid-forward: quarantine it and move on —
+                    # the request itself survives on the next candidate
+                    event = self.pools.mark_dead(url)
+                    if event is not None:
+                        self.journal_event("ingress_replica", **event)
+                    continue
+                finally:
+                    self.pools.end(url, n)
+                if status == 503:
+                    # this replica shed; remember ITS drain estimate and try
+                    # the pool's next candidate, then the next pool
+                    if retry_after is not None:
+                        retry_afters.append(retry_after)
+                    continue
+                return RouteResult(
+                    status, payload, pool=pool, replica=url,
+                    attempts=attempts, spilled=(pool != home),
+                )
+        # nothing answered: every pool is saturated (shed with the LARGEST
+        # surviving pool's drain estimate — waiting out the deepest backlog
+        # beats retrying into the shallowest) or every pool is dark
+        if retry_afters:
+            return RouteResult(
+                503,
+                json.dumps({"error": "saturated", "pools_tried": pools_tried}).encode(),
+                attempts=attempts, retry_after_s=max(retry_afters),
+                reason="saturated", pools_tried=pools_tried,
+            )
+        return RouteResult(
+            503,
+            json.dumps({"error": "no_replica", "pools_tried": pools_tried}).encode(),
+            attempts=attempts, retry_after_s=max(1.0, self.pools.probe_s),
+            reason="no_replica", pools_tried=pools_tried,
+        )
+
+    def metrics_text(self) -> str:
+        return render_prometheus(self.aggregator.snapshot())
+
+    def announce(self, port: int, host: str) -> None:
+        self.port = int(port)
+        self.journal_event(
+            "ingress_start",
+            port=self.port,
+            pools={pool: len(urls) for pool, urls in self.pool_map.items()},
+            role="active" if self.active else "standby",
+            instance=self.instance,
+            tenants=0 if self.admission.open else len(self.admission._by_key),
+            host=str(host),
+        )
+
+
+# ---------------------------------------------------------------------------
+# HTTP frontend
+# ---------------------------------------------------------------------------
+
+def _make_handler(router: IngressRouter):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _reply(
+            self, code: int, payload: bytes | dict,
+            trace_id: str | None = None, retry_after_s: float | None = None,
+        ) -> None:
+            data = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            if trace_id:
+                self.send_header(TRACE_HEADER, trace_id)
+            if retry_after_s is not None:
+                self.send_header("Retry-After", f"{retry_after_s:.3f}")
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802 (stdlib naming contract)
+            if self.path == "/healthz":
+                self._reply(200, {
+                    "status": "ok",
+                    "role": "active" if router.active else "standby",
+                    "instance": router.instance,
+                    "pools": router.pools.health(),
+                    "port": router.port,
+                })
+            elif self.path == "/metrics":
+                try:
+                    data = router.metrics_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", PROM_CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                except Exception as exc:  # scrape must never hang the socket
+                    logger.error(f"ingress: /metrics failed: {exc!r}")
+                    self._reply(500, {"error": "internal", "detail": repr(exc)})
+            else:
+                self._reply(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):  # noqa: N802
+            if self.path not in ("/v1/predict", "/predict"):
+                self._reply(404, {"error": f"no route {self.path}"})
+                return
+            trace_id = ensure_trace_id(self.headers.get(TRACE_HEADER))
+            try:
+                self._predict(trace_id)
+            except Exception as exc:  # server-side: 500, never a hung socket
+                logger.error(f"ingress: request failed: {exc!r}")
+                self._reply(500, {"error": "internal", "detail": repr(exc)}, trace_id)
+
+        def _predict(self, trace_id: str) -> None:
+            if not router.active:
+                # retryable: the client's router mode bounces to the peer
+                # (the promoted active) on the next attempt
+                self._reply(
+                    503, {"error": "standby", "instance": router.instance},
+                    trace_id, retry_after_s=max(0.05, router.lease_s / 4.0),
+                )
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                raw = self.rfile.read(length) if length else b"{}"
+                body = json.loads(raw)
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, UnicodeDecodeError) as exc:
+                self._reply(400, {"error": "bad_json", "detail": str(exc)}, trace_id)
+                return
+            model = str(body.get("model", ""))
+            n = _example_count(body.get("inputs"))
+            tenant = router.admission.authenticate(self.headers.get(API_KEY_HEADER))
+            if tenant is None:
+                # 401 is fail-fast at the client by design: replaying a bad
+                # key against every pool can only fail again
+                self._reply(401, {"error": "unknown_api_key"}, trace_id)
+                return
+            reason, retry_after = router.admission.admit(tenant, n)
+            if reason:
+                router.journal_event(
+                    "ingress_shed", reason=reason, model=model, tenant=tenant.name,
+                    retry_after_s=round(retry_after, 3), n=n, trace_id=trace_id,
+                )
+                self._reply(
+                    429, {"error": reason, "tenant": tenant.name},
+                    trace_id, retry_after_s=retry_after,
+                )
+                return
+            tic = time.monotonic()
+            try:
+                result = router.route(model, n, raw, trace_id)
+            finally:
+                latency_ms = 1000.0 * (time.monotonic() - tic)
+                router.admission.release(tenant, n, latency_ms)
+            if result.status == 503 and result.reason:
+                router.journal_event(
+                    "ingress_shed",
+                    reason=result.reason, model=model, tenant=tenant.name,
+                    retry_after_s=round(result.retry_after_s or 0.0, 3),
+                    pools_tried=result.pools_tried, n=n, trace_id=trace_id,
+                )
+            elif router.journal_requests:
+                router.journal_event(
+                    "ingress_route",
+                    model=model, pool=result.pool, replica=result.replica,
+                    n=n, latency_ms=round(latency_ms, 3),
+                    ok=(result.status == 200), tenant=tenant.name,
+                    attempts=result.attempts, spilled=result.spilled,
+                    trace_id=trace_id, status=result.status,
+                )
+            self._reply(result.status, result.body, trace_id,
+                        retry_after_s=result.retry_after_s)
+
+        def log_message(self, fmt, *args):  # access log → logger, not stderr
+            logger.debug(f"ingress http: {fmt % args}")
+
+    return Handler
+
+
+def _example_count(inputs) -> int:
+    """Leading-dimension example count of a request's ``inputs`` without
+    decoding the payload (the router moves bytes, never tensors). Mirrors
+    frontend.decode_inputs: rank 3 (dict shape or nested lists) is a single
+    implicit-batch example, rank 4's leading dim is the count."""
+    if isinstance(inputs, dict):
+        shape = inputs.get("shape")
+        if isinstance(shape, list) and len(shape) >= 4:
+            try:
+                return max(1, int(shape[0]))
+            except (TypeError, ValueError):
+                return 1
+    elif isinstance(inputs, list) and inputs:
+        depth, node = 1, inputs[0]
+        while isinstance(node, list) and node and depth < 4:
+            depth, node = depth + 1, node[0]
+        if depth >= 4:  # (n, H, W, 3): leading dim is the batch
+            return len(inputs)
+    return 1
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+def resolve_ingress_port(instance: int) -> int:
+    """DTPU_INGRESS_PORT env (the fleet sidecar's per-router handoff) >
+    SERVE.INGRESS.PORT (+instance, so a manually-launched pair on one YAML
+    gets distinct ports) > an ephemeral pick avoiding the rendezvous,
+    dataplane and serve ports in play."""
+    env_port = os.environ.get("DTPU_INGRESS_PORT", "")
+    if env_port.isdigit() and int(env_port) > 0:
+        return int(env_port)
+    if int(cfg.SERVE.INGRESS.PORT) > 0:
+        return int(cfg.SERVE.INGRESS.PORT) + int(instance)
+    from distribuuuu_tpu.runtime.dist import pick_rendezvous_port, rendezvous_ports_in_play
+
+    return pick_rendezvous_port(exclude=rendezvous_ports_in_play())
+
+
+def run_http(router: IngressRouter, stop_event: threading.Event) -> None:
+    host = str(cfg.SERVE.INGRESS.HOST)
+    port = resolve_ingress_port(router.instance)
+    server = ThreadingHTTPServer((host, port), _make_handler(router))
+    router.announce(server.server_address[1], host)
+    logger.info(
+        f"dtpu-ingress[{router.instance}] "
+        f"({'active' if router.active else 'standby'}): routing "
+        f"{ {p: len(u) for p, u in router.pool_map.items()} } on "
+        f"http://{host}:{server.server_address[1]}"
+    )
+    thread = threading.Thread(
+        target=server.serve_forever, daemon=True, name="dtpu-ingress-http"
+    )
+    thread.start()
+    try:
+        stop_event.wait()
+    finally:
+        server.shutdown()
+        server.server_close()
+        # let in-flight requests complete (the zero-client-visible-drops
+        # half of a graceful demotion — handler threads are daemonic, so
+        # without this wait an exit would sever them mid-response)
+        deadline = time.monotonic() + 5.0
+        while router.admission.inflight_total() > 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        thread.join(timeout=5.0)
+
+
+def ingress_main(argv: list[str] | None = None) -> int:
+    """``dtpu-ingress`` / ``python -m distribuuuu_tpu.serve.ingress``."""
+    load_cfg_fom_args("dtpu-ingress: global multi-pool serving router.", argv=argv)
+    cfg.freeze()
+    setup_logger(None, 0)  # supervisor-style: stderr only, no rank-0 log file
+
+    router = IngressRouter(cfg.OUT_DIR).start()
+    stop = threading.Event()
+    stop_signum: list[int] = []
+
+    def _on_signal(signum, frame):
+        stop_signum.append(signum)
+        stop.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+    except ValueError:  # not the main thread (embedded/test use)
+        pass
+
+    # a demotion must also unblock the serving loop: wire the router's stop
+    # into ours via a watcher on its internal event
+    def _watch_demote():
+        router._stop.wait()
+        stop.set()
+
+    threading.Thread(target=_watch_demote, daemon=True, name="dtpu-ingress-demote").start()
+
+    try:
+        run_http(router, stop)
+    finally:
+        router.stop()
+    if router.demoted:
+        return DEMOTED_EXIT_CODE
+    if stop_signum:
+        # preemption semantics, matching the serve replica taxonomy
+        return 128 + stop_signum[0]
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(ingress_main())

@@ -14,6 +14,15 @@ What it proves, end to end on CPU:
 Exit 0 = all of the above held. Usage:
 
     python scripts/run_serve_check.py [--out-dir DIR]
+
+``--ingress`` runs the global-front-door smoke instead (the CI
+`ingress-smoke` job, docs/SERVING.md "Global ingress"): 2 pools x 2 real
+replicas behind a dtpu-ingress router (under LockOrderGuard when
+DTPU_LOCK_ORDER=1), concurrent two-tenant traffic with tenant A bursting
+past its quota, the whole home pool killed mid-stream — asserts zero
+dropped requests (spillover), at least one journaled quota shed with a
+Retry-After answer, tenant B untouched, and a schema-valid journal whose
+summarize report renders the ingress section.
 """
 
 import argparse
@@ -40,7 +49,13 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out-dir", default="/tmp/serve_smoke")
     ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument(
+        "--ingress", action="store_true",
+        help="run the multi-pool router smoke instead of the single-replica one",
+    )
     args = ap.parse_args()
+    if args.ingress:
+        return ingress_check(args)
 
     from distribuuuu_tpu import config
     from distribuuuu_tpu.analysis.guards import CompileGuard
@@ -125,6 +140,174 @@ def main() -> int:
     assert "serving: replica" in report, "summarize did not render the serving section"
     assert "p99" in report and "batch fill" in report
     print("serve smoke: OK")
+    return 0
+
+
+def ingress_check(args) -> int:
+    """The `ingress-smoke` driver: 2 pools x 2 REAL replicas, one router."""
+    from contextlib import nullcontext
+
+    from distribuuuu_tpu import config
+    from distribuuuu_tpu.convert import synthetic_variables
+    from distribuuuu_tpu.obs.journal import read_journal, validate_journal
+    from distribuuuu_tpu.obs.summarize import summarize_file
+    from distribuuuu_tpu.runtime import data_mesh
+    from distribuuuu_tpu.runtime.compile_cache import enable_persistent_cache
+    from distribuuuu_tpu.serve.client import ServeClient
+    from distribuuuu_tpu.serve.engine import ModelSpec
+    from distribuuuu_tpu.serve.frontend import ServeReplica
+    from distribuuuu_tpu.serve.frontend import run_http as run_replica_http
+    from distribuuuu_tpu.serve.ingress import IngressRouter, _make_handler
+
+    enable_persistent_cache()
+    im, nc, ladder = 32, 8, [1, 4]
+    out_dir = args.out_dir
+    os.makedirs(out_dir, exist_ok=True)
+
+    import orbax.checkpoint as ocp
+
+    variables = synthetic_variables("resnet18", 7, im, nc)
+    weights = os.path.join(out_dir, "weights_rn18")
+    ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).save(weights, variables, force=True)
+    spec = ModelSpec("rn18", "resnet18", weights)
+
+    c = config.cfg
+    c.OUT_DIR = out_dir
+    c.MODEL.NUM_CLASSES = nc
+    c.SERVE.BATCH_SIZES = ladder
+    c.SERVE.IM_SIZE = im
+    c.SERVE.INPUT_DTYPE = "float32"
+    c.SERVE.DTYPE = "float32"
+    c.SERVE.MAX_QUEUE_DELAY_MS = 5.0
+    c.SERVE.SLO_WINDOW_S = 9999.0
+    c.SERVE.PORT = 0
+
+    # 2 pools x 2 real replicas, each journaling its own .part<1000+R>;
+    # the shared persistent compile cache amortizes the ladder to ~one
+    # compile set across all four
+    replicas, stops = [], []
+    mesh = data_mesh(-1)
+    for i in range(4):
+        os.environ["DTPU_SERVE_REPLICA"] = str(i)
+        replica = ServeReplica(mesh, [spec], out_dir)
+        stop = threading.Event()
+        threading.Thread(
+            target=run_replica_http, args=(replica, stop), daemon=True
+        ).start()
+        deadline = time.monotonic() + 120
+        while replica.port == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert replica.port, f"replica {i} never bound"
+        replicas.append(replica)
+        stops.append(stop)
+    os.environ.pop("DTPU_SERVE_REPLICA", None)  # the router is not a replica
+    print(f"pools: east={replicas[0].port},{replicas[1].port} "
+          f"west={replicas[2].port},{replicas[3].port}")
+
+    s = c.SERVE.INGRESS
+    s.POOLS = [
+        f"east={replicas[0].port},{replicas[1].port}",
+        f"west={replicas[2].port},{replicas[3].port}",
+    ]
+    # tenant A: 8 examples/s quota its ~3x demand WILL burst through
+    # (sheds are certain, yet the bucket drains well inside the client
+    # deadline); tenant B: effectively unmetered — the isolation control
+    s.TENANTS = ["teamA=ka:8:8", "teamB=kb:100000:100000"]
+    s.PROBE_S = 0.2
+    s.QUARANTINE_S = 0.5
+
+    # the concurrency analyzer's dynamic complement: under DTPU_LOCK_ORDER=1
+    # every lock the router builds is order-checked while the chaos runs
+    if os.environ.get("DTPU_LOCK_ORDER") == "1":
+        from distribuuuu_tpu.analysis.guards import LockOrderGuard
+
+        guard = LockOrderGuard()
+        print("router under LockOrderGuard")
+    else:
+        guard = nullcontext()
+
+    from http.server import ThreadingHTTPServer
+
+    with guard:
+        router = IngressRouter(out_dir).start()
+        server = ThreadingHTTPServer(("127.0.0.1", 0), _make_handler(router))
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        router_port = server.server_address[1]
+        router.announce(router_port, "127.0.0.1")
+        assert router.active, "sole router failed to claim the lease"
+        print(f"router on port {router_port}")
+
+        outcomes = {"a_ok": 0, "b_ok": 0, "failed": 0}
+        killed = threading.Event()
+
+        def fire(tenant_key, bucket, n_requests, kill_at=-1):
+            client = ServeClient([router_port], deadline_s=60, api_key=tenant_key)
+            for i in range(n_requests):
+                if i == kill_at and not killed.is_set():
+                    killed.set()
+                    for k in (0, 1):
+                        stops[k].set()
+                        replicas[k].shutdown()
+                    print("home pool killed mid-stream")
+                n = (1, 4)[i % 2]
+                x = np.random.default_rng(i).standard_normal(
+                    (n, im, im, 3), dtype=np.float32
+                )
+                try:
+                    logits = client.predict("rn18", x)
+                    assert logits.shape == (n, nc), logits.shape
+                    outcomes[bucket] += 1
+                except Exception as exc:  # noqa: BLE001 - zero-drops assertion
+                    outcomes["failed"] += 1
+                    print(f"DROPPED ({bucket}): {i}: {exc!r}")
+
+        threads = [
+            # tenant A bursts: 3 eager threads, one kills the home pool
+            threading.Thread(target=fire, args=("ka", "a_ok", 12, 6)),
+            threading.Thread(target=fire, args=("ka", "a_ok", 12)),
+            threading.Thread(target=fire, args=("ka", "a_ok", 12)),
+            # tenant B's steady control traffic
+            threading.Thread(target=fire, args=("kb", "b_ok", 12)),
+            threading.Thread(target=fire, args=("kb", "b_ok", 12)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        assert outcomes["failed"] == 0, f"dropped requests: {outcomes}"
+        assert outcomes["a_ok"] == 36 and outcomes["b_ok"] == 24, outcomes
+        print(f"zero drops across pool kill: {outcomes}")
+
+        router.stop()
+    server.shutdown()
+    server.server_close()
+    for k in (2, 3):
+        stops[k].set()
+        replicas[k].shutdown()
+
+    journal = os.path.join(out_dir, "telemetry.jsonl")
+    schema_errors = validate_journal(journal)
+    assert not schema_errors, schema_errors
+    records = list(read_journal(journal))
+    sheds = [r for r in records if r.get("kind") == "ingress_shed"]
+    quota_sheds = [r for r in sheds if r.get("reason") == "quota"]
+    assert quota_sheds, "tenant A's burst never hit its quota"
+    assert all(r.get("tenant") == "teamA" for r in quota_sheds), quota_sheds
+    assert all(r.get("retry_after_s", 0) > 0 for r in quota_sheds)
+    spilled = [
+        r for r in records
+        if r.get("kind") == "ingress_route" and r.get("spilled")
+    ]
+    assert spilled, "no spillover despite the dark home pool"
+    print(f"quota sheds: {len(quota_sheds)} (all teamA, Retry-After set); "
+          f"spilled requests: {len(spilled)}")
+
+    report = summarize_file(journal)
+    print(report)
+    assert "ingress:" in report, "summarize did not render the ingress section"
+    assert "tenant[teamA]" in report and "pool[west]" in report
+    print("ingress smoke: OK")
     return 0
 
 

@@ -48,6 +48,7 @@ _LOCK_ORDER_MODULES = (
     "test_dataplane",
     "test_autoscale",
     "test_deploy",
+    "test_ingress",
 )
 
 

@@ -1,0 +1,949 @@
+"""dtpu-ingress tests (docs/SERVING.md "Global ingress").
+
+Tiers:
+
+- **units** — pool/tenant spec parsing, Prometheus gauge parsing, token
+  buckets, weighted-fair admission, example counting, derived ports.
+- **router tier** (stub HTTP replicas, no engine/compiles) — discovery +
+  quarantine + live rejoin, least-loaded routing with trace-id stickiness,
+  spillover before shedding, the largest-surviving-pool Retry-After
+  contract, tenant quota isolation, sticky-canary integrity through the
+  router, the standby's retryable 503 and in-process promotion, client
+  endpoint re-resolution, journal schema validity.
+- **chaos tier** (slow: subprocess routers over the lease file) — SIGKILL
+  the active router mid-stream: the standby promotes within ~one lease
+  interval and the retrying client sees zero dropped requests.
+
+The stub replicas speak the real wire contract (/healthz, /metrics,
+/v1/predict with Retry-After on shed, canary versioning by the batcher's
+own crc32 hash) so the router is exercised against the protocol, not a
+mock of itself.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from distribuuuu_tpu.obs.journal import read_journal, validate_journal  # noqa: E402
+from distribuuuu_tpu.serve.client import ServeClient  # noqa: E402
+from distribuuuu_tpu.serve.ingress import (  # noqa: E402
+    AdmissionController,
+    INGRESS_PART,
+    IngressRouter,
+    _example_count,
+    _make_handler,
+    parse_gauge,
+    parse_pools,
+    parse_tenants,
+)
+
+
+# ---------------------------------------------------------------------------
+# stub replicas: the real wire contract without an engine
+# ---------------------------------------------------------------------------
+
+class StubReplica:
+    """A scriptable replica: /healthz, /metrics and /v1/predict with the
+    serve frontend's wire behaviours (trace-id echo, 503 + Retry-After
+    shed, sticky-canary version selection by the batcher's crc32 hash)."""
+
+    def __init__(self, name, models=("m",), *, ready=True, queue_depth=0.0,
+                 p99_ms=1.0, retry_after=None, canary_fraction=0.0, port=0):
+        self.name = name
+        self.models = list(models)
+        self.ready = ready
+        self.queue_depth = float(queue_depth)
+        self.p99_ms = float(p99_ms)
+        self.retry_after = retry_after  # not None => every predict sheds 503
+        self.canary_fraction = float(canary_fraction)
+        self.requests = []  # (trace_id, model) per predict served
+        self._lock = threading.Lock()
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _reply(self, code, payload, headers=()):
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, {
+                        "status": "ok", "ready": stub.ready,
+                        "models": stub.models,
+                        "versions": {m: "v1" for m in stub.models},
+                    })
+                elif self.path == "/metrics":
+                    text = (
+                        f"dtpu_serve_queue_depth {stub.queue_depth:.10g}\n"
+                        f"dtpu_serve_p99_ms {stub.p99_ms:.10g}\n"
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(text)))
+                    self.end_headers()
+                    self.wfile.write(text)
+                else:
+                    self._reply(404, {"error": "no route"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                trace_id = self.headers.get("x-dtpu-trace-id", "")
+                if stub.retry_after is not None:
+                    self._reply(
+                        503, {"error": "shed"},
+                        [("Retry-After", f"{stub.retry_after:.3f}")],
+                    )
+                    return
+                with stub._lock:
+                    stub.requests.append((trace_id, body.get("model", "")))
+                # the MicroBatcher's sticky-canary decision, verbatim
+                # (serve/batcher.py _version_for): the router must preserve
+                # the trace id so this lands identically on every replica
+                canary = (
+                    zlib.crc32(trace_id.encode()) / 2**32 < stub.canary_fraction
+                )
+                self._reply(200, {
+                    "logits": [[1.0, 2.0]],
+                    "replica": stub.name,
+                    "version": "canary" if canary else "stable",
+                })
+
+            def log_message(self, *a):
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", int(port)), Handler)
+        self.port = self._server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def _post(url, body, headers=None, timeout=10.0):
+    """POST json → (status, payload dict, headers). Never raises on 4xx/5xx."""
+    req = urllib.request.Request(
+        f"{url}/v1/predict", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        try:
+            payload = json.loads(exc.read() or b"{}")
+        except (ValueError, OSError):
+            payload = {}
+        return exc.code, payload, dict(exc.headers)
+
+
+def _make_router(monkeypatch, tmp_path, pools, *, tenants=(), instance=0, **over):
+    """An IngressRouter over stub pools (probe cadence tightened for tests).
+    ``pools`` is {name: [StubReplica, ...]}."""
+    from distribuuuu_tpu.config import cfg
+
+    s = cfg.SERVE.INGRESS
+    s.POOLS = [
+        f"{name}={','.join(str(r.port) for r in reps)}"
+        for name, reps in pools.items()
+    ]
+    s.TENANTS = list(tenants)
+    s.PROBE_S = over.pop("probe_s", 0.2)
+    s.PROBE_TIMEOUT_S = 1.0
+    s.QUARANTINE_S = over.pop("quarantine_s", 0.4)
+    s.LEASE_S = over.pop("lease_s", 2.0)
+    s.ROLLUP_S = over.pop("rollup_s", 0.5)
+    for key, value in over.items():
+        setattr(s, key, value)
+    monkeypatch.setenv("DTPU_INGRESS_INSTANCE", str(instance))
+    return IngressRouter(str(tmp_path))
+
+
+def _serve_router(router):
+    """router behind a real ThreadingHTTPServer on an ephemeral port."""
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _make_handler(router))
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+def _stop_server(server):
+    server.shutdown()
+    server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# units
+# ---------------------------------------------------------------------------
+
+def test_parse_pools():
+    pools = parse_pools(["east=8001,8002", "west=10.0.0.2:9001"])
+    assert list(pools) == ["east", "west"]  # listed order = spill order
+    assert pools["east"] == ["http://127.0.0.1:8001", "http://127.0.0.1:8002"]
+    assert pools["west"] == ["http://10.0.0.2:9001"]
+    for bad in ("east", "east=", "=8001", "east=notaport", "east=:"):
+        with pytest.raises(ValueError):
+            parse_pools([bad])
+    with pytest.raises(ValueError, match="twice"):
+        parse_pools(["east=8001", "east=8002"])
+
+
+def test_parse_tenants():
+    a, b = parse_tenants(["teamA=ka:100", "teamB=kb:50:75:2"])
+    assert (a.name, a.key, a.rate, a.burst, a.weight) == ("teamA", "ka", 100.0, 200.0, 1.0)
+    assert (b.name, b.key, b.rate, b.burst, b.weight) == ("teamB", "kb", 50.0, 75.0, 2.0)
+    for bad in ("teamA", "teamA=", "teamA=k", "teamA=k:0", "teamA=k:-1"):
+        with pytest.raises(ValueError):
+            parse_tenants([bad])
+    with pytest.raises(ValueError, match="twice"):
+        parse_tenants(["a=k:1", "b=k:1"])
+
+
+def test_parse_gauge_sums_labels():
+    text = (
+        '# TYPE dtpu_serve_queue_depth gauge\n'
+        'dtpu_serve_queue_depth{model="a"} 3\n'
+        'dtpu_serve_queue_depth{model="b"} 4.5\n'
+        'dtpu_serve_queue_depth_other 99\n'
+        'dtpu_serve_p99_ms 12.5\n'
+    )
+    assert parse_gauge(text, "serve_queue_depth") == 7.5
+    assert parse_gauge(text, "serve_p99_ms") == 12.5
+    assert parse_gauge(text, "absent_metric") == 0.0
+
+
+def test_example_count():
+    assert _example_count({"b64": "...", "shape": [8, 32, 32, 3]}) == 8
+    assert _example_count({"b64": "...", "shape": [32, 32, 3]}) == 1
+    one = [[[0.0] * 3] * 4] * 4          # (4, 4, 3): one implicit example
+    assert _example_count(one) == 1
+    assert _example_count([one, one]) == 2  # (2, 4, 4, 3)
+    assert _example_count(None) == 1
+    assert _example_count("garbage") == 1
+
+
+def test_token_bucket_quota_and_refill():
+    (t,) = parse_tenants(["a=k:10:10"])  # 10 examples/s, burst 10
+    now = t.refilled  # the bucket's own clock origin
+    assert t.take(10, now) == 0.0        # burst spends clean
+    wait = t.take(5, now)                # empty: must wait 5/10 s
+    assert wait == pytest.approx(0.5)
+    assert t.take(5, now + 0.5) == 0.0   # refilled exactly that much
+
+
+def test_admission_weighted_fair_share():
+    admission = AdmissionController(
+        parse_tenants(["a=ka:1000:1000", "b=kb:1000:1000"]), max_inflight=10
+    )
+    ta = admission.authenticate("ka")
+    tb = admission.authenticate("kb")
+    assert admission.authenticate("nope") is None
+    assert admission.authenticate(None) is None
+    # tenant A fills the router: its own further load sheds fair_share...
+    for _ in range(10):
+        assert admission.admit(ta, 1) == ("", 0.0)
+    reason, retry = admission.admit(ta, 1)
+    assert reason == "fair_share" and retry >= 0.05
+    # ...but tenant B (inflight 0, under its 5-example share) still admits
+    assert admission.admit(tb, 1) == ("", 0.0)
+    admission.release(tb, 1, 1.0)
+    for _ in range(11):
+        admission.release(ta, 1, 1.0)
+    assert admission.inflight_total() == 0
+
+
+def test_admission_open_mode_admits_anonymous():
+    admission = AdmissionController([], max_inflight=4)
+    anon = admission.authenticate(None)
+    assert anon is not None and admission.admit(anon, 2) == ("", 0.0)
+    admission.release(anon, 2, 1.0)
+
+
+def test_derive_ingress_port_reserves_pair():
+    from distribuuuu_tpu.runtime.dist import derive_ingress_port
+
+    p1 = derive_ingress_port("/out/a")
+    assert derive_ingress_port("/out/a") == p1  # deterministic
+    assert 20000 <= p1 <= 29500
+    # the pair contract: base+1 belongs to the standby, so an explicit
+    # exclusion of base must also move past it
+    p2 = derive_ingress_port("/out/a", exclude={p1})
+    assert p2 not in (p1, p1 + 1)
+
+
+# ---------------------------------------------------------------------------
+# router tier: discovery / routing / tenancy (stub replicas)
+# ---------------------------------------------------------------------------
+
+def test_discovery_quarantine_eject_and_events(monkeypatch, tmp_path, fresh_cfg):
+    r1 = StubReplica("r1", queue_depth=2.0)
+    r2 = StubReplica("r2", queue_depth=0.0)
+    router = _make_router(monkeypatch, tmp_path, {"east": [r1, r2]})
+    try:
+        router.pools.probe_once()
+        [(pool, urls)] = router.pools.candidates(
+            "m", "", sticky_slack=0.0, per_pool=4
+        )
+        assert pool == "east" and urls == [r2.url, r1.url]  # least-loaded first
+
+        # r2 goes dark: quarantined out of the candidate set
+        r2.stop()
+        router.pools.probe_once()
+        [(_, urls)] = router.pools.candidates("m", "", sticky_slack=0.0, per_pool=4)
+        assert urls == [r1.url]
+
+        # an unready replica (version swap) is ejected but NOT quarantined
+        r1.ready = False
+        router.pools.probe_once()
+        assert router.pools.candidates("m", "", sticky_slack=0.0, per_pool=4) == []
+        r1.ready = True
+        router.pools.probe_once()
+        [(_, urls)] = router.pools.candidates("m", "", sticky_slack=0.0, per_pool=4)
+        assert urls == [r1.url]
+
+        kinds = [
+            (rec["event"], rec["replica"])
+            for rec in read_journal(router.journal.path)
+            if rec.get("kind") == "ingress_replica"
+        ]
+        assert ("join", r1.url) in kinds and ("join", r2.url) in kinds
+        assert ("quarantine", r2.url) in kinds
+        assert ("eject", r1.url) in kinds and ("ready", r1.url) in kinds
+    finally:
+        r1.stop()
+        router.stop()
+
+
+def test_quarantined_replica_rejoins_after_cooldown(monkeypatch, tmp_path, fresh_cfg):
+    # a fixed port, so "the replica came back" reuses the configured
+    # address the way a real redeploy does (SO_REUSEADDR makes the rebind
+    # safe against TIME_WAIT)
+    r1 = StubReplica("r1")
+    port = r1.port
+    router = _make_router(monkeypatch, tmp_path, {"east": [r1]}, quarantine_s=0.1)
+    try:
+        router.pools.probe_once()
+        r1.stop()
+        router.pools.probe_once()  # probe failure -> quarantine
+        assert router.pools.candidates("m", "", sticky_slack=0.0, per_pool=4) == []
+        # inside the cooldown the replica is not even probed
+        router.pools.probe_once()
+        time.sleep(0.15)  # cooldown expires
+        r1b = StubReplica("r1b", port=port)  # the restarted replica
+        router.pools.probe_once()  # cooldown re-probe finds it
+        [(_, urls)] = router.pools.candidates("m", "", sticky_slack=0.0, per_pool=4)
+        assert urls == [r1b.url]
+        events = [
+            rec["event"] for rec in read_journal(router.journal.path)
+            if rec.get("kind") == "ingress_replica"
+        ]
+        assert events.count("quarantine") == 1  # cooldown muffled the repeat
+        assert "rejoin" in events
+        r1b.stop()
+    finally:
+        router.stop()
+
+
+def test_sticky_trace_prefers_one_replica_until_slack(monkeypatch, tmp_path, fresh_cfg):
+    reps = [StubReplica(f"r{i}") for i in range(3)]
+    router = _make_router(monkeypatch, tmp_path, {"east": reps})
+    try:
+        router.pools.probe_once()
+        [(_, order1)] = router.pools.candidates(
+            "m", "trace-xyz", sticky_slack=8.0, per_pool=3
+        )
+        [(_, order2)] = router.pools.candidates(
+            "m", "trace-xyz", sticky_slack=8.0, per_pool=3
+        )
+        assert order1[0] == order2[0]  # same trace id -> same preferred head
+        # overload the preferred replica beyond the slack: it loses headship
+        router.pools._replicas[order1[0]].inflight = 100
+        [(_, order3)] = router.pools.candidates(
+            "m", "trace-xyz", sticky_slack=8.0, per_pool=3
+        )
+        assert order3[0] != order1[0]
+        # a different trace id may hash elsewhere but is itself stable
+        [(_, o_a)] = router.pools.candidates("m", "other", sticky_slack=8.0, per_pool=3)
+        [(_, o_b)] = router.pools.candidates("m", "other", sticky_slack=8.0, per_pool=3)
+        assert o_a[0] == o_b[0]
+    finally:
+        for r in reps:
+            r.stop()
+        router.stop()
+
+
+def test_route_spills_to_secondary_pool(monkeypatch, tmp_path, fresh_cfg):
+    home = StubReplica("home", retry_after=0.8)   # saturated: always sheds
+    west = StubReplica("west")
+    router = _make_router(monkeypatch, tmp_path, {"east": [home], "west": [west]})
+    try:
+        router.pools.probe_once()
+        result = router.route("m", 1, json.dumps({"model": "m"}).encode(), "t1")
+        assert result.status == 200
+        assert result.pool == "west" and result.spilled
+        assert json.loads(result.body)["replica"] == "west"
+    finally:
+        home.stop()
+        west.stop()
+        router.stop()
+
+
+def test_shed_propagates_largest_pool_retry_after(monkeypatch, tmp_path, fresh_cfg):
+    """Satellite: when EVERY pool sheds, the router's Retry-After must be
+    the LARGEST surviving pool's drain estimate — not the first 503's."""
+    east = StubReplica("east", retry_after=0.25)
+    west = StubReplica("west", retry_after=1.75)  # the deeper backlog
+    router = _make_router(monkeypatch, tmp_path, {"east": [east], "west": [west]})
+    try:
+        router.pools.probe_once()
+        result = router.route("m", 1, b"{}", "t1")
+        assert result.status == 503 and result.reason == "saturated"
+        assert result.retry_after_s == pytest.approx(1.75, abs=1e-6)
+        # order independence: the bigger estimate wins from either side
+        east.retry_after, west.retry_after = 1.75, 0.25
+        result = router.route("m", 1, b"{}", "t2")
+        assert result.retry_after_s == pytest.approx(1.75, abs=1e-6)
+    finally:
+        east.stop()
+        west.stop()
+        router.stop()
+
+
+def test_route_dark_pool_no_replica(monkeypatch, tmp_path, fresh_cfg):
+    r1 = StubReplica("r1")
+    router = _make_router(monkeypatch, tmp_path, {"east": [r1]})
+    try:
+        router.pools.probe_once()
+        r1.stop()
+        result = router.route("m", 1, b"{}", "t1")
+        # the forward-time connect failure quarantines the replica and the
+        # shed reads no_replica with a probe-scale Retry-After
+        assert result.status == 503 and result.reason == "no_replica"
+        assert result.retry_after_s >= router.pools.probe_s
+        assert router.pools.candidates("m", "", sticky_slack=0.0, per_pool=4) == []
+    finally:
+        router.stop()
+
+
+def test_http_surface_tenants_and_trace(monkeypatch, tmp_path, fresh_cfg):
+    """End-to-end over real HTTP: auth, quota 429 + Retry-After, trace-id
+    echo, /healthz role + pools, /metrics rendering, journal validity."""
+    rep = StubReplica("r1")
+    router = _make_router(
+        monkeypatch, tmp_path, {"east": [rep]},
+        tenants=["teamA=ka:2:2", "teamB=kb:1000:1000"],
+    ).start()
+    server, url = _serve_router(router)
+    try:
+        assert router.active  # sole instance claims the lease at start
+        # no key -> 401 (fail-fast at the client: ServeRequestError class)
+        status, payload, _ = _post(url, {"model": "m", "inputs": None})
+        assert status == 401 and payload["error"] == "unknown_api_key"
+        # teamA: burst of 2 admits, the 3rd sheds quota with Retry-After
+        codes, retry_after = [], None
+        for i in range(3):
+            status, payload, headers = _post(
+                url, {"model": "m", "inputs": None},
+                {"x-dtpu-api-key": "ka", "x-dtpu-trace-id": f"ta-{i}"},
+            )
+            codes.append(status)
+            if status == 429:
+                retry_after = float(headers["Retry-After"])
+                assert payload["error"] == "quota"
+        assert codes.count(200) == 2 and codes.count(429) == 1
+        assert retry_after is not None and retry_after >= 0.05
+        # teamB rides through A's quota exhaustion untouched
+        status, payload, headers = _post(
+            url, {"model": "m", "inputs": None},
+            {"x-dtpu-api-key": "kb", "x-dtpu-trace-id": "tb-1"},
+        )
+        assert status == 200
+        assert headers["x-dtpu-trace-id"] == "tb-1"  # echoed verbatim
+        assert rep.requests[-1] == ("tb-1", "m")     # forwarded verbatim
+        # surfaces
+        with urllib.request.urlopen(f"{url}/healthz", timeout=5) as resp:
+            health = json.loads(resp.read())
+        assert health["role"] == "active"
+        assert health["pools"]["east"] == {"replicas": 1, "healthy": 1}
+        with urllib.request.urlopen(f"{url}/metrics", timeout=5) as resp:
+            metrics = resp.read().decode()
+        assert 'dtpu_ingress_requests_total{pool="east"}' in metrics
+        assert 'dtpu_ingress_sheds_by_reason_total{reason="quota"} 1' in metrics
+        assert "dtpu_ingress_role 1" in metrics
+    finally:
+        _stop_server(server)
+        router.stop()
+        rep.stop()
+    # every journaled record validates against the schema, on the router's
+    # own supervisory part — naming instance 0's production part is this
+    # assertion's whole point (the writer itself derives it in ingress.py)
+    assert router.journal.path.endswith(f".part{INGRESS_PART}")  # dtpu-lint: disable=DT204
+    assert validate_journal(router.journal.path) == []
+    records = list(read_journal(router.journal.path))
+    sheds = [r for r in records if r["kind"] == "ingress_shed"]
+    assert sheds and sheds[0]["tenant"] == "teamA" and sheds[0]["reason"] == "quota"
+    routes = [r for r in records if r["kind"] == "ingress_route"]
+    assert {r["tenant"] for r in routes} == {"teamA", "teamB"}
+
+
+def test_tenant_burst_isolation(monkeypatch, tmp_path, fresh_cfg):
+    """Acceptance: tenant A bursting past its quota degrades ONLY tenant A —
+    B's p99 (from the ingress_route records) stays within a factor of its
+    no-burst baseline, A's overage is answered 429+Retry-After, and no
+    request of either tenant is silently dropped."""
+    rep = StubReplica("r1")
+    router = _make_router(
+        monkeypatch, tmp_path, {"east": [rep]},
+        tenants=["teamA=ka:5:5", "teamB=kb:100000:100000"],
+    ).start()
+    server, url = _serve_router(router)
+    try:
+        # baseline: B alone
+        base_lat = []
+        for i in range(10):
+            tic = time.monotonic()
+            status, _, _ = _post(
+                url, {"model": "m", "inputs": None},
+                {"x-dtpu-api-key": "kb", "x-dtpu-trace-id": f"base-{i}"},
+            )
+            assert status == 200
+            base_lat.append(time.monotonic() - tic)
+        base_p99 = sorted(base_lat)[-1]
+
+        # burst: A floods far past its 5/s bucket while B keeps a steady
+        # trickle; count every outcome — nothing may vanish
+        outcomes = {"a_ok": 0, "a_429": 0, "a_other": 0, "b_ok": 0, "b_other": 0}
+        b_lat = []
+
+        def tenant_a():
+            for i in range(40):
+                status, _, headers = _post(
+                    url, {"model": "m", "inputs": None},
+                    {"x-dtpu-api-key": "ka", "x-dtpu-trace-id": f"a-{i}"},
+                )
+                if status == 200:
+                    outcomes["a_ok"] += 1
+                elif status == 429:
+                    assert float(headers["Retry-After"]) >= 0.05
+                    outcomes["a_429"] += 1
+                else:
+                    outcomes["a_other"] += 1
+
+        burst = threading.Thread(target=tenant_a)
+        burst.start()
+        for i in range(10):
+            tic = time.monotonic()
+            status, _, _ = _post(
+                url, {"model": "m", "inputs": None},
+                {"x-dtpu-api-key": "kb", "x-dtpu-trace-id": f"b-{i}"},
+            )
+            b_lat.append(time.monotonic() - tic)
+            outcomes["b_ok" if status == 200 else "b_other"] += 1
+        burst.join()
+
+        assert outcomes["a_other"] == 0 and outcomes["b_other"] == 0
+        assert outcomes["b_ok"] == 10           # B never shed
+        assert outcomes["a_429"] > 0            # A's burst was metered...
+        assert outcomes["a_ok"] >= 5            # ...but its share admitted
+        assert outcomes["a_ok"] + outcomes["a_429"] == 40  # zero silent drops
+        # B's tail under the burst stays within a small factor of baseline
+        # (generous bound: stub replicas answer in ~ms; a starved B would
+        # show orders of magnitude)
+        assert sorted(b_lat)[-1] <= max(10.0 * base_p99, 0.5)
+    finally:
+        _stop_server(server)
+        router.stop()
+        rep.stop()
+    assert validate_journal(router.journal.path) == []
+    records = list(read_journal(router.journal.path))
+    # the rollup ledger saw both tenants
+    rollups = [r for r in records if r["kind"] == "ingress_tenant"]
+    assert {r["tenant"] for r in rollups} >= {"teamA", "teamB"}
+
+
+def test_sticky_canary_integrity_through_router(monkeypatch, tmp_path, fresh_cfg):
+    """Acceptance: a request retried through the router lands on the SAME
+    canary decision every time — the trace id is preserved end-to-end and
+    the batcher-hash decision is replica-independent."""
+    fraction = 0.5
+    reps = [
+        StubReplica(f"r{i}", canary_fraction=fraction, queue_depth=0.0)
+        for i in range(3)
+    ]
+    router = _make_router(
+        monkeypatch, tmp_path, {"east": reps}, STICKY_SLACK=0.0
+    ).start()
+    server, url = _serve_router(router)
+    try:
+        # pick trace ids on both sides of the canary hash
+        ids = {"canary": None, "stable": None}
+        i = 0
+        while None in ids.values():
+            tid = f"trace-{i}"
+            side = "canary" if zlib.crc32(tid.encode()) / 2**32 < fraction else "stable"
+            ids[side] = ids[side] or tid
+            i += 1
+        for side, tid in ids.items():
+            versions = set()
+            for _ in range(8):  # zero slack: retries spray by load, not hash
+                status, payload, headers = _post(
+                    url, {"model": "m", "inputs": None},
+                    {"x-dtpu-trace-id": tid},
+                )
+                assert status == 200
+                assert headers["x-dtpu-trace-id"] == tid
+                versions.add(payload["version"])
+            assert versions == {side}, f"{tid} flapped versions: {versions}"
+        # and the replicas saw the ids verbatim (header preserved on the wire)
+        seen = {t for r in reps for (t, _) in r.requests}
+        assert set(ids.values()) <= seen
+    finally:
+        _stop_server(server)
+        router.stop()
+        for r in reps:
+            r.stop()
+
+
+def test_standby_serves_503_then_promotes(monkeypatch, tmp_path, fresh_cfg):
+    """In-process failover: instance 0 holds the lease, instance 1 answers
+    a retryable 503 "standby"; when 0 dies without releasing (the SIGKILL
+    shape), 1 promotes within ~one lease interval."""
+    rep = StubReplica("r1")
+    lease_s = 1.0
+    active = _make_router(
+        monkeypatch, tmp_path, {"east": [rep]}, instance=0, lease_s=lease_s
+    ).start()
+    standby = _make_router(
+        monkeypatch, tmp_path, {"east": [rep]}, instance=1, lease_s=lease_s
+    ).start()
+    server, url = _serve_router(standby)
+    try:
+        assert active.active
+        deadline = time.monotonic() + 2.0
+        while standby.active and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not standby.active  # the lease is held: it stays standby
+        status, payload, headers = _post(url, {"model": "m", "inputs": None})
+        assert status == 503 and payload["error"] == "standby"
+        assert float(headers["Retry-After"]) > 0.0
+
+        # kill the active WITHOUT release (what SIGKILL looks like on disk)
+        active._stop.set()
+        active._role_thread.join(timeout=2.0)
+        tic = time.monotonic()
+        deadline = tic + 4.0 * lease_s
+        while not standby.active and time.monotonic() < deadline:
+            time.sleep(0.02)
+        promote_s = time.monotonic() - tic
+        assert standby.active, "standby never promoted"
+        # staleness threshold (lease_s) + one poll quantum, with headroom
+        assert promote_s <= 2.0 * lease_s, f"promotion took {promote_s:.2f}s"
+        status, payload, _ = _post(url, {"model": "m", "inputs": None})
+        assert status == 200
+    finally:
+        _stop_server(server)
+        active.pools.stop()
+        standby.stop()
+        rep.stop()
+    assert validate_journal(standby.journal.path) == []
+    records = list(read_journal(standby.journal.path))
+    promotes = [
+        r for r in records
+        if r["kind"] == "ingress_failover" and r["action"] == "promote"
+    ]
+    assert promotes and promotes[0]["instance"] == 1
+
+
+def test_demoted_active_exits_with_taxonomy_code(monkeypatch, tmp_path, fresh_cfg):
+    """A healed-partition double-active resolves by demotion: the router
+    that lost the lease flags DEMOTED (exit 119 in the resilience taxonomy,
+    a free relaunch under the fleet sidecar's budget)."""
+    from distribuuuu_tpu.resilience import (
+        DEMOTED_EXIT_CODE,
+        EXIT_DEMOTED,
+        classify_exit_code,
+        outcome_exit_code,
+    )
+
+    assert classify_exit_code(DEMOTED_EXIT_CODE) == EXIT_DEMOTED
+    assert outcome_exit_code(EXIT_DEMOTED) == DEMOTED_EXIT_CODE
+
+    rep = StubReplica("r1")
+    a = _make_router(monkeypatch, tmp_path, {"east": [rep]}, instance=0, lease_s=0.6).start()
+    try:
+        assert a.active
+        # a peer force-claims the lease (the healed partition's other side)
+        from distribuuuu_tpu.runtime import pathio
+
+        pathio.write_text(
+            a.lease.path, json.dumps({"holder": "ingress-9-999", "ts": time.time()})
+        )
+        deadline = time.monotonic() + 3.0
+        while not a.demoted and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert a.demoted and not a.active
+    finally:
+        a.stop()
+        rep.stop()
+    records = list(read_journal(a.journal.path))
+    demotes = [
+        r for r in records
+        if r["kind"] == "ingress_failover" and r["action"] == "demote"
+    ]
+    assert demotes and demotes[0]["holder"] == "ingress-9-999"
+
+
+def test_pool_dark_midstream_zero_drops(monkeypatch, tmp_path, fresh_cfg):
+    """Chaos (in-process): the whole home pool goes dark mid-stream; every
+    request still completes via spillover — zero client-visible drops."""
+    home = [StubReplica("h0"), StubReplica("h1")]
+    west = [StubReplica("w0"), StubReplica("w1")]
+    router = _make_router(
+        monkeypatch, tmp_path, {"east": home, "west": west}, probe_s=0.1
+    ).start()
+    server, url = _serve_router(router)
+    port = int(url.rsplit(":", 1)[1])
+    client = ServeClient([port], deadline_s=20.0)
+    try:
+        ok, total = 0, 40
+        for i in range(total):
+            if i == total // 3:  # mid-stream: SIGKILL-shaped pool loss
+                for r in home:
+                    r.stop()
+            logits = client.predict("m", np.zeros((4, 4, 3), np.uint8),
+                                    trace_id=f"dark-{i}")
+            assert logits.shape == (1, 2)
+            ok += 1
+        assert ok == total  # zero drops
+        served = {t for r in west for (t, _) in r.requests}
+        assert any(t.startswith("dark-") for t in served)  # spill really happened
+    finally:
+        _stop_server(server)
+        router.stop()
+        for r in home + west:
+            try:
+                r.stop()
+            except Exception:
+                pass
+    assert validate_journal(router.journal.path) == []
+    records = list(read_journal(router.journal.path))
+    spilled = [r for r in records if r["kind"] == "ingress_route" and r.get("spilled")]
+    assert spilled, "journal shows no spillover despite the dark home pool"
+
+
+# ---------------------------------------------------------------------------
+# client re-resolution (satellite)
+# ---------------------------------------------------------------------------
+
+def test_client_reresolves_endpoints_after_connection_failures():
+    """The client must stop grinding cached dead endpoints: once every URL
+    in its rotation fails at the connection level it re-probes the
+    configured set and rides whoever answers — covering a restart gap
+    without exhausting the deadline, with ONE trace id across all retries."""
+    rep = StubReplica("late")
+    rep_port = rep.port
+    rep.stop()  # both endpoints start dark
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+
+    _EchoHandler.seen_traces = set()
+    client = ServeClient([dead_port, rep_port], deadline_s=15.0)
+
+    def resurrect():
+        time.sleep(0.6)
+        # the "restarted replica": same configured port, new process
+        server = ThreadingHTTPServer(("127.0.0.1", rep_port), _EchoHandler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        resurrect.server = server
+
+    threading.Thread(target=resurrect, daemon=True).start()
+    try:
+        logits = client.predict("m", np.zeros((4, 4, 3), np.uint8), trace_id="one-id")
+        assert logits.shape == (1, 2)
+        assert client.refreshes >= 1          # the re-resolution fired
+        assert client.last_trace_id == "one-id"
+        assert _EchoHandler.seen_traces == {"one-id"}  # one id across retries
+    finally:
+        server = getattr(resurrect, "server", None)
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+
+
+class _EchoHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    seen_traces: set = set()
+
+    def do_GET(self):
+        data = b'{"status": "ok"}'
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_POST(self):
+        type(self).seen_traces.add(self.headers.get("x-dtpu-trace-id", ""))
+        self.rfile.read(int(self.headers.get("Content-Length", "0")))
+        data = json.dumps({"logits": [[0.0, 1.0]]}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_client_for_router_parses_addresses(monkeypatch):
+    client = ServeClient.for_router("10.0.0.1:8100,10.0.0.2:8101")
+    assert client.urls == ["http://10.0.0.1:8100", "http://10.0.0.2:8101"]
+    monkeypatch.setenv("DTPU_INGRESS_ADDR", "127.0.0.1:9100,127.0.0.1:9101")
+    client = ServeClient.for_router()
+    assert client.urls == ["http://127.0.0.1:9100", "http://127.0.0.1:9101"]
+    monkeypatch.delenv("DTPU_INGRESS_ADDR")
+    with pytest.raises(ValueError, match="DTPU_INGRESS_ADDR"):
+        ServeClient.for_router()
+    with pytest.raises(ValueError, match="host:port"):
+        ServeClient.for_router("nonsense")
+
+
+# ---------------------------------------------------------------------------
+# chaos tier: subprocess router pair, SIGKILL the active (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_router_sigkill_failover_zero_drops(tmp_path):
+    """Acceptance: SIGKILL the active ROUTER mid-stream. The standby
+    promotes within ~one lease interval (journaled), and the retrying
+    client — pointed at both routers — sees zero dropped requests."""
+    from distribuuuu_tpu.runtime.dist import pick_rendezvous_port
+
+    rep = StubReplica("r1")
+    lease_s = 2.0
+    base = pick_rendezvous_port()
+    ports = [base, base + 1]
+    out_dir = str(tmp_path)
+    procs = []
+    env_base = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "DTPU_LOCK_ORDER": os.environ.get("DTPU_LOCK_ORDER", "0"),
+    }
+    for i, port in enumerate(ports):
+        env = {
+            **env_base,
+            "DTPU_INGRESS_INSTANCE": str(i),
+            "DTPU_INGRESS_PORT": str(port),
+        }
+        procs.append(subprocess.Popen(
+            [
+                sys.executable, "-m", "distribuuuu_tpu.serve.ingress",
+                "OUT_DIR", out_dir,
+                "SERVE.INGRESS.POOLS", f"['east={rep.port}']",
+                "SERVE.INGRESS.LEASE_S", str(lease_s),
+                "SERVE.INGRESS.PROBE_S", "0.2",
+            ],
+            env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        ))
+    client = ServeClient(ports, deadline_s=30.0)
+    try:
+        client.wait_ready(deadline_s=90.0)  # both routers answer /healthz
+
+        def role_of(port):
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=2
+                ) as resp:
+                    return json.loads(resp.read())["role"]
+            except OSError:
+                return None
+
+        deadline = time.monotonic() + 30.0
+        active_idx = None
+        while active_idx is None and time.monotonic() < deadline:
+            roles = [role_of(p) for p in ports]
+            if "active" in roles:
+                active_idx = roles.index("active")
+            else:
+                time.sleep(0.2)
+        assert active_idx is not None, "no router claimed the lease"
+
+        ok = 0
+        kill_at = 10
+        total = 30
+        killed_t = None
+        for i in range(total):
+            if i == kill_at:
+                os.kill(procs[active_idx].pid, signal.SIGKILL)
+                killed_t = time.monotonic()
+            logits = client.predict(
+                "m", np.zeros((4, 4, 3), np.uint8), trace_id=f"fo-{i}"
+            )
+            assert logits.shape == (1, 2)
+            ok += 1
+        assert ok == total  # ZERO drops across the router kill
+
+        survivor = ports[1 - active_idx]
+        deadline = time.monotonic() + 10.0
+        while role_of(survivor) != "active" and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert role_of(survivor) == "active"
+        assert killed_t is not None
+
+        # the survivor journaled its promotion on its own part, schema-valid;
+        # reconstructing the subprocess router's production part path is the
+        # point — only ingress.py ever WRITES it
+        part = INGRESS_PART + (1 - active_idx)
+        journal = os.path.join(out_dir, f"telemetry.jsonl.part{part}")  # dtpu-lint: disable=DT204
+        deadline = time.monotonic() + 5.0
+        promotes = []
+        while not promotes and time.monotonic() < deadline:
+            records = (
+                list(read_journal(journal)) if os.path.exists(journal) else []
+            )
+            promotes = [
+                r for r in records
+                if r.get("kind") == "ingress_failover" and r.get("action") == "promote"
+            ]
+            time.sleep(0.1)
+        assert promotes, "promotion never journaled"
+        assert validate_journal(journal) == []
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+            except OSError:
+                pass
+            p.wait(timeout=10)
+        rep.stop()
